@@ -1,0 +1,10 @@
+"""distributedmandelbrot_tpu — a TPU-native distributed fractal-rendering framework.
+
+A pull-based tile farm with the capabilities of ofsouzap/DistributedMandelbrot,
+re-designed TPU-first: JAX/Pallas escape-time kernels, ``shard_map`` tile
+batching over device meshes, an asyncio coordinator with O(1) frontier
+scheduling, a durable append-only tile index, and wire-compatible Distributer
+and DataServer protocols.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""Chunk payload codecs (Raw 0x00, RLE 0x01) and the pick-smallest registry."""
+
+from distributedmandelbrot_tpu.codecs import base
+from distributedmandelbrot_tpu.codecs.base import (Codec, deserialize, get,
+                                                   register, serialize)
+from distributedmandelbrot_tpu.codecs.raw import RawCodec
+from distributedmandelbrot_tpu.codecs.rle import RleCodec
+
+RAW = RawCodec()
+RLE = RleCodec()
+
+if not base.all_codecs():
+    register(RAW)
+    register(RLE)
+
+__all__ = ["Codec", "RawCodec", "RleCodec", "RAW", "RLE", "register", "get",
+           "serialize", "deserialize"]

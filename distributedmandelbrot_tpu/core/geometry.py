@@ -1,0 +1,103 @@
+"""Tile-grid geometry for the rendered complex-plane domain.
+
+The rendered domain is the fixed square ``[-2, 2] x [-2, 2]`` of the complex
+plane.  A *level* ``l`` tiles that square into an ``l x l`` grid of *chunks*;
+each chunk is a fixed ``4096 x 4096`` pixel tile, one byte per pixel, so the
+full image at level ``l`` is ``4096*l`` pixels on a side.
+
+These invariants mirror the reference system so output stays bit-identical
+(reference: ``DistributedMandelbrot/DataChunk.cs:14-27,32-33,59-72`` and
+``DistributedMandelbrotWorkerCUDA/DistributedMandelbrotWorkerCUDA.py:7-8,19-37,75-78``):
+
+- chunk side length in plane units: ``(MAX_AXIS - MIN_AXIS) / level = 4 / level``
+- chunk origin: ``MIN_AXIS + chunk_range * index``
+- pixel grids use **inclusive endpoints** (``np.linspace(start, start + range,
+  num=4096)``), so the pixel pitch is ``range / 4095`` and adjacent chunks
+  share their boundary row/column
+- the flat pixel array is real-fastest: real values tiled, imaginary values
+  repeated, i.e. row index = imaginary index, column index = real index.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+# Bounds of the rendered square of the complex plane.
+MIN_AXIS: float = -2.0
+MAX_AXIS: float = 2.0
+
+# Fixed chunk tile: CHUNK_WIDTH x CHUNK_WIDTH pixels, one byte per pixel.
+CHUNK_WIDTH: int = 4096
+CHUNK_PIXELS: int = CHUNK_WIDTH * CHUNK_WIDTH  # 16,777,216
+
+
+def level_chunk_range(level: int) -> float:
+    """Side length of one chunk in complex-plane units at ``level``."""
+    if level < 1:
+        raise ValueError(f"level must be >= 1, got {level}")
+    return (MAX_AXIS - MIN_AXIS) / level
+
+
+def chunk_origin(level: int, index_real: int, index_imag: int) -> tuple[float, float]:
+    """Complex-plane coordinates of the chunk's low corner (start values)."""
+    validate_indices(level, index_real, index_imag)
+    r = level_chunk_range(level)
+    return (MIN_AXIS + r * index_real, MIN_AXIS + r * index_imag)
+
+
+def validate_indices(level: int, index_real: int, index_imag: int) -> None:
+    """Chunk indices live in ``[0, level)`` on each axis."""
+    if level < 1:
+        raise ValueError(f"level must be >= 1, got {level}")
+    if not (0 <= index_real < level):
+        raise ValueError(f"index_real {index_real} out of range for level {level}")
+    if not (0 <= index_imag < level):
+        raise ValueError(f"index_imag {index_imag} out of range for level {level}")
+
+
+@dataclass(frozen=True)
+class TileSpec:
+    """Geometry of one tile to compute: where it sits and how finely sampled.
+
+    Decoupled from the fixed chunk grid so the same kernels serve arbitrary
+    window renders (benchmarks, deep zooms) as well as canonical chunks.
+    """
+
+    start_real: float
+    start_imag: float
+    range_real: float
+    range_imag: float
+    width: int = CHUNK_WIDTH
+    height: int = CHUNK_WIDTH
+
+    @staticmethod
+    def for_chunk(level: int, index_real: int, index_imag: int,
+                  definition: int = CHUNK_WIDTH) -> "TileSpec":
+        start_r, start_i = chunk_origin(level, index_real, index_imag)
+        rng = level_chunk_range(level)
+        return TileSpec(start_r, start_i, rng, rng, definition, definition)
+
+    def axes(self) -> tuple[np.ndarray, np.ndarray]:
+        """Inclusive-endpoint sample axes (real, imag) as float64 numpy arrays.
+
+        Computed with ``np.linspace`` so endpoint arithmetic is bit-identical
+        to the reference worker's grid generation.
+        """
+        re = np.linspace(self.start_real, self.start_real + self.range_real,
+                         num=self.width)
+        im = np.linspace(self.start_imag, self.start_imag + self.range_imag,
+                         num=self.height)
+        return re, im
+
+    def grid_flat(self) -> tuple[np.ndarray, np.ndarray]:
+        """Flat (real, imag) coordinate arrays, real-fastest ordering."""
+        re, im = self.axes()
+        return np.tile(re, self.height), np.repeat(im, self.width)
+
+    def grid_2d(self) -> tuple[np.ndarray, np.ndarray]:
+        """2-D (height, width) coordinate arrays; row = imag, col = real."""
+        re, im = self.axes()
+        return np.broadcast_to(re, (self.height, self.width)).copy(), \
+            np.broadcast_to(im[:, None], (self.height, self.width)).copy()

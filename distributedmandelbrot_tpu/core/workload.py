@@ -1,0 +1,121 @@
+"""Workload identity and wire encoding.
+
+A workload (tile job) is the 4-tuple ``(level, max_iter, index_real,
+index_imag)``, all uint32 little-endian on the wire (reference:
+``DistributedMandelbrot/DistributerWorkload.cs:9-29,53-100``).
+
+``max_iter`` (the reference's ``maximumRecursionDepth``) is optional in
+memory: jobs reloaded from the on-disk index do not store it, so the
+reference treats a missing value as a wildcard in equality
+(``DistributerWorkload.cs:14-17,31-38``).  The reference breaks the
+hash/equality contract doing so (``GetHashCode`` is identity,
+``DistributerWorkload.cs:50-51``), making resume dedup best-effort; here
+completion is instead keyed on :meth:`Workload.key` — ``(level, i, j)``
+only — which is the fix the survey prescribes.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional
+
+_WIRE = struct.Struct("<IIII")
+
+WORKLOAD_WIRE_SIZE: int = _WIRE.size  # 16 bytes
+
+
+@dataclass(frozen=True)
+class Workload:
+    """One tile job: which chunk to compute and to what iteration depth."""
+
+    level: int
+    max_iter: Optional[int]
+    index_real: int
+    index_imag: int
+
+    def __post_init__(self) -> None:
+        for name in ("level", "index_real", "index_imag"):
+            v = getattr(self, name)
+            if not (0 <= v <= 0xFFFFFFFF):
+                raise ValueError(f"{name}={v} out of uint32 range")
+        if self.max_iter is not None and not (0 <= self.max_iter <= 0xFFFFFFFF):
+            raise ValueError(f"max_iter={self.max_iter} out of uint32 range")
+
+    @property
+    def key(self) -> tuple[int, int, int]:
+        """Completion identity: ``(level, index_real, index_imag)``.
+
+        ``max_iter`` is deliberately excluded — the on-disk index does not
+        record it, so resume matching must not depend on it.
+        """
+        return (self.level, self.index_real, self.index_imag)
+
+    def matches(self, other: "Workload") -> bool:
+        """Equality with ``max_iter=None`` acting as a wildcard on either side."""
+        if self.key != other.key:
+            return False
+        if self.max_iter is None or other.max_iter is None:
+            return True
+        return self.max_iter == other.max_iter
+
+    def to_wire(self) -> bytes:
+        """16-byte little-endian encoding ``(level, max_iter, i_real, i_imag)``."""
+        if self.max_iter is None:
+            raise ValueError("cannot wire-encode a workload with max_iter=None")
+        return _WIRE.pack(self.level, self.max_iter, self.index_real,
+                          self.index_imag)
+
+    @staticmethod
+    def from_wire(data: bytes) -> "Workload":
+        if len(data) != WORKLOAD_WIRE_SIZE:
+            raise ValueError(
+                f"workload wire data must be {WORKLOAD_WIRE_SIZE} bytes, "
+                f"got {len(data)}")
+        level, max_iter, index_real, index_imag = _WIRE.unpack(data)
+        return Workload(level, max_iter, index_real, index_imag)
+
+
+@dataclass(frozen=True)
+class LevelSetting:
+    """One entry of the coordinator's work definition: a level and its depth."""
+
+    level: int
+    max_iter: int
+
+    def __post_init__(self) -> None:
+        if self.level < 1:
+            raise ValueError(f"level must be >= 1, got {self.level}")
+        if self.max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
+
+    @property
+    def tile_count(self) -> int:
+        return self.level * self.level
+
+
+def parse_level_settings(spec: str) -> tuple[LevelSetting, ...]:
+    """Parse a ``level:max_iter[,level:max_iter...]`` spec string.
+
+    Same surface as the reference CLI's ``-l`` flag
+    (``DistributedMandelbrot/Program.cs:227-257``).
+    """
+    settings: list[LevelSetting] = []
+    seen: set[int] = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            level_s, mrd_s = part.split(":")
+            setting = LevelSetting(int(level_s), int(mrd_s))
+        except ValueError as e:
+            raise ValueError(f"bad level setting {part!r}: expected "
+                             f"'level:max_iter' with positive integers") from e
+        if setting.level in seen:
+            raise ValueError(f"level {setting.level} specified more than once")
+        seen.add(setting.level)
+        settings.append(setting)
+    if not settings:
+        raise ValueError("no level settings given")
+    return tuple(settings)

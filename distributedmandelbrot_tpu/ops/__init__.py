@@ -1,0 +1,10 @@
+"""Compute kernels: numpy golden reference, JAX/XLA escape time, Pallas."""
+
+from distributedmandelbrot_tpu.ops import reference
+from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
+                                                       compute_tile,
+                                                       escape_counts,
+                                                       scale_counts_to_uint8)
+
+__all__ = ["reference", "DEFAULT_SEGMENT", "compute_tile", "escape_counts",
+           "scale_counts_to_uint8"]

@@ -1,0 +1,175 @@
+"""JAX escape-time kernels, TPU-first.
+
+The reference's CUDA kernel (``DistributedMandelbrotWorkerCUDA.py:39-68``)
+returns per-pixel at the escape iteration.  SIMD/vector hardware has no
+per-element early return, so the TPU-native form is *masked iteration*:
+every pixel advances under a mask that freezes it once escaped (freezing
+also prevents inf/nan pollution from continued squaring).  Early exit is
+recovered at tile granularity with a segmented ``lax.while_loop`` — run
+``segment`` masked iterations at a time (an unrolled ``fori_loop`` body XLA
+fuses into one elementwise loop nest), then stop when the whole tile has
+escaped or the iteration budget is spent.  For typical views most of the
+tile escapes early, so segments capture most of the CUDA early-exit win
+without data-dependent control flow inside the hot loop.
+
+Two precision paths:
+
+- ``float64`` path — near-exact vs the numpy golden
+  (:mod:`distributedmandelbrot_tpu.ops.reference`).  *Near*, not bit-exact:
+  XLA's backends contract ``mul+add/sub`` chains into FMA/FMS (single
+  rounding), and the contraction survives ``optimization_barrier`` because
+  fusions recompute producers; no supported flag disables it
+  (``--xla_allow_excess_precision=false`` does not).  The effect is a
+  last-ulp trajectory difference that changes the escape count of O(1)
+  chaotic-boundary pixels per tile (measured ~0.02% at depth 1000).  The
+  framework's *bit-exact* parity anchors are therefore the host paths —
+  the numpy golden and the native C++ kernel built with
+  ``-ffp-contract=off`` — and the JAX paths are validated against them
+  statistically.
+- ``float32`` fast path — the TPU throughput path; boundary pixels may
+  land in adjacent iteration buckets, acceptable for rendering and
+  benchmarked separately.
+
+All functions are pure and jit-compiled with static ``max_iter`` and
+``segment`` (a handful of distinct depths per run, so recompiles are rare
+and each specialization unrolls its segment body).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributedmandelbrot_tpu.core.geometry import TileSpec
+from distributedmandelbrot_tpu.utils.precision import ensure_x64
+
+DEFAULT_SEGMENT = 32
+
+
+def escape_counts(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
+                  segment: int = DEFAULT_SEGMENT) -> jax.Array:
+    """Escape iteration (int32) per element; 0 if never escaped.
+
+    Semantics pinned to the golden reference: z starts at c, iterations
+    count 1..max_iter-1, bailout test |z|^2 >= 4 after the update.
+
+    Thin dispatch wrapper: float64 inputs enable x64 first — otherwise JAX
+    would silently truncate them to float32 and run the fast path while the
+    caller believes they got the f64 path.
+    """
+    dt = getattr(c_real, "dtype", None)
+    if dt is not None and np.dtype(dt) == np.float64:
+        ensure_x64()
+    return _escape_counts_jit(c_real, c_imag, max_iter=max_iter,
+                              segment=segment)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "segment"))
+def _escape_counts_jit(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
+                       segment: int = DEFAULT_SEGMENT) -> jax.Array:
+    dtype = jnp.result_type(c_real)
+    c_real = c_real.astype(dtype)
+    c_imag = c_imag.astype(dtype)
+    four = jnp.asarray(4.0, dtype)
+    two = jnp.asarray(2.0, dtype)
+
+    total_steps = max_iter - 1  # iterations 1 .. max_iter-1
+    if total_steps <= 0:
+        return jnp.zeros(c_real.shape, jnp.int32)
+    segment = max(1, min(segment, total_steps))
+
+    def one_step(state, it):
+        zr, zi, counts = state
+        active = counts == 0
+        new_zr = zr * zr - zi * zi + c_real
+        new_zi = two * zr * zi + c_imag
+        zr = jnp.where(active, new_zr, zr)
+        zi = jnp.where(active, new_zi, zi)
+        escaped = active & (zr * zr + zi * zi >= four)
+        counts = jnp.where(escaped, it, counts)
+        return (zr, zi, counts)
+
+    def segment_body(carry):
+        zr, zi, counts, it = carry
+        state = (zr, zi, counts)
+        # Unrolled fixed-trip segment; `it + k` stays a traced scalar.
+        for k in range(segment):
+            state = one_step(state, it + k)
+        zr, zi, counts = state
+        return (zr, zi, counts, it + segment)
+
+    def segment_cond(carry):
+        zr, zi, counts, it = carry
+        # Keep going while budget remains and any pixel is still active.
+        # Pixels that never escape stay active to the end, exactly like the
+        # reference's full-depth loop.
+        return (it <= total_steps) & jnp.any(counts == 0)
+
+    init = (c_real, c_imag, jnp.zeros(c_real.shape, jnp.int32),
+            jnp.asarray(1, jnp.int32))
+    zr, zi, counts, it = lax.while_loop(segment_cond, segment_body, init)
+    # The last segment may overrun past total_steps; cancel counts recorded
+    # beyond the budget (they belong to iterations the reference never runs).
+    counts = jnp.where(counts > total_steps, 0, counts)
+    return counts
+
+
+def scale_counts_to_uint8(counts: jax.Array, *, max_iter: int,
+                          clamp: bool = False) -> jax.Array:
+    """See :func:`_scale_counts_jit`; widens beyond int32 when needed."""
+    if max_iter - 1 > (1 << 23):  # counts*256 would overflow int32's 2^31
+        ensure_x64()
+    return _scale_counts_jit(counts, max_iter=max_iter, clamp=clamp)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "clamp"))
+def _scale_counts_jit(counts: jax.Array, *, max_iter: int,
+                      clamp: bool = False) -> jax.Array:
+    """uint8 pixel encoding of escape counts (device-side, exact).
+
+    Parity mode reproduces ``ceil(v*256/max_iter)`` with uint8 *wrap* at 256
+    (``DistributedMandelbrotWorkerCUDA.py:96-98``).  Computed as exact
+    integer ceil-division ``(v*256 + m - 1) // m`` instead of emulated
+    float64 on TPU: for ``v*256 <= 2^24`` and integer ratios bounded by 256,
+    the fractional gap above any integer is >= 2^-40 relative, far above
+    float64's 2^-52 ulp, so the float64 ``ceil`` the reference computes can
+    never disagree with true integer ceil — the paths are bit-identical.
+
+    For ``max_iter - 1 > 2^23`` the product ``counts*256`` would overflow
+    int32, so the wrapper enables x64 and the math widens to int64 (still
+    exact; the same gap argument holds through the uint32 wire range).
+    """
+    wide = jnp.int64 if max_iter - 1 > (1 << 23) else jnp.int32
+    vals = (counts.astype(wide) * 256 + (max_iter - 1)) // max_iter
+    if clamp:
+        vals = jnp.minimum(vals, 255)
+    return vals.astype(jnp.uint8)  # int->uint8 wraps mod 256 deterministically
+
+
+def compute_tile(spec: TileSpec, max_iter: int, *,
+                 dtype: np.dtype = np.float32,
+                 segment: int = DEFAULT_SEGMENT,
+                 clamp: bool = False,
+                 device: jax.Device | None = None) -> np.ndarray:
+    """Compute one tile end-to-end: grid -> device kernel -> uint8 pixels.
+
+    Returns the flat uint8 array in the canonical real-fastest order.  The
+    sample grid is always generated in float64 on the host (bit-identical to
+    the reference's ``np.linspace``) and cast to ``dtype`` for the kernel, so
+    the float64 path is the exact parity path.
+    """
+    if np.dtype(dtype) == np.float64:
+        ensure_x64()
+    c_real, c_imag = spec.grid_2d()
+    c_real = jnp.asarray(c_real, dtype=dtype)
+    c_imag = jnp.asarray(c_imag, dtype=dtype)
+    if device is not None:
+        c_real = jax.device_put(c_real, device)
+        c_imag = jax.device_put(c_imag, device)
+    counts = escape_counts(c_real, c_imag, max_iter=max_iter, segment=segment)
+    pixels = scale_counts_to_uint8(counts, max_iter=max_iter, clamp=clamp)
+    return np.asarray(pixels).ravel()

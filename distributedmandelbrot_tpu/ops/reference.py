@@ -1,0 +1,59 @@
+"""Pure-numpy golden reference for the escape-time computation.
+
+This is the semantic pin for every accelerated kernel in the framework.  It
+reproduces the reference worker's per-pixel loop
+(``DistributedMandelbrotWorkerCUDA.py:39-68``) exactly, element-wise over
+float64:
+
+- ``z`` starts at ``c`` (not 0)
+- iterations count from 1 to ``max_iter - 1`` inclusive
+- each iteration computes ``z <- z*z + c`` (square first, then add), then
+  tests ``|z|^2 >= 4`` and records the iteration number on escape
+- a pixel that never escapes yields 0.
+
+The vectorized form freezes escaped pixels (no further updates), which is
+IEEE-identical to the reference's per-pixel early return: active pixels see
+the same operations in the same order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def escape_counts(c_real: np.ndarray, c_imag: np.ndarray,
+                  max_iter: int) -> np.ndarray:
+    """Escape iteration (int32) per pixel; 0 if never escaped within max_iter."""
+    c_real = np.asarray(c_real, dtype=np.float64)
+    c_imag = np.asarray(c_imag, dtype=np.float64)
+    zr = c_real.copy()
+    zi = c_imag.copy()
+    counts = np.zeros(c_real.shape, dtype=np.int32)
+    active = np.ones(c_real.shape, dtype=bool)
+    for it in range(1, max_iter):
+        new_zr = zr * zr - zi * zi + c_real
+        new_zi = 2.0 * zr * zi + c_imag
+        zr = np.where(active, new_zr, zr)
+        zi = np.where(active, new_zi, zi)
+        escaped = active & (zr * zr + zi * zi >= 4.0)
+        counts = np.where(escaped, np.int32(it), counts)
+        active &= ~escaped
+        if not active.any():
+            break
+    return counts
+
+
+def scale_counts_to_uint8(counts: np.ndarray, max_iter: int,
+                          clamp: bool = False) -> np.ndarray:
+    """Scale escape counts to the uint8 pixel encoding.
+
+    Parity mode (``clamp=False``) reproduces the reference exactly
+    (``DistributedMandelbrotWorkerCUDA.py:96-98``): ``ceil(v * 256 /
+    max_iter)`` cast to uint8, which *wraps* 256 -> 0 for ``max_iter > 256``
+    (a pixel escaping near the iteration ceiling reads as in-set).  Quality
+    mode (``clamp=True``) clamps to 255 instead.
+    """
+    scaled = np.ceil((counts.astype(np.float64) * 256.0) / max_iter)
+    if clamp:
+        scaled = np.minimum(scaled, 255.0)
+    return scaled.astype(np.uint8)

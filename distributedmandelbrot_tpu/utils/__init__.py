@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: precision, logging, metrics."""
+
+from distributedmandelbrot_tpu.utils.precision import ensure_x64, x64_enabled
+
+__all__ = ["ensure_x64", "x64_enabled"]

@@ -1,0 +1,21 @@
+"""Precision helpers.
+
+JAX disables 64-bit types by default; the float64 parity/deep-zoom paths
+need them.  ``jax.config.update`` is the only mechanism that reliably works
+across JAX builds (the ``JAX_ENABLE_X64`` env var is not honored by all),
+so callers that are about to run an f64 kernel call :func:`ensure_x64`.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def ensure_x64() -> None:
+    """Enable 64-bit types globally (idempotent)."""
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)
+
+
+def x64_enabled() -> bool:
+    return bool(jax.config.jax_enable_x64)

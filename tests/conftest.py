@@ -1,0 +1,34 @@
+"""Test configuration: force the JAX CPU backend with 8 virtual devices.
+
+Multi-chip hardware is not available in CI; sharding tests run on a virtual
+8-device CPU mesh instead (the standard substitute — mirrors how every piece
+of the reference system is testable on loopback).  Must run before jax is
+used anywhere, hence environment mutation at conftest import time.
+
+Note: env vars alone are not enough on images where a sitecustomize
+registers a remote-TPU PJRT plugin at interpreter start; that plugin's
+backend init blocks on a network tunnel.  ``jax.config.update`` is applied
+*before* any backend is initialized, which reliably restricts platform
+selection, and the remote plugin's factory is dropped for good measure.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (env must be set first)
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+try:  # Drop any remotely-tunneled accelerator plugin registered at startup.
+    import jax._src.xla_bridge as _xb
+
+    for _plat in ("axon", "tpu"):
+        _xb._backend_factories.pop(_plat, None)
+except Exception:
+    pass

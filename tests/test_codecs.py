@@ -1,0 +1,115 @@
+import struct
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu import codecs
+from distributedmandelbrot_tpu.codecs import RAW, RLE
+from distributedmandelbrot_tpu.core import CHUNK_PIXELS, Chunk
+
+
+def reference_rle_decode(body: bytes) -> bytes:
+    """Independent decoder following the viewer's record format
+    (DistributedMandelbrotViewer.py:35-50): uint32 LE count + uint8 value."""
+    out = bytearray()
+    i = 0
+    while i < len(body):
+        count, val = struct.unpack("<IB", body[i:i + 5])
+        out.extend([val] * count)
+        i += 5
+    return bytes(out)
+
+
+def test_raw_roundtrip():
+    data = np.random.default_rng(0).integers(0, 256, 1000, dtype=np.uint8)
+    body = RAW.encode(data)
+    assert body == data.tobytes()
+    np.testing.assert_array_equal(RAW.decode(body, 1000), data)
+
+
+def test_rle_roundtrip_and_format():
+    data = np.array([5, 5, 5, 0, 0, 7], dtype=np.uint8)
+    body = RLE.encode(data)
+    assert body == struct.pack("<IB", 3, 5) + struct.pack("<IB", 2, 0) + \
+        struct.pack("<IB", 1, 7)
+    np.testing.assert_array_equal(RLE.decode(body, 6), data)
+    assert reference_rle_decode(body) == data.tobytes()
+
+
+def test_rle_single_run():
+    data = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+    body = RLE.encode(data)
+    assert body == struct.pack("<IB", CHUNK_PIXELS, 0)
+    assert RLE.encoded_size(data) == 5
+
+
+def test_rle_decode_rejects_zero_run():
+    with pytest.raises(ValueError):
+        RLE.decode(struct.pack("<IB", 0, 1), 0)
+
+
+def test_rle_decode_rejects_wrong_total():
+    body = struct.pack("<IB", 3, 9)
+    with pytest.raises(ValueError):
+        RLE.decode(body, 4)
+    with pytest.raises(ValueError):
+        RLE.decode(body, 2)
+
+
+def test_pick_min_selects_rle_for_flat_data():
+    payload = codecs.serialize(np.zeros(CHUNK_PIXELS, dtype=np.uint8))
+    assert payload[0] == 0x01
+    assert len(payload) == 6  # code byte + one 5-byte record
+
+
+def test_pick_min_selects_raw_for_noise():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 4096, dtype=np.uint8)
+    payload = codecs.serialize(data)
+    assert payload[0] == 0x00
+    np.testing.assert_array_equal(codecs.deserialize(payload, 4096), data)
+
+
+def test_roundtrip_property():
+    rng = np.random.default_rng(2)
+    for _ in range(10):
+        # Run-heavy data to exercise RLE selection.
+        runs = rng.integers(1, 50, size=rng.integers(1, 100))
+        vals = rng.integers(0, 4, size=runs.size).astype(np.uint8)
+        data = np.repeat(vals, runs)
+        payload = codecs.serialize(data)
+        np.testing.assert_array_equal(codecs.deserialize(payload, data.size),
+                                      data)
+
+
+def test_chunk_classification():
+    assert Chunk.never(4, 0, 0).is_never
+    assert not Chunk.never(4, 0, 0).is_immediate
+    assert Chunk.immediate(4, 1, 2).is_immediate
+    data = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+    data[123] = 9
+    c = Chunk(4, 0, 0, data)
+    assert not c.is_never and not c.is_immediate
+
+
+def test_chunk_serialize_roundtrip():
+    data = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+    data[::7] = 3
+    c = Chunk(4, 2, 1, data)
+    np.testing.assert_array_equal(Chunk.deserialize_data(c.serialize()), data)
+
+
+def test_chunk_copies_caller_buffer():
+    """A frozen Chunk must not alias the caller's buffer — workers reuse
+    their pixel buffers between tiles."""
+    buf = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+    c = Chunk(4, 0, 0, buf)
+    buf[0] = 7
+    assert c.data[0] == 0 and c.is_never
+
+
+def test_chunk_validates_size_and_indices():
+    with pytest.raises(ValueError):
+        Chunk(4, 0, 0, np.zeros(10, dtype=np.uint8))
+    with pytest.raises(ValueError):
+        Chunk(4, 4, 0, np.zeros(CHUNK_PIXELS, dtype=np.uint8))

@@ -1,0 +1,72 @@
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core import (CHUNK_PIXELS, CHUNK_WIDTH,
+                                            MAX_AXIS, MIN_AXIS, TileSpec,
+                                            chunk_origin, level_chunk_range,
+                                            validate_indices)
+
+
+def test_domain_constants():
+    assert (MIN_AXIS, MAX_AXIS) == (-2.0, 2.0)
+    assert CHUNK_WIDTH == 4096
+    assert CHUNK_PIXELS == 4096 * 4096
+
+
+@pytest.mark.parametrize("level,expected", [(1, 4.0), (4, 1.0), (10, 0.4)])
+def test_level_chunk_range(level, expected):
+    assert level_chunk_range(level) == pytest.approx(expected)
+
+
+def test_chunk_origin_corners():
+    assert chunk_origin(4, 0, 0) == (-2.0, -2.0)
+    # Top corner chunk starts one chunk-range short of the max axis.
+    r, i = chunk_origin(4, 3, 3)
+    assert r == pytest.approx(1.0) and i == pytest.approx(1.0)
+
+
+def test_validate_indices_rejects_out_of_range():
+    with pytest.raises(ValueError):
+        validate_indices(4, 4, 0)
+    with pytest.raises(ValueError):
+        validate_indices(4, 0, -1)
+    with pytest.raises(ValueError):
+        validate_indices(0, 0, 0)
+
+
+def test_axes_match_reference_linspace():
+    """Grid must be bit-identical to the reference worker's np.linspace call
+    (inclusive endpoints, pitch = range/4095)."""
+    spec = TileSpec.for_chunk(10, 3, 7)
+    re, im = spec.axes()
+    start_r = MIN_AXIS + level_chunk_range(10) * 3
+    start_i = MIN_AXIS + level_chunk_range(10) * 7
+    np.testing.assert_array_equal(
+        re, np.linspace(start_r, start_r + 0.4, num=4096))
+    np.testing.assert_array_equal(
+        im, np.linspace(start_i, start_i + 0.4, num=4096))
+    assert re[0] == start_r and re[-1] == start_r + 0.4
+
+
+def test_adjacent_chunks_share_boundary_column():
+    left = TileSpec.for_chunk(10, 3, 0).axes()[0]
+    right = TileSpec.for_chunk(10, 4, 0).axes()[0]
+    assert left[-1] == pytest.approx(right[0])
+
+
+def test_grid_flat_is_real_fastest():
+    spec = TileSpec(0.0, 1.0, 1.0, 1.0, width=4, height=3)
+    re_flat, im_flat = spec.grid_flat()
+    assert re_flat.shape == (12,) and im_flat.shape == (12,)
+    # Real values cycle fastest; imag constant within a row.
+    np.testing.assert_array_equal(re_flat[:4], re_flat[4:8])
+    assert (im_flat[:4] == im_flat[0]).all()
+    assert im_flat[4] != im_flat[0]
+
+
+def test_grid_2d_matches_flat():
+    spec = TileSpec(-1.0, -1.0, 2.0, 2.0, width=8, height=8)
+    re2, im2 = spec.grid_2d()
+    re_flat, im_flat = spec.grid_flat()
+    np.testing.assert_array_equal(re2.ravel(), re_flat)
+    np.testing.assert_array_equal(im2.ravel(), im_flat)

@@ -1,0 +1,113 @@
+"""Kernel parity tests: JAX escape-time vs the numpy golden reference."""
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core import TileSpec
+from distributedmandelbrot_tpu.ops import (compute_tile, escape_counts,
+                                           scale_counts_to_uint8)
+from distributedmandelbrot_tpu.ops import reference as ref
+
+
+def grids(spec):
+    return spec.grid_2d()
+
+
+# Small but representative views: full set, boundary detail, all-escape, all-in.
+VIEWS = [
+    TileSpec(-2.0, -2.0, 4.0, 4.0, width=64, height=64),          # level-1 chunk
+    TileSpec(-0.8, 0.1, 0.2, 0.2, width=64, height=64),           # boundary
+    TileSpec(1.5, 1.5, 0.5, 0.5, width=32, height=32),            # all escape fast
+    TileSpec(-0.2, -0.1, 0.2, 0.2, width=32, height=32),          # interior (in-set)
+]
+
+
+@pytest.mark.parametrize("spec", VIEWS)
+@pytest.mark.parametrize("max_iter", [2, 17, 256, 1000])
+def test_f64_counts_near_identical_to_golden(spec, max_iter):
+    """f64 JAX vs golden: XLA FMA contraction can shift O(1) chaotic-boundary
+    pixels per tile (see ops/escape_time.py docstring); everything else must
+    be bit-identical.  Bit-exact parity is anchored by the host paths."""
+    cr, ci = grids(spec)
+    golden = ref.escape_counts(cr, ci, max_iter)
+    got = np.asarray(escape_counts(cr, ci, max_iter=max_iter))
+    mismatched = got != golden
+    assert mismatched.mean() <= 5e-4, (
+        f"f64 path diverges on {mismatched.mean():.2%} of pixels")
+    if mismatched.any():
+        # Divergence is only credible deep in the iteration tail (chaotic
+        # boundary); early escapes must agree exactly.
+        assert golden[mismatched].min() >= 50
+
+
+@pytest.mark.parametrize("segment", [1, 7, 32, 1024])
+def test_segment_size_does_not_change_result(segment):
+    """Early-exit segmentation is a pure scheduling choice — results must be
+    bit-identical across segment sizes."""
+    spec = VIEWS[1]
+    cr, ci = grids(spec)
+    base = np.asarray(escape_counts(cr, ci, max_iter=300, segment=300))
+    got = np.asarray(escape_counts(cr, ci, max_iter=300, segment=segment))
+    np.testing.assert_array_equal(got, base)
+
+
+def test_max_iter_one_yields_all_zero():
+    cr, ci = grids(VIEWS[0])
+    got = np.asarray(escape_counts(cr, ci, max_iter=1))
+    assert (got == 0).all()
+
+
+def test_counts_range():
+    cr, ci = grids(VIEWS[1])
+    got = np.asarray(escape_counts(cr, ci, max_iter=100))
+    # Max representable escape iteration is max_iter - 1 (loop range(1, mrd)).
+    assert got.max() <= 99 and got.min() >= 0
+
+
+@pytest.mark.parametrize("max_iter", [256, 1000, 50000])
+def test_uint8_scaling_parity_including_wrap(max_iter):
+    counts = np.arange(0, max_iter, max(1, max_iter // 3000), dtype=np.int32)
+    golden = ref.scale_counts_to_uint8(counts, max_iter)
+    got = np.asarray(scale_counts_to_uint8(counts, max_iter=max_iter))
+    np.testing.assert_array_equal(got, golden)
+    if max_iter > 256:
+        # The reference wrap: a pixel escaping near the ceiling reads 0.
+        near_ceiling = np.array([max_iter - 1], dtype=np.int32)
+        assert ref.scale_counts_to_uint8(near_ceiling, max_iter)[0] == 0
+        assert np.asarray(
+            scale_counts_to_uint8(near_ceiling, max_iter=max_iter))[0] == 0
+
+
+def test_uint8_scaling_huge_max_iter_widens_beyond_int32():
+    """counts*256 overflows int32 for max_iter > 2^23; the kernel must widen
+    and still match the float64 golden path."""
+    max_iter = 10_000_000
+    counts = np.array([0, 1, 9_000_000, max_iter - 1], dtype=np.int32)
+    golden = ref.scale_counts_to_uint8(counts, max_iter)
+    got = np.asarray(scale_counts_to_uint8(counts, max_iter=max_iter))
+    np.testing.assert_array_equal(got, golden)
+
+
+def test_uint8_scaling_clamp_mode():
+    counts = np.array([999], dtype=np.int32)
+    assert np.asarray(
+        scale_counts_to_uint8(counts, max_iter=1000, clamp=True))[0] == 255
+
+
+def test_compute_tile_f64_matches_golden_end_to_end():
+    spec = TileSpec.for_chunk(4, 1, 2, definition=64)
+    cr, ci = grids(spec)
+    golden = ref.scale_counts_to_uint8(ref.escape_counts(cr, ci, 256), 256)
+    got = compute_tile(spec, 256, dtype=np.float64)
+    mismatch = (got != golden.ravel()).mean()
+    assert mismatch <= 5e-4, f"{mismatch:.2%} of pixels diverge"
+
+
+def test_compute_tile_f32_close_to_golden():
+    """The fast path may differ only at boundary pixels (last-ulp effects)."""
+    spec = TileSpec(-0.8, 0.1, 0.2, 0.2, width=128, height=128)
+    cr, ci = grids(spec)
+    golden = ref.scale_counts_to_uint8(ref.escape_counts(cr, ci, 256), 256)
+    got = compute_tile(spec, 256, dtype=np.float32)
+    mismatch = (got != golden.ravel()).mean()
+    assert mismatch < 0.02, f"f32 path diverges on {mismatch:.1%} of pixels"

@@ -1,0 +1,60 @@
+import struct
+
+import pytest
+
+from distributedmandelbrot_tpu.core import (WORKLOAD_WIRE_SIZE, LevelSetting,
+                                            Workload, parse_level_settings)
+
+
+def test_wire_roundtrip_little_endian():
+    w = Workload(10, 1024, 3, 7)
+    wire = w.to_wire()
+    assert len(wire) == WORKLOAD_WIRE_SIZE == 16
+    assert wire == struct.pack("<IIII", 10, 1024, 3, 7)
+    assert Workload.from_wire(wire) == w
+
+
+def test_wire_rejects_bad_length():
+    with pytest.raises(ValueError):
+        Workload.from_wire(b"\x00" * 15)
+
+
+def test_wire_encode_requires_max_iter():
+    with pytest.raises(ValueError):
+        Workload(10, None, 3, 7).to_wire()
+
+
+def test_none_max_iter_is_wildcard_in_matches():
+    generated = Workload(10, 1024, 3, 7)
+    from_disk = Workload(10, None, 3, 7)
+    assert from_disk.matches(generated)
+    assert generated.matches(from_disk)
+    assert not Workload(10, 512, 3, 7).matches(generated)
+    assert not Workload(10, None, 3, 6).matches(generated)
+
+
+def test_key_excludes_max_iter():
+    """Completion dedup must work across disk-reloaded (max_iter=None) jobs —
+    the reference's broken hash contract (DistributerWorkload.cs:50-51) made
+    this best-effort; keying on (level, i, j) fixes it."""
+    assert Workload(10, 1024, 3, 7).key == Workload(10, None, 3, 7).key
+
+
+def test_uint32_range_enforced():
+    with pytest.raises(ValueError):
+        Workload(2**32, 1, 0, 0)
+    with pytest.raises(ValueError):
+        Workload(1, -2, 0, 0)
+
+
+def test_parse_level_settings_canonical():
+    settings = parse_level_settings("4:256,10:1024,20:1024")
+    assert settings == (LevelSetting(4, 256), LevelSetting(10, 1024),
+                        LevelSetting(20, 1024))
+    assert sum(s.tile_count for s in settings) == 16 + 100 + 400
+
+
+@pytest.mark.parametrize("bad", ["", "4", "4:", ":256", "4:256,4:512", "a:b"])
+def test_parse_level_settings_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_level_settings(bad)
