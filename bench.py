@@ -1,0 +1,93 @@
+"""Benchmark harness: prints ONE JSON line with the headline metric.
+
+Headline: escape-time throughput in Mpixels/s at max_iter=1000 on the
+seahorse-valley zoom (BASELINE.md config 2 view), computed through the
+production sharded path (device-side grids, batched tiles over the local
+mesh).  ``vs_baseline`` is measured against the driver's north star of
+500 Mpix/s (BASELINE.json) — set for a TPU v2-8; single-chip runs are
+reported as-is.
+
+Usage: python bench.py [--tile 1024] [--tiles N] [--max-iter 1000]
+                       [--dtype f32] [--repeats 3] [--all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR_MPIX_S = 500.0
+
+# Seahorse valley: boundary-dense, iteration-heavy — a conservative view
+# (full-view tiles with fast escapes bench much higher).
+SEAHORSE = (-0.748, 0.09)
+
+
+def _mesh_and_kernel():
+    import jax
+
+    from distributedmandelbrot_tpu.parallel import (batched_escape_pixels,
+                                                    tile_mesh)
+    mesh = tile_mesh()
+    return jax, mesh, batched_escape_pixels
+
+
+def bench_throughput(tile: int, tiles: int, max_iter: int, dtype: str,
+                     repeats: int, segment: int = 256) -> dict:
+    jax, mesh, batched_escape_pixels = _mesh_and_kernel()
+    np_dtype = {"f32": np.float32, "f64": np.float64}[dtype]
+    n_dev = mesh.devices.size
+    # One batch = `tiles` sub-tiles of the seahorse window, tiled spatially.
+    span = 0.005
+    params = np.empty((tiles, 3))
+    for i in range(tiles):
+        params[i] = (SEAHORSE[0] + (i % 4) * span,
+                     SEAHORSE[1] + (i // 4) * span,
+                     span / (tile - 1))
+    mrds = np.full(tiles, max_iter, dtype=np.int64)
+
+    def run():
+        return batched_escape_pixels(mesh, params, mrds, definition=tile,
+                                     dtype=np_dtype, segment=segment)
+
+    run()  # warmup/compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = run()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    pixels = tiles * tile * tile
+    mpix_s = pixels / best / 1e6
+    return {
+        "metric": f"Mpixels/s @ max_iter={max_iter} "
+                  f"({tiles}x{tile}^2 {dtype}, seahorse valley, "
+                  f"{n_dev} {jax.devices()[0].platform} device(s))",
+        "value": round(mpix_s, 2),
+        "unit": "Mpix/s",
+        "vs_baseline": round(mpix_s / NORTH_STAR_MPIX_S, 4),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tile", type=int, default=1024)
+    parser.add_argument("--tiles", type=int, default=8)
+    parser.add_argument("--max-iter", type=int, default=1000)
+    parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
+    parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--segment", type=int, default=256)
+    args = parser.parse_args()
+
+    result = bench_throughput(args.tile, args.tiles, args.max_iter,
+                              args.dtype, args.repeats, args.segment)
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,252 @@
+"""Command-line entry points: coordinator, worker, viewer.
+
+Covers the reference's configuration surface (``Program.cs:182-409``: level
+spec, data directory, bind address/ports, per-channel log enables, socket
+timeout toggle; worker/viewer connection prompts
+``DistributedMandelbrotWorkerCUDA.py:178-184``,
+``DistributedMandelbrotViewer.py:145-166``) with a standard argparse CLI:
+
+    python -m distributedmandelbrot_tpu coordinator -l 4:256,10:1024
+    python -m distributedmandelbrot_tpu worker --backend jax --batch-size 8
+    python -m distributedmandelbrot_tpu viewer 4 1 2 --out tile.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distributedmandelbrot_tpu.core.workload import parse_level_settings
+from distributedmandelbrot_tpu.net import protocol as proto
+
+logger = logging.getLogger("dmtpu.cli")
+
+
+def _configure_logging(args: argparse.Namespace) -> None:
+    level = logging.ERROR if args.quiet else (
+        logging.DEBUG if args.verbose else logging.INFO)
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    if getattr(args, "no_info_log", False):
+        logging.getLogger("dmtpu").setLevel(logging.ERROR)
+
+
+def _add_common(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="debug logging")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="errors only")
+
+
+def cmd_coordinator(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu coordinator",
+        description="Run the tile coordinator (Distributer + DataServer).")
+    parser.add_argument("-l", "--levels", required=True,
+                        help="level:max_iter[,level:max_iter...] "
+                             "(e.g. 4:256,10:1024,20:1024)")
+    parser.add_argument("-o", "--data-dir", default="",
+                        help="parent directory for Data/ (default: cwd)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--distributer-port", type=int,
+                        default=proto.DEFAULT_DISTRIBUTER_PORT)
+    parser.add_argument("--dataserver-port", type=int,
+                        default=proto.DEFAULT_DATASERVER_PORT)
+    parser.add_argument("--lease-timeout", type=float,
+                        default=proto.DEFAULT_LEASE_TIMEOUT,
+                        help="seconds a worker has to return a tile")
+    parser.add_argument("--sweep-period", type=float,
+                        default=proto.DEFAULT_SWEEP_PERIOD,
+                        help="seconds between expired-lease sweeps")
+    parser.add_argument("--fsync-index", action="store_true",
+                        help="fsync the tile index on every append")
+    parser.add_argument("--no-info-log", action="store_true")
+    _add_common(parser)
+    args = parser.parse_args(argv)
+    _configure_logging(args)
+
+    from distributedmandelbrot_tpu.coordinator import Coordinator
+
+    settings = parse_level_settings(args.levels)
+    coordinator = Coordinator(
+        settings, data_dir_parent=args.data_dir, host=args.host,
+        distributer_port=args.distributer_port,
+        dataserver_port=args.dataserver_port,
+        lease_timeout=args.lease_timeout, sweep_period=args.sweep_period,
+        fsync_index=args.fsync_index)
+    total = coordinator.scheduler.total_tiles
+    done = coordinator.scheduler.completed_count
+    print(f"coordinator: {len(settings)} level(s), {total} tiles "
+          f"({done} already complete on disk)", flush=True)
+    try:
+        asyncio.run(coordinator.run_forever())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _make_backend(name: str, dtype: str):
+    np_dtype = {"f32": np.float32, "f64": np.float64}[dtype]
+    if name == "numpy":
+        from distributedmandelbrot_tpu.worker import NumpyBackend
+        return NumpyBackend()
+    if name == "jax":
+        from distributedmandelbrot_tpu.worker import JaxBackend
+        return JaxBackend(dtype=np_dtype)
+    if name == "mesh":
+        from distributedmandelbrot_tpu.parallel import MeshBackend
+        return MeshBackend(dtype=np_dtype)
+    raise ValueError(f"unknown backend {name!r}")
+
+
+def cmd_worker(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu worker",
+        description="Run a stateless pull-loop compute worker.")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int,
+                        default=proto.DEFAULT_DISTRIBUTER_PORT)
+    parser.add_argument("--backend", choices=["jax", "numpy", "mesh"],
+                        default="jax")
+    parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
+    parser.add_argument("--batch-size", type=int, default=0,
+                        help="tiles leased per exchange "
+                             "(default: device count for mesh, else 1)")
+    parser.add_argument("--poll", type=float, default=0.0,
+                        help="keep polling every N seconds after the "
+                             "coordinator drains (default: exit)")
+    _add_common(parser)
+    args = parser.parse_args(argv)
+    _configure_logging(args)
+
+    from distributedmandelbrot_tpu.worker import DistributerClient, Worker
+
+    backend = _make_backend(args.backend, args.dtype)
+    batch_size = args.batch_size
+    if batch_size <= 0:
+        if args.backend == "mesh":
+            import jax
+            batch_size = jax.local_device_count()
+        else:
+            batch_size = 1
+    worker = Worker(DistributerClient(args.host, args.port), backend,
+                    batch_size=batch_size)
+    try:
+        if args.poll > 0:
+            worker.run_forever(poll_interval=args.poll)
+        else:
+            rounds = worker.run_until_drained()
+            stats = worker.counters.snapshot()
+            print(f"worker: drained after {rounds} round(s); "
+                  f"{stats.get('tiles_computed', 0)} tiles computed, "
+                  f"{stats.get('results_accepted', 0)} accepted", flush=True)
+    except KeyboardInterrupt:
+        pass
+    except OSError as e:
+        print(f"error: cannot reach coordinator at {args.host}:{args.port} "
+              f"({e})", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_viewer(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu viewer",
+        description="Fetch and render finished tiles.")
+    parser.add_argument("level", type=int)
+    parser.add_argument("index_real", type=int, nargs="?", default=None)
+    parser.add_argument("index_imag", type=int, nargs="?", default=None)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int,
+                        default=proto.DEFAULT_DATASERVER_PORT)
+    parser.add_argument("--stitch", action="store_true",
+                        help="fetch ALL chunks of the level into one image")
+    parser.add_argument("--out", default=None,
+                        help="write a PNG instead of opening a window")
+    parser.add_argument("--colormap", default="jet")
+    _add_common(parser)
+    args = parser.parse_args(argv)
+    _configure_logging(args)
+
+    from distributedmandelbrot_tpu.viewer import DataClient
+
+    client = DataClient(args.host, args.port)
+    try:
+        return _viewer_fetch_and_render(parser, args, client)
+    except OSError as e:
+        print(f"error: cannot reach data server at {args.host}:{args.port} "
+              f"({e})", file=sys.stderr)
+        return 1
+
+
+def _viewer_fetch_and_render(parser, args, client) -> int:
+    from distributedmandelbrot_tpu.viewer import (FetchStatus, stitch_level,
+                                                  value_to_rgba)
+
+    if args.stitch:
+        missing = []
+
+        def fetch(i: int, j: int) -> Optional[np.ndarray]:
+            pixels, status = client.fetch(args.level, i, j)
+            if status is not FetchStatus.OK:
+                missing.append((i, j))
+                return None
+            return pixels
+
+        values = stitch_level(fetch, args.level)
+        if missing:
+            print(f"warning: {len(missing)} chunk(s) unavailable, "
+                  f"rendered black: {missing[:8]}...", file=sys.stderr)
+    else:
+        if args.index_real is None or args.index_imag is None:
+            parser.error("index_real and index_imag required unless --stitch")
+        pixels, status = client.fetch(args.level, args.index_real,
+                                      args.index_imag)
+        if status is FetchStatus.NOT_AVAILABLE:
+            print("Chunk isn't available")
+            return 1
+        if status is FetchStatus.REJECTED:
+            print("Request was rejected (invalid indices)", file=sys.stderr)
+            return 2
+        values = pixels
+
+    rgba = value_to_rgba(values, colormap=args.colormap)
+    if args.out:
+        import matplotlib
+        matplotlib.use("Agg")
+        from matplotlib import pyplot as plt
+        plt.imsave(args.out, rgba)
+        print(f"wrote {args.out} ({rgba.shape[1]}x{rgba.shape[0]})")
+    else:  # pragma: no cover - needs a display
+        from distributedmandelbrot_tpu.viewer import show
+        show(rgba)
+    return 0
+
+
+COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
+            "viewer": cmd_viewer}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m distributedmandelbrot_tpu "
+              "{coordinator|worker|viewer} [options]\n"
+              "Run each subcommand with -h for its options.")
+        return 0 if argv else 2
+    cmd = argv[0]
+    if cmd not in COMMANDS:
+        print(f"unknown command {cmd!r}; expected one of "
+              f"{sorted(COMMANDS)}", file=sys.stderr)
+        return 2
+    return COMMANDS[cmd](argv[1:])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

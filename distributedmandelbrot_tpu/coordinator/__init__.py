@@ -1,0 +1,12 @@
+"""Coordinator: scheduling, leases, and both wire services in one process."""
+
+from distributedmandelbrot_tpu.coordinator.app import Coordinator
+from distributedmandelbrot_tpu.coordinator.clock import (Clock, ManualClock,
+                                                         MonotonicClock)
+from distributedmandelbrot_tpu.coordinator.dataserver import DataServer
+from distributedmandelbrot_tpu.coordinator.distributer import Distributer
+from distributedmandelbrot_tpu.coordinator.scheduler import (Lease,
+                                                             TileScheduler)
+
+__all__ = ["Coordinator", "Clock", "ManualClock", "MonotonicClock",
+           "DataServer", "Distributer", "Lease", "TileScheduler"]

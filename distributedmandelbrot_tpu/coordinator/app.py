@@ -1,0 +1,77 @@
+"""The coordinator process: one event loop hosting both services.
+
+Mirrors the reference's process topology — a single process running the
+Distributer and DataServer concurrently over shared storage
+(``Program.cs:127-150``) — as one asyncio loop instead of two blocking
+threads.  Resume happens here: completed tiles are seeded from the on-disk
+index before the distributer starts (``Distributer.cs:124,165-175``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Sequence
+
+from distributedmandelbrot_tpu.coordinator.clock import Clock
+from distributedmandelbrot_tpu.coordinator.dataserver import DataServer
+from distributedmandelbrot_tpu.coordinator.distributer import Distributer
+from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
+from distributedmandelbrot_tpu.core.workload import LevelSetting
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.storage.store import ChunkStore
+from distributedmandelbrot_tpu.utils.metrics import Counters
+
+logger = logging.getLogger("dmtpu.coordinator")
+
+
+class Coordinator:
+    def __init__(self, level_settings: Sequence[LevelSetting], *,
+                 data_dir_parent: str = "",
+                 host: str = "0.0.0.0",
+                 distributer_port: int = proto.DEFAULT_DISTRIBUTER_PORT,
+                 dataserver_port: int = proto.DEFAULT_DATASERVER_PORT,
+                 lease_timeout: float = proto.DEFAULT_LEASE_TIMEOUT,
+                 sweep_period: float = proto.DEFAULT_SWEEP_PERIOD,
+                 clock: Optional[Clock] = None,
+                 fsync_index: bool = False) -> None:
+        self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index)
+        completed = self.store.completed_keys(
+            levels=[s.level for s in level_settings])
+        if completed:
+            logger.info("resume: %d tiles already completed on disk",
+                        len(completed))
+        self.counters = Counters()
+        kwargs = {} if clock is None else {"clock": clock}
+        self.scheduler = TileScheduler(level_settings, completed=completed,
+                                       lease_timeout=lease_timeout, **kwargs)
+        self.distributer = Distributer(self.scheduler, self.store, host=host,
+                                       port=distributer_port,
+                                       sweep_period=sweep_period,
+                                       counters=self.counters)
+        self.dataserver = DataServer(self.store, host=host,
+                                     port=dataserver_port,
+                                     counters=self.counters)
+
+    async def start(self) -> None:
+        await self.distributer.start()
+        await self.dataserver.start()
+
+    async def stop(self) -> None:
+        await self.distributer.stop()
+        await self.dataserver.stop()
+
+    async def run_forever(self) -> None:
+        await self.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await self.stop()
+
+    @property
+    def distributer_port(self) -> int:
+        return self.distributer.port
+
+    @property
+    def dataserver_port(self) -> int:
+        return self.dataserver.port

@@ -1,0 +1,94 @@
+"""Asyncio chunk-serving server (the read-side coordinator service).
+
+Wire-compatible with the reference DataServer (``DataServer.cs:82-224``):
+12-byte query ``(level, index_real, index_imag)`` each uint32 LE, one status
+byte (accept / reject-invalid / not-yet-available), and on accept a
+uint32-length-prefixed codec payload.
+
+Improvements: queries on one connection can repeat until EOF; the store's
+payload LRU means a hot chunk is served without the decode + re-encode round
+trip the reference performs per request (``DataServer.cs:204-221``); index
+scanning runs in a thread pool off the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Optional
+
+from distributedmandelbrot_tpu.net import framing
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.storage.store import ChunkStore
+from distributedmandelbrot_tpu.utils.metrics import Counters
+
+logger = logging.getLogger("dmtpu.dataserver")
+
+_QUERY = struct.Struct("<III")
+
+
+class DataServer:
+    def __init__(self, store: ChunkStore, *, host: str = "0.0.0.0",
+                 port: int = proto.DEFAULT_DATASERVER_PORT,
+                 counters: Optional[Counters] = None) -> None:
+        self.store = store
+        self.host = host
+        self.port = port
+        self.counters = counters if counters is not None else Counters()
+        self._server: Optional[asyncio.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("dataserver listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    raw = await framing.read_exact(reader, _QUERY.size)
+                except ConnectionError:
+                    break  # clean EOF between queries
+                level, index_real, index_imag = _QUERY.unpack(raw)
+                await self._serve_query(writer, level, index_real, index_imag)
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            logger.exception("error serving %s", peer)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _serve_query(self, writer: asyncio.StreamWriter, level: int,
+                           index_real: int, index_imag: int) -> None:
+        if level < 1 or index_real >= level or index_imag >= level:
+            framing.write_byte(writer, proto.QUERY_REJECT)
+            self.counters.inc("queries_rejected")
+            logger.info("rejected invalid query (%d,%d,%d)",
+                        level, index_real, index_imag)
+            return
+        payload = await asyncio.to_thread(
+            self.store.load_payload, level, index_real, index_imag)
+        if payload is None:
+            framing.write_byte(writer, proto.QUERY_NOT_AVAILABLE)
+            self.counters.inc("queries_unavailable")
+            return
+        framing.write_byte(writer, proto.QUERY_ACCEPT)
+        framing.write_u32(writer, len(payload))
+        writer.write(payload)
+        self.counters.inc("queries_served")
+        logger.info("served chunk (%d,%d,%d): %d bytes",
+                    level, index_real, index_imag, len(payload))

@@ -1,0 +1,201 @@
+"""Asyncio job-distribution server (the write-side coordinator service).
+
+Wire-compatible with the reference Distributer (``Distributer.cs:207-458``)
+— same purpose/status codes, same 16-byte workload frames, same raw
+16 MiB result payload — plus the batched-dispatch extension
+(:mod:`distributedmandelbrot_tpu.net.protocol`).
+
+Differences by design:
+
+- single asyncio event loop instead of a blocking accept loop + threads;
+  chunk persistence runs in a thread pool so ingest never blocks the loop
+  (the reference saves on a fire-and-forget Task, ``Distributer.cs:436-442``)
+- every receive is exact-length (fixes the 16 MiB short-read bug,
+  ``Distributer.cs:415-416``)
+- a connection may carry any number of messages back-to-back (the
+  reference is connection-per-message; clients that close after one
+  message remain fully supported — EOF just ends the session)
+- the lease sweep is an asyncio task with the same default 5-minute period
+  (``Distributer.cs:24``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import numpy as np
+
+from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
+from distributedmandelbrot_tpu.core.chunk import Chunk
+from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+from distributedmandelbrot_tpu.core.workload import (WORKLOAD_WIRE_SIZE,
+                                                     Workload)
+from distributedmandelbrot_tpu.net import framing
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.storage.store import ChunkStore
+from distributedmandelbrot_tpu.utils.metrics import Counters
+
+logger = logging.getLogger("dmtpu.distributer")
+
+MAX_BATCH = 4096
+
+
+class Distributer:
+    def __init__(self, scheduler: TileScheduler, store: ChunkStore, *,
+                 host: str = "0.0.0.0",
+                 port: int = proto.DEFAULT_DISTRIBUTER_PORT,
+                 sweep_period: float = proto.DEFAULT_SWEEP_PERIOD,
+                 counters: Optional[Counters] = None) -> None:
+        self.scheduler = scheduler
+        self.store = store
+        self.host = host
+        self.port = port
+        self.sweep_period = sweep_period
+        self.counters = counters if counters is not None else Counters()
+        self._server: Optional[asyncio.Server] = None
+        self._sweep_task: Optional[asyncio.Task] = None
+        self._save_tasks: set[asyncio.Task] = set()
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._sweep_task = asyncio.create_task(self._sweep_loop())
+        logger.info("distributer listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._sweep_task is not None:
+            self._sweep_task.cancel()
+            try:
+                await self._sweep_task
+            except asyncio.CancelledError:
+                pass
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        if self._save_tasks:
+            await asyncio.gather(*self._save_tasks, return_exceptions=True)
+
+    async def _sweep_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.sweep_period)
+            swept = self.scheduler.sweep()
+            if swept:
+                logger.info("lease sweep requeued %d tiles", swept)
+
+    # -- connection handling ----------------------------------------------
+
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    purpose = await framing.read_byte(reader)
+                except ConnectionError:
+                    break  # clean EOF between messages
+                if purpose == proto.PURPOSE_REQUEST:
+                    await self._handle_request(writer)
+                elif purpose == proto.PURPOSE_RESPONSE:
+                    await self._handle_response(reader, writer)
+                elif purpose == proto.PURPOSE_BATCH_REQUEST:
+                    await self._handle_batch_request(reader, writer)
+                elif purpose == proto.PURPOSE_BATCH_RESPONSE:
+                    await self._handle_batch_response(reader, writer)
+                else:
+                    logger.error("unknown purpose byte %#x from %s",
+                                 purpose, peer)
+                    break
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass  # per-connection failures never take down the accept loop
+        except Exception:
+            logger.exception("error serving %s", peer)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _handle_request(self, writer: asyncio.StreamWriter) -> None:
+        w = self.scheduler.acquire()
+        if w is None:
+            framing.write_byte(writer, proto.WORKLOAD_NOT_AVAILABLE)
+            self.counters.inc("requests_denied")
+        else:
+            framing.write_byte(writer, proto.WORKLOAD_AVAILABLE)
+            writer.write(w.to_wire())
+            self.counters.inc("workloads_granted")
+            logger.info("granted %s", w)
+
+    async def _handle_batch_request(self, reader: asyncio.StreamReader,
+                                    writer: asyncio.StreamWriter) -> None:
+        count = await framing.read_u32(reader)
+        grants = self.scheduler.acquire_batch(min(count, MAX_BATCH))
+        if not grants:
+            framing.write_byte(writer, proto.WORKLOAD_NOT_AVAILABLE)
+            self.counters.inc("requests_denied")
+            return
+        framing.write_byte(writer, proto.WORKLOAD_AVAILABLE)
+        framing.write_u32(writer, len(grants))
+        for w in grants:
+            writer.write(w.to_wire())
+        self.counters.inc("workloads_granted", len(grants))
+        logger.info("granted batch of %d tiles", len(grants))
+
+    async def _handle_response(self, reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter) -> None:
+        await self._ingest_one(reader, writer)
+
+    async def _handle_batch_response(self, reader: asyncio.StreamReader,
+                                     writer: asyncio.StreamWriter) -> None:
+        # No cap here (unlike grants, which bound coordinator state): each
+        # submission is bounded sequential work, and truncating would
+        # desynchronize the stream mid-batch.  A lying count just ends in
+        # EOF, which the connection handler treats as a clean close.
+        count = await framing.read_u32(reader)
+        for _ in range(count):
+            await self._ingest_one(reader, writer)
+
+    async def _ingest_one(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        w = Workload.from_wire(
+            await framing.read_exact(reader, WORKLOAD_WIRE_SIZE))
+        if not self.scheduler.can_accept(w):
+            framing.write_byte(writer, proto.RESPONSE_REJECT)
+            await writer.drain()
+            self.counters.inc("results_rejected")
+            logger.info("rejected result for %s (stale or unknown lease)", w)
+            return
+        framing.write_byte(writer, proto.RESPONSE_ACCEPT)
+        await writer.drain()
+        data = await framing.read_exact(reader, CHUNK_PIXELS)
+        if not self.scheduler.complete(w):
+            # Lease expired between accept and payload arrival; drop.
+            self.counters.inc("results_rejected")
+            logger.info("dropped result for %s (lease expired mid-upload)", w)
+            return
+        self.counters.inc("results_accepted")
+        chunk = Chunk(w.level, w.index_real, w.index_imag,
+                      np.frombuffer(data, dtype=np.uint8))
+        task = asyncio.create_task(self._save_chunk(w, chunk))
+        self._save_tasks.add(task)
+        task.add_done_callback(self._save_tasks.discard)
+
+    async def _save_chunk(self, w: Workload, chunk: Chunk) -> None:
+        try:
+            await asyncio.to_thread(self.store.save, chunk)
+            self.counters.inc("chunks_saved")
+            logger.info("saved chunk %s", chunk.key)
+        except Exception:
+            # The result's bytes are lost; reopen the tile so it is granted
+            # again rather than leaving a silent hole in a "complete" run.
+            logger.exception("failed to save chunk %s; reopening tile",
+                             chunk.key)
+            self.counters.inc("save_errors")
+            self.scheduler.reopen(w)

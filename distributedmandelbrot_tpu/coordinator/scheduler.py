@@ -1,0 +1,183 @@
+"""Tile scheduler: frontier queue + lease table + completion dedup.
+
+Semantics preserved from the reference's Distributer (at-least-once with
+dedup at ingest):
+
+- a tile is handed out iff it is neither completed nor under an unexpired
+  lease (``Distributer.cs:317-330``)
+- grants are ordered level-setting by level-setting, ``index_real`` outer,
+  ``index_imag`` inner (``Distributer.cs:338-340``)
+- a result is accepted iff an unexpired matching lease exists; late
+  (expired-lease) and duplicate results are rejected
+  (``Distributer.cs:404,447-456``)
+- expired leases make the tile grantable again, both lazily and via a
+  periodic sweep (``DistributerWorkload.cs:116-120``, ``Distributer.cs:153-160``)
+- completion is keyed on ``(level, i, j)`` only, fixing the reference's
+  broken hash/equality contract so resume dedup is exact, not best-effort
+  (survey caveat on ``DistributerWorkload.cs:50-51``).
+
+Design difference (the TPU build's hot-path fix): the reference rescans the
+whole O(sum level^2) grid per request; this scheduler keeps an advancing
+cursor over the grid plus a retry queue fed by lease expiry, making grants
+O(1) amortized.  A batched acquire leases k tiles in one call — the server
+-side half of batched dispatch that keeps a device mesh fed.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Iterator, Optional, Sequence
+
+from distributedmandelbrot_tpu.coordinator.clock import Clock, MonotonicClock
+from distributedmandelbrot_tpu.core.workload import LevelSetting, Workload
+from distributedmandelbrot_tpu.net.protocol import DEFAULT_LEASE_TIMEOUT
+
+Key = tuple[int, int, int]
+
+
+@dataclass
+class Lease:
+    workload: Workload
+    expires_at: float
+
+    def expired(self, now: float) -> bool:
+        return now >= self.expires_at
+
+
+class TileScheduler:
+    """Pure scheduling logic — no I/O, no real time."""
+
+    def __init__(self, level_settings: Sequence[LevelSetting], *,
+                 completed: Optional[set[Key]] = None,
+                 lease_timeout: float = DEFAULT_LEASE_TIMEOUT,
+                 clock: Optional[Clock] = None) -> None:
+        if not level_settings:
+            raise ValueError("at least one level setting required")
+        seen_levels: set[int] = set()
+        for s in level_settings:
+            if s.level in seen_levels:
+                raise ValueError(f"duplicate level {s.level}")
+            seen_levels.add(s.level)
+        self.level_settings = tuple(level_settings)
+        self.lease_timeout = lease_timeout
+        self.clock = clock if clock is not None else MonotonicClock()
+        self._completed: set[Key] = set(completed or ())
+        self._leases: dict[Key, Lease] = {}
+        self._retry: deque[Workload] = deque()
+        self._cursor = self._grid_iter()
+        self._cursor_done = False
+
+    # -- state inspection -------------------------------------------------
+
+    @property
+    def total_tiles(self) -> int:
+        return sum(s.tile_count for s in self.level_settings)
+
+    @property
+    def completed_count(self) -> int:
+        return len(self._completed)
+
+    @property
+    def outstanding_leases(self) -> int:
+        now = self.clock.now()
+        return sum(1 for l in self._leases.values() if not l.expired(now))
+
+    def is_complete(self) -> bool:
+        """All tiles of all configured levels are done."""
+        return len(self._completed) >= self.total_tiles and \
+            self._all_grid_completed()
+
+    def _all_grid_completed(self) -> bool:
+        return all((s.level, i, j) in self._completed
+                   for s in self.level_settings
+                   for i in range(s.level) for j in range(s.level))
+
+    # -- grant path -------------------------------------------------------
+
+    def _grid_iter(self) -> Iterator[Workload]:
+        for s in self.level_settings:
+            for index_real in range(s.level):
+                for index_imag in range(s.level):
+                    yield Workload(s.level, s.max_iter, index_real, index_imag)
+
+    def _grantable(self, w: Workload, now: float) -> bool:
+        if w.key in self._completed:
+            return False
+        lease = self._leases.get(w.key)
+        return lease is None or lease.expired(now)
+
+    def _next_needed(self, now: float) -> Optional[Workload]:
+        while self._retry:
+            w = self._retry.popleft()
+            if self._grantable(w, now):
+                return w
+        if not self._cursor_done:
+            for w in self._cursor:
+                if self._grantable(w, now):
+                    return w
+            self._cursor_done = True
+        return None
+
+    def acquire(self) -> Optional[Workload]:
+        """Grant the next needed tile and lease it; None if none available.
+
+        None does not mean the run is finished — tiles under unexpired
+        leases may yet expire and become grantable (poll again later),
+        exactly as in the reference's pull loop.
+        """
+        now = self.clock.now()
+        w = self._next_needed(now)
+        if w is None:
+            return None
+        self._leases[w.key] = Lease(w, now + self.lease_timeout)
+        return w
+
+    def acquire_batch(self, max_count: int) -> list[Workload]:
+        """Lease up to ``max_count`` tiles in one call (batched dispatch)."""
+        out: list[Workload] = []
+        while len(out) < max_count:
+            w = self.acquire()
+            if w is None:
+                break
+            out.append(w)
+        return out
+
+    # -- ingest path ------------------------------------------------------
+
+    def can_accept(self, w: Workload) -> bool:
+        """A result is acceptable iff an unexpired matching lease exists."""
+        lease = self._leases.get(w.key)
+        return (lease is not None and not lease.expired(self.clock.now())
+                and lease.workload.matches(w))
+
+    def complete(self, w: Workload) -> bool:
+        """Record a completed tile; returns False for stale/unknown results."""
+        if not self.can_accept(w):
+            return False
+        del self._leases[w.key]
+        self._completed.add(w.key)
+        return True
+
+    def reopen(self, w: Workload) -> None:
+        """Un-complete a tile whose persistence failed so it is granted again.
+
+        Ingest marks a tile complete before its asynchronous save lands; if
+        the save errors, the result's bytes are gone and the tile must go
+        back in the frontier or the run would finish with a silent hole.
+        """
+        if w.key in self._completed:
+            self._completed.discard(w.key)
+            self._retry.append(w)
+
+    # -- maintenance ------------------------------------------------------
+
+    def sweep(self) -> int:
+        """Drop expired leases and requeue their tiles; returns count swept."""
+        now = self.clock.now()
+        expired = [k for k, l in self._leases.items() if l.expired(now)]
+        for key in expired:
+            lease = self._leases.pop(key)
+            if key not in self._completed:
+                self._retry.append(lease.workload)
+        return len(expired)

@@ -1,0 +1,13 @@
+"""Wire protocols and exact-length framing shared by all processes."""
+
+from distributedmandelbrot_tpu.net import protocol
+from distributedmandelbrot_tpu.net.framing import (ProtocolError, read_byte,
+                                                   read_exact, read_u32,
+                                                   recv_byte, recv_exact,
+                                                   recv_u32, send_all,
+                                                   send_byte, send_u32,
+                                                   write_byte, write_u32)
+
+__all__ = ["protocol", "ProtocolError", "recv_exact", "send_all", "recv_u32",
+           "send_u32", "recv_byte", "send_byte", "read_exact", "read_u32",
+           "read_byte", "write_u32", "write_byte"]

@@ -1,0 +1,81 @@
+"""Exact-length socket framing, sync and asyncio.
+
+The one correct receive pattern in the reference is the viewer's recv-exact
+loop (``DistributedMandelbrotViewer.py:19-33``); the coordinator's single
+16 MiB ``Receive`` call (``Distributer.cs:415-416``) silently truncates on
+TCP short reads.  Here *every* read is exact-length, on both sides.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+
+_U32 = struct.Struct("<I")
+
+
+class ProtocolError(Exception):
+    """Peer violated the wire protocol (bad code, short message, etc.)."""
+
+
+# -- synchronous (worker/viewer clients) ----------------------------------
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Receive exactly ``n`` bytes or raise ``ConnectionError`` on EOF."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError(
+                f"connection closed after {got} of {n} bytes")
+        got += r
+    return bytes(buf)
+
+
+def send_all(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(data)
+
+
+def recv_u32(sock: socket.socket) -> int:
+    return _U32.unpack(recv_exact(sock, 4))[0]
+
+
+def send_u32(sock: socket.socket, value: int) -> None:
+    sock.sendall(_U32.pack(value))
+
+
+def recv_byte(sock: socket.socket) -> int:
+    return recv_exact(sock, 1)[0]
+
+
+def send_byte(sock: socket.socket, value: int) -> None:
+    sock.sendall(bytes([value]))
+
+
+# -- asyncio (coordinator servers) ----------------------------------------
+
+async def read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
+    try:
+        return await reader.readexactly(n)
+    except asyncio.IncompleteReadError as e:
+        raise ConnectionError(
+            f"connection closed after {len(e.partial)} of {n} bytes") from None
+
+
+async def read_u32(reader: asyncio.StreamReader) -> int:
+    return _U32.unpack(await read_exact(reader, 4))[0]
+
+
+async def read_byte(reader: asyncio.StreamReader) -> int:
+    return (await read_exact(reader, 1))[0]
+
+
+def write_u32(writer: asyncio.StreamWriter, value: int) -> None:
+    writer.write(_U32.pack(value))
+
+
+def write_byte(writer: asyncio.StreamWriter, value: int) -> None:
+    writer.write(bytes([value]))

@@ -1,0 +1,56 @@
+"""Wire-protocol constants for both coordinator services.
+
+Byte-compatible with the reference's two TCP protocols
+(``Distributer.cs:30-45``, ``DataServer.cs:15-20``, defaults
+``Program.cs:13-14``), plus a *batched dispatch* extension — the one
+server-side addition the TPU build needs so a single worker process can
+lease enough tiles to keep a whole device mesh fed.
+
+Distributer protocol (default port 59010).  Connection purpose byte, then:
+
+- ``PURPOSE_REQUEST`` (0x00): server replies ``WORKLOAD_AVAILABLE`` + 16-byte
+  workload, or ``WORKLOAD_NOT_AVAILABLE``.
+- ``PURPOSE_RESPONSE`` (0x01): client sends 16-byte workload echo; server
+  replies ``RESPONSE_ACCEPT`` (then client streams the 16,777,216 raw pixel
+  bytes) or ``RESPONSE_REJECT``.
+- ``PURPOSE_BATCH_REQUEST`` (0x02, extension): client sends uint32 max
+  count; server replies ``WORKLOAD_AVAILABLE`` + uint32 n + n x 16-byte
+  workloads, or ``WORKLOAD_NOT_AVAILABLE`` if none.
+- ``PURPOSE_BATCH_RESPONSE`` (0x03, extension): client sends uint32 n, then
+  n submissions each shaped exactly like a single response (16-byte echo ->
+  accept/reject byte -> pixels if accepted).  Per-item dedup semantics are
+  identical to singles.
+
+DataServer protocol (default port 59011): client sends 3 x uint32 LE
+``(level, index_real, index_imag)``; server replies ``QUERY_ACCEPT`` +
+uint32 payload length + codec payload, ``QUERY_REJECT`` (invalid indices),
+or ``QUERY_NOT_AVAILABLE``.
+"""
+
+from __future__ import annotations
+
+# Distributer: connection purpose
+PURPOSE_REQUEST = 0x00
+PURPOSE_RESPONSE = 0x01
+PURPOSE_BATCH_REQUEST = 0x02  # extension
+PURPOSE_BATCH_RESPONSE = 0x03  # extension
+
+# Distributer: workload availability
+WORKLOAD_AVAILABLE = 0x10
+WORKLOAD_NOT_AVAILABLE = 0x11
+
+# Distributer: response acceptance
+RESPONSE_ACCEPT = 0x20
+RESPONSE_REJECT = 0x21
+
+# DataServer: query status
+QUERY_ACCEPT = 0x00
+QUERY_REJECT = 0x01
+QUERY_NOT_AVAILABLE = 0x02
+
+DEFAULT_DISTRIBUTER_PORT = 59010
+DEFAULT_DATASERVER_PORT = 59011
+
+# Scheduling defaults (reference: Distributer.cs:22,24 — 1 h lease, 5 min sweep)
+DEFAULT_LEASE_TIMEOUT = 3600.0
+DEFAULT_SWEEP_PERIOD = 300.0

@@ -1,0 +1,12 @@
+"""Device-mesh parallelism: tile batching, within-tile sharding, multi-host."""
+
+from distributedmandelbrot_tpu.parallel.backend import MeshBackend
+from distributedmandelbrot_tpu.parallel.mesh import (ROW_AXIS, TILE_AXIS,
+                                                     local_devices, tile_mesh,
+                                                     tile_row_mesh)
+from distributedmandelbrot_tpu.parallel.sharding import (
+    batched_escape_pixels, compute_tile_row_sharded)
+
+__all__ = ["MeshBackend", "ROW_AXIS", "TILE_AXIS", "local_devices",
+           "tile_mesh", "tile_row_mesh", "batched_escape_pixels",
+           "compute_tile_row_sharded"]

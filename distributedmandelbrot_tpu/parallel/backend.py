@@ -1,0 +1,48 @@
+"""Mesh compute backend: the worker backend that drives a whole device mesh.
+
+One fat worker leases a batch of tiles (batched dispatch) and computes them
+in a single sharded dispatch — the TPU-native replacement for the
+reference's N independent one-GPU worker processes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+from jax.sharding import Mesh
+
+from distributedmandelbrot_tpu.core.geometry import CHUNK_WIDTH, TileSpec
+from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.ops.escape_time import DEFAULT_SEGMENT
+from distributedmandelbrot_tpu.parallel.mesh import tile_mesh
+from distributedmandelbrot_tpu.parallel.sharding import batched_escape_pixels
+
+
+class MeshBackend:
+    """Computes tile batches sharded over a device mesh."""
+
+    def __init__(self, definition: int = CHUNK_WIDTH,
+                 dtype: np.dtype = np.float32,
+                 segment: int = DEFAULT_SEGMENT,
+                 mesh: Optional[Mesh] = None) -> None:
+        self.definition = definition
+        self.dtype = dtype
+        self.segment = segment
+        self.mesh = mesh if mesh is not None else tile_mesh()
+
+    def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
+        if not workloads:
+            return []
+        params = np.empty((len(workloads), 3), dtype=np.float64)
+        mrds = np.empty(len(workloads), dtype=np.int64)
+        for i, w in enumerate(workloads):
+            spec = TileSpec.for_chunk(w.level, w.index_real, w.index_imag,
+                                      definition=self.definition)
+            params[i] = (spec.start_real, spec.start_imag,
+                         spec.range_real / (self.definition - 1))
+            mrds[i] = w.max_iter
+        pixels = batched_escape_pixels(self.mesh, params, mrds,
+                                       definition=self.definition,
+                                       dtype=self.dtype, segment=self.segment)
+        return [pixels[i].ravel() for i in range(len(workloads))]

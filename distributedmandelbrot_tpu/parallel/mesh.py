@@ -1,0 +1,50 @@
+"""Device-mesh construction helpers.
+
+The reference scales by running N independent worker processes, one GPU each
+(survey §2 parallelism inventory).  The TPU-native shape is the inverse: one
+fat worker process drives all local devices through a
+:class:`jax.sharding.Mesh`, and scale-out across hosts extends the same mesh
+via ``jax.distributed`` (see :mod:`distributedmandelbrot_tpu.parallel.multihost`).
+
+Two mesh shapes cover the framework's parallelism:
+
+- 1-D ``(tiles,)`` — data-parallel over a batch of tiles (the throughput
+  shape; one tile per device per step)
+- 2-D ``(tiles, rows)`` — batch sharding combined with within-tile row
+  sharding (the latency shape for single huge tiles / deep zooms).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+TILE_AXIS = "tiles"
+ROW_AXIS = "rows"
+
+
+def local_devices() -> list[jax.Device]:
+    return jax.local_devices()
+
+
+def tile_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over local devices for tile-batch data parallelism."""
+    devices = local_devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (TILE_AXIS,))
+
+
+def tile_row_mesh(tiles: int, rows: int,
+                  devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D mesh: ``tiles x rows`` devices; rows shard within each tile."""
+    devs = list(devices) if devices is not None else local_devices()
+    if tiles * rows > len(devs):
+        raise ValueError(
+            f"mesh {tiles}x{rows} needs {tiles * rows} devices, "
+            f"have {len(devs)}")
+    grid = np.array(devs[:tiles * rows]).reshape(tiles, rows)
+    return Mesh(grid, (TILE_AXIS, ROW_AXIS))

@@ -1,0 +1,225 @@
+"""Sharded escape-time compute over a device mesh.
+
+Two shardings, matching the two scaling axes (survey §2/§5.7):
+
+- :func:`batched_escape_pixels` — *tile batch* data parallelism: a batch of
+  k tiles (possibly from different levels, each with its own ``max_iter``)
+  is sharded over the mesh's ``tiles`` axis with ``shard_map``; each device
+  walks its tiles with ``lax.map`` so every tile keeps its own segmented
+  early exit.  This is the throughput path behind batched dispatch.
+- :func:`compute_tile_row_sharded` — *within-tile* row sharding: one tile's
+  rows are split across devices (rows are embarrassingly parallel — the
+  halo-free analog of sequence parallelism here).  This is the latency path
+  for single huge tiles / deep zooms.
+
+Grids are generated **on device** from ``(start, step)`` scalars via
+``broadcasted_iota`` — no 256 MB host grid, no H2D transfer of coordinates
+(the reference ships full coordinate arrays to the GPU,
+``DistributedMandelbrotWorkerCUDA.py:82-90``).  Device grid generation uses
+``start + index*step`` without numpy-linspace's forced exact endpoint; for
+the f32 fast path this is irrelevant and the bit-exact parity anchor
+remains the host-grid paths (see ops/escape_time.py).
+
+Per-tile ``max_iter`` in a mixed batch: the kernel iterates to the batch's
+static cap, then zeroes counts ``> mrd_i - 1`` — identical to running each
+tile to its own budget, since escape counts are monotone in the budget.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributedmandelbrot_tpu.core.geometry import TileSpec
+from distributedmandelbrot_tpu.ops.escape_time import DEFAULT_SEGMENT
+from distributedmandelbrot_tpu.parallel.mesh import ROW_AXIS, TILE_AXIS
+
+try:
+    from jax.experimental.shard_map import shard_map
+except ImportError:  # newer JAX moved it
+    from jax.sharding import shard_map  # type: ignore
+
+
+def _device_grid(start_r, start_i, step, shape, dtype, row_offset=0):
+    """(c_real, c_imag) grids from scalars, generated on device."""
+    col = lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+    row = lax.broadcasted_iota(jnp.int32, shape, len(shape) - 2) + row_offset
+    c_real = start_r + col.astype(dtype) * step
+    c_imag = start_i + row.astype(dtype) * step
+    return c_real, c_imag
+
+
+def _masked_escape(c_real, c_imag, max_iter_cap: int, segment: int):
+    """The segmented masked escape loop (same semantics as ops.escape_time)."""
+    dtype = c_real.dtype
+    four = jnp.asarray(4.0, dtype)
+    two = jnp.asarray(2.0, dtype)
+    total_steps = max_iter_cap - 1
+    if total_steps <= 0:
+        return jnp.zeros(c_real.shape, jnp.int32)
+    segment = max(1, min(segment, total_steps))
+
+    def one_step(state, it):
+        zr, zi, counts = state
+        active = counts == 0
+        new_zr = zr * zr - zi * zi + c_real
+        new_zi = two * zr * zi + c_imag
+        zr = jnp.where(active, new_zr, zr)
+        zi = jnp.where(active, new_zi, zi)
+        escaped = active & (zr * zr + zi * zi >= four)
+        counts = jnp.where(escaped, it, counts)
+        return (zr, zi, counts)
+
+    def body(carry):
+        zr, zi, counts, it = carry
+        state = (zr, zi, counts)
+        for k in range(segment):
+            state = one_step(state, it + k)
+        zr, zi, counts = state
+        return (zr, zi, counts, it + segment)
+
+    def cond(carry):
+        _, _, counts, it = carry
+        return (it <= total_steps) & jnp.any(counts == 0)
+
+    # Derive every carry from BOTH coordinate arrays rather than fresh
+    # constants (or one input alone) so that, under shard_map, each carry
+    # has the union of the inputs' varying-manual-axes — e.g. in the
+    # row-sharded path c_imag varies over the rows axis but c_real is
+    # replicated, and a carry typed off only one of them fails while_loop
+    # typing when the body mixes in the other.
+    zr0 = c_real + 0.0 * c_imag
+    zi0 = c_imag + 0.0 * c_real
+    counts0 = (zr0 * 0).astype(jnp.int32)
+    init = (zr0, zi0, counts0, jnp.asarray(1, jnp.int32))
+    _, _, counts, _ = lax.while_loop(cond, body, init)
+    return jnp.where(counts > total_steps, 0, counts)
+
+
+def _scale_pixels(counts, mrd, clamp: bool):
+    """Exact integer uint8 scaling; widens when counts*256 could overflow
+    int32 (same policy as ops.escape_time._scale_counts_jit)."""
+    wide = jnp.int64 if counts.dtype == jnp.int64 else jnp.int32
+    mrd = mrd.astype(wide) if hasattr(mrd, "astype") else mrd
+    vals = (counts.astype(wide) * 256 + (mrd - 1)) // mrd
+    if clamp:
+        vals = jnp.minimum(vals, 255)
+    return vals.astype(jnp.uint8)
+
+
+def _one_tile_pixels(params, mrd, *, definition: int, max_iter_cap: int,
+                     segment: int, clamp: bool):
+    """params = (start_r, start_i, step) scalars; mrd = per-tile budget."""
+    start_r, start_i, step = params[0], params[1], params[2]
+    c_real, c_imag = _device_grid(start_r, start_i, step,
+                                  (definition, definition), params.dtype)
+    counts = _masked_escape(c_real, c_imag, max_iter_cap, segment)
+    counts = jnp.where(counts <= mrd - 1, counts, 0)
+    if max_iter_cap - 1 > (1 << 23):
+        counts = counts.astype(jnp.int64)
+    return _scale_pixels(counts, mrd, clamp)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "definition", "max_iter_cap", "segment",
+                          "clamp"))
+def _batched_escape_sharded(params, mrds, *, mesh: Mesh, definition: int,
+                            max_iter_cap: int, segment: int, clamp: bool):
+    tile_fn = partial(_one_tile_pixels, definition=definition,
+                      max_iter_cap=max_iter_cap, segment=segment, clamp=clamp)
+
+    def shard_fn(p_shard, m_shard):
+        # Sequential walk of this device's tiles: each keeps its own
+        # early-exit while_loop instead of lockstepping with batch peers.
+        return lax.map(lambda args: tile_fn(*args), (p_shard, m_shard))
+
+    return shard_map(shard_fn, mesh=mesh,
+                     in_specs=(P(TILE_AXIS), P(TILE_AXIS)),
+                     out_specs=P(TILE_AXIS))(params, mrds)
+
+
+def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
+                          mrds: np.ndarray, *, definition: int,
+                          dtype=np.float32, segment: int = DEFAULT_SEGMENT,
+                          clamp: bool = False) -> np.ndarray:
+    """Compute a batch of tiles sharded over ``mesh``'s ``tiles`` axis.
+
+    ``starts_steps``: float (k, 3) of ``(start_real, start_imag, step)``;
+    ``mrds``: int (k,) per-tile iteration budgets.  Returns uint8
+    ``(k, definition, definition)``.  The batch is padded on the right to a
+    multiple of the mesh size with trivial tiles and unpadded on return.
+    """
+    k = starts_steps.shape[0]
+    if k == 0:
+        return np.zeros((0, definition, definition), np.uint8)
+    n_dev = mesh.devices.size
+    pad = (-k) % n_dev
+    if pad:
+        pad_params = np.tile(np.array([[3.0, 3.0, 0.0]]), (pad, 1))
+        starts_steps = np.concatenate(
+            [starts_steps, pad_params.astype(starts_steps.dtype)])
+        mrds = np.concatenate([mrds, np.ones(pad, mrds.dtype)])
+    cap = int(mrds.max())
+    if cap - 1 > (1 << 23):  # counts*256 must not overflow int32
+        from distributedmandelbrot_tpu.utils.precision import ensure_x64
+        ensure_x64()
+        mrd_dtype = jnp.int64
+    else:
+        mrd_dtype = jnp.int32
+    params = jnp.asarray(starts_steps, dtype=dtype)
+    mrd_arr = jnp.asarray(mrds, dtype=mrd_dtype)
+    sharding = NamedSharding(mesh, P(TILE_AXIS))
+    params = jax.device_put(params, sharding)
+    mrd_arr = jax.device_put(mrd_arr, sharding)
+    out = _batched_escape_sharded(params, mrd_arr, mesh=mesh,
+                                  definition=definition, max_iter_cap=cap,
+                                  segment=segment, clamp=clamp)
+    return np.asarray(out)[:k]
+
+
+@partial(jax.jit, static_argnames=("mesh", "definition", "max_iter", "segment",
+                                   "clamp"))
+def _row_sharded_tile(start_r, start_i, step, *, mesh: Mesh, definition: int,
+                      max_iter: int, segment: int, clamp: bool):
+    n_rows = mesh.shape[ROW_AXIS]
+    rows_per = definition // n_rows
+
+    def shard_fn(sr, si, st):
+        offset = lax.axis_index(ROW_AXIS) * rows_per
+        c_real, c_imag = _device_grid(sr, si, st, (rows_per, definition),
+                                      sr.dtype, row_offset=offset)
+        counts = _masked_escape(c_real, c_imag, max_iter, segment)
+        if max_iter - 1 > (1 << 23):
+            counts = counts.astype(jnp.int64)
+        return _scale_pixels(counts, jnp.asarray(max_iter, counts.dtype),
+                             clamp)
+
+    return shard_map(shard_fn, mesh=mesh, in_specs=(P(), P(), P()),
+                     out_specs=P(ROW_AXIS))(start_r, start_i, step)
+
+
+def compute_tile_row_sharded(mesh: Mesh, spec: TileSpec, max_iter: int, *,
+                             dtype=np.float32, segment: int = DEFAULT_SEGMENT,
+                             clamp: bool = False) -> np.ndarray:
+    """One tile's rows sharded across the mesh's ``rows`` axis (latency path)."""
+    n_rows = mesh.shape[ROW_AXIS]
+    if spec.height % n_rows:
+        raise ValueError(
+            f"tile height {spec.height} not divisible by {n_rows} row shards")
+    if spec.width != spec.height:
+        raise ValueError("row sharding currently requires square tiles")
+    if max_iter - 1 > (1 << 23):  # int64 scaling path needs x64 types
+        from distributedmandelbrot_tpu.utils.precision import ensure_x64
+        ensure_x64()
+    step = spec.range_real / (spec.width - 1)
+    out = _row_sharded_tile(jnp.asarray(spec.start_real, dtype),
+                            jnp.asarray(spec.start_imag, dtype),
+                            jnp.asarray(step, dtype), mesh=mesh,
+                            definition=spec.width, max_iter=max_iter,
+                            segment=segment, clamp=clamp)
+    return np.asarray(out)

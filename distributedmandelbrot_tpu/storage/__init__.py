@@ -1,0 +1,11 @@
+"""Durable persistence: append-only tile index + codec'd chunk files."""
+
+from distributedmandelbrot_tpu.storage.index import (CorruptIndexError,
+                                                     EntryType, IndexEntry,
+                                                     read_entry, scan_entries)
+from distributedmandelbrot_tpu.storage.store import (DATA_DIR_NAME,
+                                                     INDEX_FILENAME,
+                                                     ChunkStore)
+
+__all__ = ["CorruptIndexError", "EntryType", "IndexEntry", "read_entry",
+           "scan_entries", "ChunkStore", "DATA_DIR_NAME", "INDEX_FILENAME"]

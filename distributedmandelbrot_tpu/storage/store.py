@@ -1,0 +1,193 @@
+"""Chunk store: data directory + append-only index + codec'd chunk files.
+
+Capabilities mirrored from the reference (``DataStorage.cs``), instance-based
+rather than process-global so tests and multi-store coordinators compose:
+
+- ``Data/`` directory with ``_index.dat`` created on demand
+  (``DataStorage.cs:131-144``)
+- chunk files named ``level;re;im`` with a numeric suffix on collision
+  (``DataStorage.cs:392-405``)
+- ``save()`` appends an index entry, then writes the chunk file for Regular
+  chunks (``DataStorage.cs:410-427``); Never/Immediate chunks are tag-only
+- ``load()``/``load_many()`` scan the index and synthesize Never/Immediate
+  chunks in memory (``DataStorage.cs:256-292,86-118``); with duplicate
+  entries the *last* (most recent append) wins
+- ``completed_keys()`` replays the index for resume seeding
+  (``Distributer.cs:165-175``)
+
+Fixes over the reference (survey caveats): one lock serializes index
+appends AND the per-file guard is a real mutex (the reference's
+check-then-add spin-wait races, ``DataStorage.cs:158-162,337-341``);
+optional fsync for the index; a serialized-payload LRU so the read path
+doesn't decode + re-encode a chunk per request (the reference re-serializes
+every fetch, ``DataServer.cs:204-221``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Iterable, Optional
+
+from distributedmandelbrot_tpu.core.chunk import Chunk
+from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+from distributedmandelbrot_tpu.storage.index import (EntryType, IndexEntry,
+                                                     scan_entries)
+
+INDEX_FILENAME = "_index.dat"
+DATA_DIR_NAME = "Data"
+
+
+class ChunkStore:
+    """Durable chunk storage rooted at ``parent_dir/Data/``."""
+
+    def __init__(self, parent_dir: str = "", *, fsync_index: bool = False,
+                 payload_cache_size: int = 64) -> None:
+        self.data_dir = os.path.join(parent_dir, DATA_DIR_NAME)
+        self.index_path = os.path.join(self.data_dir, INDEX_FILENAME)
+        self._fsync_index = fsync_index
+        self._index_lock = threading.Lock()
+        self._file_locks: dict[str, threading.Lock] = {}
+        self._file_locks_guard = threading.Lock()
+        self._payload_cache: OrderedDict[tuple[int, int, int], bytes] = \
+            OrderedDict()
+        self._payload_cache_size = payload_cache_size
+        self._cache_lock = threading.Lock()
+        self.setup()
+
+    # -- directory / bookkeeping ------------------------------------------
+
+    def setup(self) -> None:
+        """Create the data directory and an empty index if absent."""
+        os.makedirs(self.data_dir, exist_ok=True)
+        with self._index_lock:
+            if not os.path.exists(self.index_path):
+                with open(self.index_path, "wb"):
+                    pass
+
+    def _chunk_path(self, filename: str) -> str:
+        return os.path.join(self.data_dir, filename)
+
+    def _file_lock(self, filename: str) -> threading.Lock:
+        with self._file_locks_guard:
+            return self._file_locks.setdefault(filename, threading.Lock())
+
+    def _generate_filename(self, chunk: Chunk) -> str:
+        base = f"{chunk.level};{chunk.index_real};{chunk.index_imag}"
+        if not os.path.exists(self._chunk_path(base)):
+            return base
+        suffix = 0
+        while os.path.exists(self._chunk_path(base + str(suffix))):
+            suffix += 1
+        return base + str(suffix)
+
+    # -- write path -------------------------------------------------------
+
+    def save(self, chunk: Chunk) -> IndexEntry:
+        """Persist a chunk: write its file (if Regular), then its index entry.
+
+        The file is written *before* the index entry so a crash between the
+        two leaves an orphaned data file (harmless) rather than an index
+        entry pointing at nothing — the reverse of the reference's order,
+        which can break resume.
+        """
+        if chunk.is_never:
+            entry = IndexEntry(*chunk.key, EntryType.NEVER)
+        elif chunk.is_immediate:
+            entry = IndexEntry(*chunk.key, EntryType.IMMEDIATE)
+        else:
+            filename = self._generate_filename(chunk)
+            payload = chunk.serialize()
+            with self._file_lock(filename):
+                tmp = self._chunk_path(filename) + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+                os.replace(tmp, self._chunk_path(filename))
+            entry = IndexEntry(*chunk.key, EntryType.REGULAR, filename)
+            self._cache_payload(chunk.key, payload)
+
+        with self._index_lock:
+            with open(self.index_path, "ab") as f:
+                f.write(entry.to_bytes())
+                f.flush()
+                if self._fsync_index:
+                    os.fsync(f.fileno())
+        return entry
+
+    # -- read path --------------------------------------------------------
+
+    def entries(self) -> list[IndexEntry]:
+        with self._index_lock:
+            with open(self.index_path, "rb") as f:
+                return list(scan_entries(f))
+
+    def completed_keys(self, levels: Optional[Iterable[int]] = None
+                       ) -> set[tuple[int, int, int]]:
+        """Replay the index into a set of completed tile keys (resume path)."""
+        level_filter = set(levels) if levels is not None else None
+        keys: set[tuple[int, int, int]] = set()
+        for entry in self.entries():
+            if level_filter is None or entry.level in level_filter:
+                keys.add(entry.key)
+        return keys
+
+    def load_many(self, keys: list[tuple[int, int, int]]
+                  ) -> list[Optional[Chunk]]:
+        """Load several chunks in one index scan; None where absent."""
+        wanted = {key: i for i, key in enumerate(keys)}
+        found: dict[tuple[int, int, int], IndexEntry] = {}
+        for entry in self.entries():
+            if entry.key in wanted:
+                found[entry.key] = entry  # last entry wins
+        out: list[Optional[Chunk]] = [None] * len(keys)
+        for key, entry in found.items():
+            out[wanted[key]] = self._entry_to_chunk(entry)
+        return out
+
+    def load(self, level: int, index_real: int, index_imag: int
+             ) -> Optional[Chunk]:
+        return self.load_many([(level, index_real, index_imag)])[0]
+
+    def load_payload(self, level: int, index_real: int, index_imag: int
+                     ) -> Optional[bytes]:
+        """Serialized payload (code byte + body) for a chunk, LRU-cached.
+
+        This is what the read-side server sends; caching skips the
+        decode/re-encode round trip per request.
+        """
+        key = (level, index_real, index_imag)
+        with self._cache_lock:
+            if key in self._payload_cache:
+                self._payload_cache.move_to_end(key)
+                return self._payload_cache[key]
+        chunk = self.load(level, index_real, index_imag)
+        if chunk is None:
+            return None
+        payload = chunk.serialize()
+        self._cache_payload(key, payload)
+        return payload
+
+    def _cache_payload(self, key: tuple[int, int, int],
+                       payload: bytes) -> None:
+        if self._payload_cache_size <= 0:
+            return
+        with self._cache_lock:
+            self._payload_cache[key] = payload
+            self._payload_cache.move_to_end(key)
+            while len(self._payload_cache) > self._payload_cache_size:
+                self._payload_cache.popitem(last=False)
+
+    def _entry_to_chunk(self, entry: IndexEntry) -> Chunk:
+        if entry.type == EntryType.NEVER:
+            return Chunk.never(*entry.key)
+        if entry.type == EntryType.IMMEDIATE:
+            return Chunk.immediate(*entry.key)
+        with self._file_lock(entry.filename):
+            with open(self._chunk_path(entry.filename), "rb") as f:
+                payload = f.read()
+        data = Chunk.deserialize_data(payload)
+        if data.size != CHUNK_PIXELS:
+            raise ValueError(
+                f"chunk file {entry.filename} decodes to {data.size} pixels")
+        return Chunk(*entry.key, data)

@@ -1,0 +1,29 @@
+"""Minimal thread-safe counters for coordinator/worker observability.
+
+The reference has no metrics at all (survey §5.5); these power the
+coordinator's stats logging and the bench harness without pulling in a
+metrics stack.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class Counters:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = defaultdict(int)
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] += by
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts[name]
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)

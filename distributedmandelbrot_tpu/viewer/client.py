@@ -1,0 +1,89 @@
+"""Synchronous client for the DataServer protocol (viewer side).
+
+Same exchange as the reference viewer (``DistributedMandelbrotViewer.py:
+62-108``): 12-byte query, status byte, length-prefixed codec payload.
+Decoding goes through the shared codec registry instead of a hand-rolled
+RLE loop, and straight into numpy (the reference round-trips 16M pixels
+through a Python list, ``DistributedMandelbrotViewer.py:102``).
+
+Unlike the reference's connection-per-query, the client keeps one
+connection open and pipelines queries over it (the server loops until EOF),
+which matters on the stitch path — a level-L image is L^2 fetches.  A
+broken connection is re-dialed transparently once per fetch.
+"""
+
+from __future__ import annotations
+
+import enum
+import socket
+import struct
+from typing import Optional
+
+import numpy as np
+
+from distributedmandelbrot_tpu.core.chunk import Chunk
+from distributedmandelbrot_tpu.net import framing
+from distributedmandelbrot_tpu.net import protocol as proto
+
+_QUERY = struct.Struct("<III")
+
+
+class FetchStatus(enum.Enum):
+    OK = "ok"
+    NOT_AVAILABLE = "not_available"
+    REJECTED = "rejected"
+
+
+class DataClient:
+    def __init__(self, host: str, port: int, *,
+                 timeout: Optional[float] = 30.0) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+
+    def _connected(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection((self.host, self.port),
+                                                  timeout=self.timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __enter__(self) -> "DataClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def fetch(self, level: int, index_real: int, index_imag: int
+              ) -> tuple[Optional[np.ndarray], FetchStatus]:
+        """Fetch one chunk's flat uint8 pixels; (None, status) if unavailable."""
+        try:
+            return self._fetch_once(level, index_real, index_imag)
+        except (ConnectionError, OSError):
+            # Stale persistent connection (server restart, idle teardown):
+            # re-dial once and retry; a second failure propagates.
+            self.close()
+            return self._fetch_once(level, index_real, index_imag)
+
+    def _fetch_once(self, level: int, index_real: int, index_imag: int
+                    ) -> tuple[Optional[np.ndarray], FetchStatus]:
+        sock = self._connected()
+        framing.send_all(sock, _QUERY.pack(level, index_real, index_imag))
+        status = framing.recv_byte(sock)
+        if status == proto.QUERY_NOT_AVAILABLE:
+            return None, FetchStatus.NOT_AVAILABLE
+        if status == proto.QUERY_REJECT:
+            return None, FetchStatus.REJECTED
+        if status != proto.QUERY_ACCEPT:
+            raise framing.ProtocolError(f"unknown query status {status:#x}")
+        length = framing.recv_u32(sock)
+        payload = framing.recv_exact(sock, length)
+        return Chunk.deserialize_data(payload), FetchStatus.OK

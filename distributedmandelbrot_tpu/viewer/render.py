@@ -1,0 +1,65 @@
+"""Rendering: uint8 pixel values -> RGBA images, plus multi-chunk stitching.
+
+``value_to_rgba`` reproduces the reference viewer's colormap pipeline
+exactly (``DistributedMandelbrotViewer.py:110-135``): normalize /256,
+invert, apply matplotlib's ``jet``, then paint in-set pixels (value 0,
+i.e. inverted 1.0) black.
+
+Stitching a whole level into one image is a natural capability extension
+(the reference renders only single chunks).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from distributedmandelbrot_tpu.core.geometry import CHUNK_WIDTH
+
+
+def value_to_rgba(values: np.ndarray, colormap: str = "jet") -> np.ndarray:
+    """Flat or 2-D uint8 values -> float RGBA array (reference pipeline)."""
+    import matplotlib
+
+    if values.ndim == 1:
+        side = int(round(values.size ** 0.5))
+        if side * side != values.size:
+            raise ValueError(f"cannot square-reshape {values.size} pixels")
+        values = values.reshape((side, side))
+    vs = values.astype(float) / 256.0
+    vs = 1.0 - vs
+    mapped = matplotlib.colormaps[colormap](vs).astype(float)
+    black = np.array((0.0, 0.0, 0.0, 1.0))
+    return np.where(vs[..., None] == 1.0, black, mapped)
+
+
+def stitch_level(fetch: Callable[[int, int], Optional[np.ndarray]],
+                 level: int, *, chunk_width: int = CHUNK_WIDTH,
+                 fill_value: int = 0) -> np.ndarray:
+    """Assemble a full level image from per-chunk fetches.
+
+    ``fetch(index_real, index_imag)`` returns flat uint8 pixels or None for
+    missing chunks (filled with ``fill_value``).  Output axis order follows
+    the chunk-local convention — row = imaginary axis, column = real axis —
+    so chunk (i, j) lands at rows ``j*W:(j+1)*W``, cols ``i*W:(i+1)*W``.
+    """
+    out = np.full((level * chunk_width, level * chunk_width), fill_value,
+                  dtype=np.uint8)
+    for i in range(level):
+        for j in range(level):
+            pixels = fetch(i, j)
+            if pixels is None:
+                continue
+            tile = np.asarray(pixels, dtype=np.uint8).reshape(
+                (chunk_width, chunk_width))
+            out[j * chunk_width:(j + 1) * chunk_width,
+                i * chunk_width:(i + 1) * chunk_width] = tile
+    return out
+
+
+def show(rgba: np.ndarray) -> None:  # pragma: no cover - needs a display
+    from matplotlib import pyplot as plt
+
+    plt.imshow(rgba)
+    plt.show()

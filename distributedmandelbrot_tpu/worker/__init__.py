@@ -1,0 +1,10 @@
+"""Stateless pull-loop workers and their compute backends."""
+
+from distributedmandelbrot_tpu.worker.backends import (ComputeBackend,
+                                                       JaxBackend,
+                                                       NumpyBackend)
+from distributedmandelbrot_tpu.worker.client import DistributerClient
+from distributedmandelbrot_tpu.worker.worker import Worker
+
+__all__ = ["ComputeBackend", "JaxBackend", "NumpyBackend",
+           "DistributerClient", "Worker"]

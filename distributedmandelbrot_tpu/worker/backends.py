@@ -1,0 +1,71 @@
+"""Compute backends: how a worker turns a workload into uint8 pixels.
+
+The reference worker's compute is a Numba-CUDA kernel
+(``DistributedMandelbrotWorkerCUDA.py:39-100``); here the same contract —
+``Workload -> 16,777,216 uint8 pixels in real-fastest order`` — has three
+interchangeable implementations:
+
+- :class:`NumpyBackend` — the bit-exact golden path (slow; parity anchor)
+- :class:`JaxBackend` — single-device ``jit`` kernel, f32 fast / f64 exact-ish
+- the sharded mesh backend lives in
+  :mod:`distributedmandelbrot_tpu.parallel` (batch pmap/shard_map)
+
+Backends expose batch compute so mesh backends can fuse a whole lease batch
+into one device dispatch; scalar backends just loop.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+import numpy as np
+
+from distributedmandelbrot_tpu.core.geometry import CHUNK_WIDTH, TileSpec
+from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.ops import escape_time
+from distributedmandelbrot_tpu.ops import reference as ref_ops
+
+
+class ComputeBackend(Protocol):
+    def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
+        """Flat uint8 pixel arrays, one per workload, real-fastest order."""
+        ...
+
+
+def _spec_for(workload: Workload, definition: int) -> TileSpec:
+    return TileSpec.for_chunk(workload.level, workload.index_real,
+                              workload.index_imag, definition=definition)
+
+
+class NumpyBackend:
+    """Golden-reference compute: float64 numpy, bit-identical semantics."""
+
+    def __init__(self, definition: int = CHUNK_WIDTH) -> None:
+        self.definition = definition
+
+    def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
+        out = []
+        for w in workloads:
+            spec = _spec_for(w, self.definition)
+            cr, ci = spec.grid_2d()
+            counts = ref_ops.escape_counts(cr, ci, w.max_iter)
+            out.append(ref_ops.scale_counts_to_uint8(counts, w.max_iter)
+                       .ravel())
+        return out
+
+
+class JaxBackend:
+    """Single-device JAX compute (CPU or one TPU core)."""
+
+    def __init__(self, definition: int = CHUNK_WIDTH,
+                 dtype: np.dtype = np.float32,
+                 segment: int = escape_time.DEFAULT_SEGMENT) -> None:
+        self.definition = definition
+        self.dtype = dtype
+        self.segment = segment
+
+    def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
+        return [escape_time.compute_tile(_spec_for(w, self.definition),
+                                         w.max_iter, dtype=self.dtype,
+                                         segment=self.segment)
+                for w in workloads]

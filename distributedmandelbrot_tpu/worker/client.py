@@ -1,0 +1,113 @@
+"""Synchronous client for the Distributer protocol (worker side).
+
+Speaks the same wire protocol as the reference worker
+(``DistributedMandelbrotWorkerCUDA.py:102-176``): one connection per
+exchange, purpose byte first.  Adds the batched request/response exchanges
+(one connection for a whole batch) used to feed a device mesh.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+from distributedmandelbrot_tpu.core.workload import (WORKLOAD_WIRE_SIZE,
+                                                     Workload)
+from distributedmandelbrot_tpu.net import framing
+from distributedmandelbrot_tpu.net import protocol as proto
+
+
+class DistributerClient:
+    def __init__(self, host: str, port: int, *,
+                 timeout: Optional[float] = 30.0) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    # -- job acquisition --------------------------------------------------
+
+    def request(self) -> Optional[Workload]:
+        """Pull one workload; None when the coordinator has nothing to hand out."""
+        with self._connect() as sock:
+            framing.send_byte(sock, proto.PURPOSE_REQUEST)
+            status = framing.recv_byte(sock)
+            if status == proto.WORKLOAD_NOT_AVAILABLE:
+                return None
+            if status != proto.WORKLOAD_AVAILABLE:
+                raise framing.ProtocolError(
+                    f"unexpected availability code {status:#x}")
+            return Workload.from_wire(
+                framing.recv_exact(sock, WORKLOAD_WIRE_SIZE))
+
+    def request_batch(self, max_count: int) -> list[Workload]:
+        """Pull up to ``max_count`` workloads in one exchange."""
+        with self._connect() as sock:
+            framing.send_byte(sock, proto.PURPOSE_BATCH_REQUEST)
+            framing.send_u32(sock, max_count)
+            status = framing.recv_byte(sock)
+            if status == proto.WORKLOAD_NOT_AVAILABLE:
+                return []
+            if status != proto.WORKLOAD_AVAILABLE:
+                raise framing.ProtocolError(
+                    f"unexpected availability code {status:#x}")
+            n = framing.recv_u32(sock)
+            return [Workload.from_wire(
+                framing.recv_exact(sock, WORKLOAD_WIRE_SIZE))
+                for _ in range(n)]
+
+    # -- result submission ------------------------------------------------
+
+    @staticmethod
+    def _pixel_bytes(pixels: np.ndarray) -> bytes:
+        arr = np.ascontiguousarray(pixels, dtype=np.uint8).ravel()
+        if arr.size != CHUNK_PIXELS:
+            raise ValueError(
+                f"result must have {CHUNK_PIXELS} pixels, got {arr.size}")
+        return arr.tobytes()
+
+    def submit(self, workload: Workload, pixels: np.ndarray) -> bool:
+        """Push one result; returns True if the coordinator accepted it."""
+        data = self._pixel_bytes(pixels)
+        with self._connect() as sock:
+            framing.send_byte(sock, proto.PURPOSE_RESPONSE)
+            framing.send_all(sock, workload.to_wire())
+            status = framing.recv_byte(sock)
+            if status == proto.RESPONSE_REJECT:
+                return False
+            if status != proto.RESPONSE_ACCEPT:
+                raise framing.ProtocolError(
+                    f"unexpected acceptance code {status:#x}")
+            framing.send_all(sock, data)
+            return True
+
+    def submit_batch(self, results: Sequence[tuple[Workload, np.ndarray]]
+                     ) -> list[bool]:
+        """Push several results over one connection; per-item accept flags."""
+        if not results:
+            return []
+        encoded = [(w, self._pixel_bytes(p)) for w, p in results]
+        accepted: list[bool] = []
+        with self._connect() as sock:
+            framing.send_byte(sock, proto.PURPOSE_BATCH_RESPONSE)
+            framing.send_u32(sock, len(encoded))
+            for w, data in encoded:
+                framing.send_all(sock, w.to_wire())
+                status = framing.recv_byte(sock)
+                if status == proto.RESPONSE_ACCEPT:
+                    framing.send_all(sock, data)
+                    accepted.append(True)
+                elif status == proto.RESPONSE_REJECT:
+                    accepted.append(False)
+                else:
+                    raise framing.ProtocolError(
+                        f"unexpected acceptance code {status:#x}")
+        return accepted

@@ -1,0 +1,132 @@
+"""The stateless pull-loop worker.
+
+Same lifecycle as the reference worker (``DistributedMandelbrotWorkerCUDA.py:
+111-184``): request -> compute -> submit, repeating until the coordinator
+reports no work (or forever with polling, for long-running farms — workers
+can join or leave at any time; all state lives coordinator-side).
+
+TPU-first extensions:
+
+- *batched dispatch*: lease up to ``batch_size`` tiles per exchange and hand
+  the whole batch to the backend in one call, so a mesh backend computes
+  all of them in a single device dispatch
+- *compute/IO overlap*: while batch N uploads on a background thread, batch
+  N+1 is already computing — the moral equivalent of the reference farm's
+  many concurrent worker processes, folded into one fat worker.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.utils.metrics import Counters
+from distributedmandelbrot_tpu.worker.backends import ComputeBackend
+from distributedmandelbrot_tpu.worker.client import DistributerClient
+
+logger = logging.getLogger("dmtpu.worker")
+
+
+class Worker:
+    def __init__(self, client: DistributerClient, backend: ComputeBackend, *,
+                 batch_size: int = 1, overlap_io: bool = True,
+                 counters: Optional[Counters] = None) -> None:
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self.client = client
+        self.backend = backend
+        self.batch_size = batch_size
+        self.overlap_io = overlap_io
+        self.counters = counters if counters is not None else Counters()
+        self._upload_thread: Optional[threading.Thread] = None
+        self._upload_error: Optional[BaseException] = None
+
+    # -- single round -----------------------------------------------------
+
+    def _acquire(self) -> list[Workload]:
+        if self.batch_size == 1:
+            w = self.client.request()
+            return [w] if w is not None else []
+        return self.client.request_batch(self.batch_size)
+
+    def _submit(self, results: Sequence[tuple[Workload, np.ndarray]]) -> None:
+        if len(results) == 1:
+            accepted = [self.client.submit(*results[0])]
+        else:
+            accepted = self.client.submit_batch(results)
+        n_ok = sum(accepted)
+        self.counters.inc("results_accepted", n_ok)
+        self.counters.inc("results_rejected", len(accepted) - n_ok)
+        if n_ok < len(accepted):
+            logger.info("%d of %d results rejected (stale leases)",
+                        len(accepted) - n_ok, len(accepted))
+
+    def _join_upload(self) -> None:
+        if self._upload_thread is not None:
+            self._upload_thread.join()
+            self._upload_thread = None
+            if self._upload_error is not None:
+                err, self._upload_error = self._upload_error, None
+                raise err
+
+    def _start_upload(self, results: list[tuple[Workload, np.ndarray]]) -> None:
+        def run() -> None:
+            try:
+                self._submit(results)
+            except BaseException as e:  # surfaced on next join
+                self._upload_error = e
+
+        self._upload_thread = threading.Thread(target=run, daemon=True)
+        self._upload_thread.start()
+
+    def run_once(self) -> bool:
+        """One pull/compute/submit round; False when no work was available."""
+        workloads = self._acquire()
+        if not workloads:
+            self._join_upload()
+            return False
+        t0 = time.monotonic()
+        pixels = self.backend.compute_batch(workloads)
+        compute_s = time.monotonic() - t0
+        self.counters.inc("tiles_computed", len(workloads))
+        self.counters.inc("compute_ms", int(compute_s * 1000))
+        logger.info("computed %d tiles in %.2fs", len(workloads), compute_s)
+        results = list(zip(workloads, pixels))
+        self._join_upload()  # previous batch must land before the next starts
+        if self.overlap_io:
+            self._start_upload(results)
+        else:
+            self._submit(results)
+        return True
+
+    # -- loops ------------------------------------------------------------
+
+    def run_until_drained(self) -> int:
+        """Work until the coordinator has nothing to hand out; returns rounds."""
+        rounds = 0
+        while self.run_once():
+            rounds += 1
+        self._join_upload()
+        return rounds
+
+    def run_forever(self, poll_interval: float = 5.0,
+                    stop: Optional[threading.Event] = None) -> None:
+        """Work, then keep polling — the elastic-farm mode (workers may join
+        while other workers' leases are still pending expiry)."""
+        try:
+            while stop is None or not stop.is_set():
+                if not self.run_once():
+                    if stop is not None and stop.wait(poll_interval):
+                        return
+                    if stop is None:
+                        time.sleep(poll_interval)
+        finally:
+            # Never abandon an in-flight overlap-IO upload (dropping it
+            # would strand a computed batch until lease expiry) or swallow
+            # an error the upload thread already recorded.
+            self._join_upload()

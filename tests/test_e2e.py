@@ -1,0 +1,105 @@
+"""End-to-end without real hardware: coordinator + worker (JAX-CPU backend)
++ viewer-decoder on loopback; tile bytes compared to the numpy golden."""
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core import (CHUNK_WIDTH, LevelSetting,
+                                            TileSpec)
+from distributedmandelbrot_tpu.ops import reference as ref
+from distributedmandelbrot_tpu.viewer import (DataClient, FetchStatus,
+                                              stitch_level, value_to_rgba)
+from distributedmandelbrot_tpu.worker import (DistributerClient, JaxBackend,
+                                              NumpyBackend, Worker)
+
+from harness import CoordinatorHarness
+
+MAX_ITER = 24  # keep full-size 4096^2 tiles cheap on the CPU backend
+
+
+def golden_tile(level, i, j, max_iter=MAX_ITER):
+    spec = TileSpec.for_chunk(level, i, j)
+    cr, ci = spec.grid_2d()
+    return ref.scale_counts_to_uint8(
+        ref.escape_counts(cr, ci, max_iter), max_iter).ravel()
+
+
+def test_full_farm_level1_bit_exact_vs_golden(tmp_path):
+    """The 'one model running' milestone: request a level-1 tile, compute
+    (f64 JAX), persist, fetch, compare bytes to the numpy golden."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)]) as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            JaxBackend(dtype=np.float64), overlap_io=False)
+        rounds = worker.run_until_drained()
+        assert rounds == 1
+        farm.wait_saves_settled(expected_accepted=1)
+        assert farm.scheduler.is_complete()
+
+        pixels, status = DataClient("127.0.0.1", farm.dataserver_port) \
+            .fetch(1, 0, 0)
+        assert status is FetchStatus.OK
+        golden = golden_tile(1, 0, 0)
+        mismatch = (pixels != golden).mean()
+        assert mismatch <= 5e-4, f"{mismatch:.2%} pixels diverge from golden"
+
+        # Restart resume: a fresh coordinator over the same dir sees the
+        # completed tile and hands out nothing.
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)]) as farm2:
+        assert DistributerClient(
+            "127.0.0.1", farm2.distributer_port).request() is None
+        assert farm2.scheduler.is_complete()
+
+
+def test_batched_farm_level2_f32_and_stitching(tmp_path):
+    """Batched dispatch end-to-end: one worker leases all 4 level-2 tiles in
+    one exchange, computes them on the f32 fast path, and the stitched level
+    image is consistent with per-tile fetches."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, MAX_ITER)]) as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            JaxBackend(dtype=np.float32), batch_size=4)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=4)
+        assert farm.scheduler.is_complete()
+        assert worker.counters.get("tiles_computed") == 4
+
+        data_client = DataClient("127.0.0.1", farm.dataserver_port)
+
+        def fetch(i, j):
+            pixels, status = data_client.fetch(2, i, j)
+            assert status is FetchStatus.OK
+            return pixels
+
+        image = stitch_level(fetch, 2)
+        assert image.shape == (2 * CHUNK_WIDTH, 2 * CHUNK_WIDTH)
+        # The Mandelbrot set is symmetric about the real axis; level 2 splits
+        # exactly there, so the two image halves must mirror.
+        np.testing.assert_array_equal(image[:CHUNK_WIDTH],
+                                      image[CHUNK_WIDTH:][::-1])
+        # f32 fast path stays within tolerance of the golden per tile.
+        golden = golden_tile(2, 0, 0)
+        mismatch = (fetch(0, 0) != golden).mean()
+        assert mismatch < 0.01, f"{mismatch:.2%} f32 divergence"
+
+
+def test_numpy_backend_is_bit_exact(tmp_path):
+    """The parity-anchor backend must produce byte-identical persisted tiles."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, 12)]) as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            NumpyBackend(), overlap_io=False)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=1)
+        pixels, _ = DataClient("127.0.0.1", farm.dataserver_port).fetch(1, 0, 0)
+        np.testing.assert_array_equal(pixels, golden_tile(1, 0, 0, 12))
+
+
+def test_rgba_rendering_matches_reference_semantics():
+    """In-set pixels (value 0) must render black; others via inverted jet."""
+    values = np.zeros((8, 8), dtype=np.uint8)
+    values[0, 0] = 128
+    rgba = value_to_rgba(values)
+    assert rgba.shape == (8, 8, 4)
+    np.testing.assert_array_equal(rgba[1, 1], [0.0, 0.0, 0.0, 1.0])  # in-set
+    assert rgba[0, 0, :3].sum() > 0  # escaped pixel is colored

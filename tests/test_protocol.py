@@ -1,0 +1,158 @@
+"""Wire-conformance tests against live loopback servers, byte-level where it
+matters (a third-party client written to the reference protocol must
+interoperate)."""
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core import CHUNK_PIXELS, LevelSetting, Workload
+from distributedmandelbrot_tpu.net import framing
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.viewer import DataClient, FetchStatus
+from distributedmandelbrot_tpu.worker import DistributerClient
+
+from harness import CoordinatorHarness
+
+
+@pytest.fixture
+def farm(tmp_path):
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, 64)]) as h:
+        yield h
+
+
+def raw_conn(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def test_request_grant_bytes(farm):
+    """Purpose 0x00 -> 0x10 + 16B workload (level,mrd,i,j as u32 LE)."""
+    with raw_conn(farm.distributer_port) as s:
+        s.sendall(b"\x00")
+        assert framing.recv_byte(s) == 0x10
+        level, mrd, i, j = struct.unpack("<IIII", framing.recv_exact(s, 16))
+        assert (level, mrd, i, j) == (2, 64, 0, 0)
+
+
+def test_request_exhaustion_returns_not_available(farm):
+    client = DistributerClient("127.0.0.1", farm.distributer_port)
+    grants = [client.request() for _ in range(4)]
+    assert all(w is not None for w in grants)
+    with raw_conn(farm.distributer_port) as s:
+        s.sendall(b"\x00")
+        assert framing.recv_byte(s) == 0x11
+
+
+def test_response_roundtrip_and_dedup(farm):
+    client = DistributerClient("127.0.0.1", farm.distributer_port)
+    w = client.request()
+    zeros = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+    # Byte-level submit: purpose 0x01, 16B echo, expect 0x20, stream pixels.
+    with raw_conn(farm.distributer_port) as s:
+        s.sendall(b"\x01" + w.to_wire())
+        assert framing.recv_byte(s) == 0x20
+        s.sendall(zeros.tobytes())
+    farm.wait_saves_settled(expected_accepted=1)
+    # Duplicate submission is rejected with 0x21.
+    with raw_conn(farm.distributer_port) as s:
+        s.sendall(b"\x01" + w.to_wire())
+        assert framing.recv_byte(s) == 0x21
+
+
+def test_unknown_result_rejected(farm):
+    stray = Workload(2, 64, 1, 1)
+    with raw_conn(farm.distributer_port) as s:
+        s.sendall(b"\x01" + stray.to_wire())
+        assert framing.recv_byte(s) == 0x21
+
+
+def test_wrong_max_iter_rejected_wildcard_accepted(farm):
+    client = DistributerClient("127.0.0.1", farm.distributer_port)
+    w = client.request()
+    wrong = Workload(w.level, 999, w.index_real, w.index_imag)
+    assert not client.submit(wrong, np.zeros(CHUNK_PIXELS, np.uint8))
+    # max_iter=0 is not a wildcard; only in-memory None is — which can't go
+    # on the wire, so wire clients must echo exactly.
+    still = Workload(w.level, w.max_iter, w.index_real, w.index_imag)
+    assert client.submit(still, np.zeros(CHUNK_PIXELS, np.uint8))
+
+
+def test_dataserver_statuses_and_payload(farm):
+    client = DistributerClient("127.0.0.1", farm.distributer_port)
+    data_client = DataClient("127.0.0.1", farm.dataserver_port)
+
+    # Not yet computed -> NOT_AVAILABLE (0x02).
+    pixels, status = data_client.fetch(2, 0, 0)
+    assert status is FetchStatus.NOT_AVAILABLE and pixels is None
+
+    # Invalid query (index >= level) -> REJECT (0x01).
+    _, status = data_client.fetch(2, 2, 0)
+    assert status is FetchStatus.REJECTED
+
+    # Complete one tile, then fetch it.
+    w = client.request()
+    ones = np.ones(CHUNK_PIXELS, dtype=np.uint8)
+    assert client.submit(w, ones)
+    farm.wait_saves_settled(expected_accepted=1)
+    pixels, status = data_client.fetch(w.level, w.index_real, w.index_imag)
+    assert status is FetchStatus.OK
+    np.testing.assert_array_equal(pixels, ones)
+
+
+def test_dataserver_payload_bytes_are_length_prefixed_codec(farm):
+    """Byte-level: status 0x00, u32 length, then code byte + body — an
+    all-ones chunk must arrive as a single 5-byte RLE record."""
+    client = DistributerClient("127.0.0.1", farm.distributer_port)
+    w = client.request()
+    client.submit(w, np.ones(CHUNK_PIXELS, dtype=np.uint8))
+    farm.wait_saves_settled(expected_accepted=1)
+    with raw_conn(farm.dataserver_port) as s:
+        s.sendall(struct.pack("<III", w.level, w.index_real, w.index_imag))
+        assert framing.recv_byte(s) == 0x00
+        length = framing.recv_u32(s)
+        payload = framing.recv_exact(s, length)
+    assert payload[0] == 0x01  # RLE codec
+    count, value = struct.unpack("<IB", payload[1:6])
+    assert (count, value) == (CHUNK_PIXELS, 1)
+    assert length == 6
+
+
+def test_batch_request_and_response(farm):
+    client = DistributerClient("127.0.0.1", farm.distributer_port)
+    batch = client.request_batch(3)
+    assert len(batch) == 3
+    assert len({w.key for w in batch}) == 3
+    results = [(w, np.full(CHUNK_PIXELS, 2, dtype=np.uint8)) for w in batch]
+    assert client.submit_batch(results) == [True, True, True]
+    farm.wait_saves_settled(expected_accepted=3)
+    # Remaining tile via single path, then exhaustion.
+    assert len(client.request_batch(10)) == 1
+    assert client.request_batch(1) == []
+
+
+def test_lease_expiry_then_stale_rejected_and_regrant():
+    """Full redistribution flow over virtual time through the real servers."""
+    import tempfile
+
+    from distributedmandelbrot_tpu.coordinator import ManualClock
+
+    clock = ManualClock()
+    with tempfile.TemporaryDirectory() as tmp:
+        with CoordinatorHarness(tmp, [LevelSetting(1, 16)],
+                                lease_timeout=10.0, clock=clock) as farm:
+            client = DistributerClient("127.0.0.1", farm.distributer_port)
+            w1 = client.request()
+            assert w1 is not None
+            assert client.request() is None  # single tile, leased
+            clock.advance(11.0)
+            # Expired: the slow worker's result is rejected...
+            assert not client.submit(w1, np.zeros(CHUNK_PIXELS, np.uint8))
+            farm.scheduler.sweep()
+            # ...and the tile is regranted to the next worker.
+            w2 = client.request()
+            assert w2 is not None and w2.key == w1.key
+            assert client.submit(w2, np.zeros(CHUNK_PIXELS, np.uint8))

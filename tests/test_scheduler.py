@@ -1,0 +1,149 @@
+"""Pure scheduler logic over virtual time — no sockets, no real clock."""
+
+import pytest
+
+from distributedmandelbrot_tpu.coordinator import ManualClock, TileScheduler
+from distributedmandelbrot_tpu.core import LevelSetting, Workload
+
+
+def make(levels=((2, 64),), completed=None, timeout=3600.0):
+    clock = ManualClock()
+    sched = TileScheduler([LevelSetting(l, m) for l, m in levels],
+                          completed=completed, lease_timeout=timeout,
+                          clock=clock)
+    return sched, clock
+
+
+def test_grant_order_matches_reference_grid_walk():
+    """Level settings in order; index_real outer, index_imag inner."""
+    sched, _ = make(levels=((2, 64), (3, 128)))
+    got = [sched.acquire() for _ in range(4)]
+    assert [(w.level, w.index_real, w.index_imag) for w in got] == \
+        [(2, 0, 0), (2, 0, 1), (2, 1, 0), (2, 1, 1)]
+    nxt = sched.acquire()
+    assert (nxt.level, nxt.max_iter) == (3, 128)
+
+
+def test_leased_tiles_not_regranted():
+    sched, _ = make()
+    grants = {sched.acquire().key for _ in range(4)}
+    assert len(grants) == 4
+    assert sched.acquire() is None  # all leased, none completed
+
+
+def test_complete_and_dedup():
+    sched, _ = make()
+    w = sched.acquire()
+    assert sched.complete(w)
+    assert not sched.complete(w)  # duplicate result rejected
+    assert sched.completed_count == 1
+
+
+def test_unknown_result_rejected():
+    sched, _ = make()
+    stray = Workload(2, 64, 1, 1)  # never granted
+    assert not sched.can_accept(stray)
+    assert not sched.complete(stray)
+
+
+def test_max_iter_mismatch_rejected_wildcard_accepted():
+    sched, _ = make()
+    w = sched.acquire()
+    wrong = Workload(w.level, 999, w.index_real, w.index_imag)
+    assert not sched.can_accept(wrong)
+    wildcard = Workload(w.level, None, w.index_real, w.index_imag)
+    assert sched.can_accept(wildcard)
+
+
+def test_lease_expiry_redistributes_after_sweep():
+    sched, clock = make(timeout=10.0)
+    w = sched.acquire()
+    # Exhaust the rest of the grid so only expiry can yield w again.
+    while sched.acquire() is not None:
+        pass
+    clock.advance(11.0)
+    assert sched.sweep() == 4  # all four leases expired
+    regrant = sched.acquire()
+    assert regrant.key == w.key  # FIFO requeue: first-leased comes back first
+
+
+def test_stale_result_rejected_after_expiry():
+    """A worker returning past the lease deadline is rejected even before
+    any sweep runs (lazy expiry)."""
+    sched, clock = make(timeout=10.0)
+    w = sched.acquire()
+    clock.advance(10.0)
+    assert not sched.can_accept(w)
+    assert not sched.complete(w)
+
+
+def test_redistributed_tile_rejects_first_workers_late_result():
+    """At-least-once: after expiry + regrant, the new lease accepts and the
+    result is recorded once."""
+    sched, clock = make(timeout=10.0)
+    w1 = sched.acquire()
+    clock.advance(11.0)
+    sched.sweep()
+    w2 = sched.acquire()
+    assert w2.key == w1.key
+    assert sched.complete(w2)
+    assert not sched.complete(w2)
+
+
+def test_completed_seed_skips_tiles():
+    """Resume: disk-seeded completions (keyed without max_iter) are never
+    regranted — the fix for the reference's broken hash contract."""
+    sched, _ = make(completed={(2, 0, 0), (2, 1, 1)})
+    grants = []
+    while (w := sched.acquire()) is not None:
+        grants.append(w.key)
+    assert grants == [(2, 0, 1), (2, 1, 0)]
+
+
+def test_is_complete():
+    sched, _ = make()
+    while (w := sched.acquire()) is not None:
+        sched.complete(w)
+    assert sched.is_complete()
+    assert sched.acquire() is None
+
+
+def test_acquire_batch():
+    sched, _ = make(levels=((3, 64),))
+    batch = sched.acquire_batch(5)
+    assert len(batch) == 5
+    assert len({w.key for w in batch}) == 5
+    rest = sched.acquire_batch(100)
+    assert len(rest) == 4  # 9 total
+    assert sched.acquire_batch(3) == []
+
+
+def test_reopen_after_failed_persistence():
+    """A tile whose save failed must become grantable again, not a silent
+    hole in a 'complete' run."""
+    sched, _ = make(levels=((1, 16),))
+    w = sched.acquire()
+    assert sched.complete(w)
+    assert sched.is_complete()
+    sched.reopen(w)
+    assert not sched.is_complete()
+    w2 = sched.acquire()
+    assert w2.key == w.key
+    assert sched.complete(w2)
+    sched.reopen(Workload(1, 16, 0, 0))  # idempotent for completed...
+    sched.reopen(Workload(1, 16, 0, 0))  # ...and for already-reopened
+    assert sched.acquire() is not None
+
+
+def test_duplicate_levels_rejected():
+    with pytest.raises(ValueError):
+        TileScheduler([LevelSetting(2, 64), LevelSetting(2, 128)])
+
+
+def test_outstanding_leases_tracks_expiry():
+    sched, clock = make(timeout=10.0)
+    sched.acquire()
+    sched.acquire()
+    assert sched.outstanding_leases == 2
+    clock.advance(11.0)
+    assert sched.outstanding_leases == 0

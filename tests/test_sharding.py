@@ -1,0 +1,132 @@
+"""Sharded-compute tests on the virtual 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core import LevelSetting, TileSpec, Workload
+from distributedmandelbrot_tpu.ops import escape_time
+from distributedmandelbrot_tpu.ops import reference as ref
+from distributedmandelbrot_tpu.parallel import (MeshBackend, ROW_AXIS,
+                                                batched_escape_pixels,
+                                                compute_tile_row_sharded,
+                                                tile_mesh, tile_row_mesh)
+from distributedmandelbrot_tpu.worker import JaxBackend
+
+DEF = 64
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert jax.device_count() >= 8, "conftest should provide 8 CPU devices"
+    return tile_mesh(8)
+
+
+def batch_params(workloads, definition=DEF):
+    params = np.empty((len(workloads), 3))
+    mrds = np.empty(len(workloads), dtype=np.int64)
+    for i, w in enumerate(workloads):
+        spec = TileSpec.for_chunk(w.level, w.index_real, w.index_imag,
+                                  definition=definition)
+        params[i] = (spec.start_real, spec.start_imag,
+                     spec.range_real / (definition - 1))
+        mrds[i] = w.max_iter
+    return params, mrds
+
+
+def assert_tiles_equalish(got, want, frac=0.02):
+    """Different XLA compilations may make different FMA-contraction choices
+    (including in the `start + i*step` grid coordinates, a 1-ulp shift that
+    moves ~1% of pixels across iteration buckets on boundary-dense tiles),
+    so two compiles of the same math are not bitwise comparable.  A 2%
+    budget still catches every sharding-mechanics bug — wrong tile order,
+    wrong row offsets, wrong per-tile max_iter all produce ~100% mismatch."""
+    got, want = np.asarray(got), np.asarray(want)
+    mism = float((got != want).mean())
+    assert mism <= frac, f"{mism:.2%} of pixels differ (budget {frac:.0%})"
+
+
+def golden_like_device_grid(w, max_iter, definition=DEF):
+    """Reference pixels computed on the device-grid coordinates (start +
+    i*step in float32) so the comparison isolates the sharding, not grid
+    generation."""
+    spec = TileSpec.for_chunk(w.level, w.index_real, w.index_imag,
+                              definition=definition)
+    step = np.float32(spec.range_real / (definition - 1))
+    idx = np.arange(definition, dtype=np.float32)
+    cr = (np.float32(spec.start_real) + idx * step)[None, :].repeat(
+        definition, 0).astype(np.float64)
+    ci = (np.float32(spec.start_imag) + idx * step)[:, None].repeat(
+        definition, 1).astype(np.float64)
+    # f32 kernel -> compare against f32 single-device kernel instead of f64
+    counts = np.asarray(escape_time.escape_counts(
+        cr.astype(np.float32), ci.astype(np.float32), max_iter=max_iter))
+    return np.asarray(escape_time.scale_counts_to_uint8(
+        counts, max_iter=max_iter))
+
+
+def test_batched_sharded_matches_single_device(mesh8):
+    """8 tiles over 8 devices == the same tiles one-by-one on one device."""
+    workloads = [Workload(4, 100, i % 4, i // 4) for i in range(8)]
+    params, mrds = batch_params(workloads)
+    got = batched_escape_pixels(mesh8, params, mrds, definition=DEF)
+    assert got.shape == (8, DEF, DEF)
+    for i, w in enumerate(workloads):
+        assert_tiles_equalish(got[i], golden_like_device_grid(w, 100))
+
+
+def test_batched_handles_non_divisible_batch(mesh8):
+    """Batch of 5 on 8 devices: padded internally, unpadded on return."""
+    workloads = [Workload(3, 50, i % 3, i // 3) for i in range(5)]
+    params, mrds = batch_params(workloads)
+    got = batched_escape_pixels(mesh8, params, mrds, definition=DEF)
+    assert got.shape == (5, DEF, DEF)
+    assert_tiles_equalish(got[4], golden_like_device_grid(workloads[4], 50))
+
+
+def test_batched_mixed_max_iter_per_tile(mesh8):
+    """Tiles from different levels carry different budgets; each must be
+    cut at its own max_iter exactly as if computed alone."""
+    workloads = [Workload(2, 30, 0, 0), Workload(4, 120, 1, 2)]
+    params, mrds = batch_params(workloads)
+    got = batched_escape_pixels(mesh8, params, mrds, definition=DEF)
+    for i, w in enumerate(workloads):
+        assert_tiles_equalish(got[i],
+                              golden_like_device_grid(w, w.max_iter))
+
+
+def test_row_sharded_tile_matches_unsharded(mesh8):
+    spec = TileSpec(-0.8, 0.1, 0.2, 0.2, width=DEF, height=DEF)
+    mesh = tile_row_mesh(1, 8)
+    got = compute_tile_row_sharded(mesh, spec, 200)
+    assert got.shape == (DEF, DEF)
+    step = np.float32(spec.range_real / (DEF - 1))
+    idx = np.arange(DEF, dtype=np.float32)
+    cr = np.float32(spec.start_real) + idx[None, :] * step
+    ci = np.float32(spec.start_imag) + idx[:, None] * step
+    counts = np.asarray(escape_time.escape_counts(
+        np.broadcast_to(cr, (DEF, DEF)).astype(np.float32),
+        np.broadcast_to(ci, (DEF, DEF)).astype(np.float32), max_iter=200))
+    expect = np.asarray(escape_time.scale_counts_to_uint8(counts,
+                                                          max_iter=200))
+    assert_tiles_equalish(got, expect)
+
+
+def test_row_sharded_rejects_indivisible_height():
+    mesh = tile_row_mesh(1, 8)
+    with pytest.raises(ValueError):
+        compute_tile_row_sharded(mesh, TileSpec(0, 0, 1, 1, width=60,
+                                                height=60), 10)
+
+
+def test_mesh_backend_end_to_end(mesh8):
+    """MeshBackend fulfills the ComputeBackend contract over the mesh."""
+    backend = MeshBackend(definition=DEF, mesh=mesh8)
+    workloads = [Workload(4, 64, i, j) for i in range(2) for j in range(2)]
+    out = backend.compute_batch(workloads)
+    assert len(out) == 4
+    for pixels, w in zip(out, workloads):
+        assert pixels.shape == (DEF * DEF,)
+        assert pixels.dtype == np.uint8
+        assert_tiles_equalish(pixels, golden_like_device_grid(w, 64).ravel())
+    assert backend.compute_batch([]) == []

@@ -1,0 +1,137 @@
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core import CHUNK_PIXELS, Chunk
+from distributedmandelbrot_tpu.storage import (ChunkStore, CorruptIndexError,
+                                               EntryType, IndexEntry,
+                                               scan_entries)
+
+
+def patterned_chunk(level=4, i=1, j=2, period=97):
+    data = (np.arange(CHUNK_PIXELS) % period).astype(np.uint8)
+    return Chunk(level, i, j, data)
+
+
+def test_index_entry_byte_format():
+    """Byte-compatible with the reference: u32 x3 + int32 type
+    (+ int32 len + ASCII name for Regular)."""
+    e = IndexEntry(10, 3, 7, EntryType.REGULAR, "10;3;7")
+    assert e.to_bytes() == struct.pack("<IIIi", 10, 3, 7, 0) + \
+        struct.pack("<i", 6) + b"10;3;7"
+    n = IndexEntry(10, 3, 7, EntryType.NEVER)
+    assert n.to_bytes() == struct.pack("<IIIi", 10, 3, 7, 1)
+    i = IndexEntry(10, 3, 7, EntryType.IMMEDIATE)
+    assert i.to_bytes() == struct.pack("<IIIi", 10, 3, 7, 2)
+
+
+def test_index_scan_roundtrip():
+    entries = [IndexEntry(4, 0, 0, EntryType.NEVER),
+               IndexEntry(4, 1, 2, EntryType.REGULAR, "4;1;2"),
+               IndexEntry(20, 19, 19, EntryType.IMMEDIATE)]
+    blob = b"".join(e.to_bytes() for e in entries)
+    assert list(scan_entries(io.BytesIO(blob))) == entries
+
+
+def test_index_scan_tolerates_torn_tail():
+    good = IndexEntry(4, 0, 0, EntryType.NEVER).to_bytes()
+    torn = IndexEntry(4, 1, 2, EntryType.REGULAR, "4;1;2").to_bytes()[:-3]
+    got = list(scan_entries(io.BytesIO(good + torn)))
+    assert len(got) == 1 and got[0].key == (4, 0, 0)
+    with pytest.raises(CorruptIndexError):
+        list(scan_entries(io.BytesIO(good + torn), tolerate_torn_tail=False))
+
+
+def test_index_scan_rejects_bad_type():
+    blob = struct.pack("<IIIi", 4, 0, 0, 99)
+    with pytest.raises(CorruptIndexError):
+        list(scan_entries(io.BytesIO(blob)))
+
+
+def test_entry_validation():
+    with pytest.raises(ValueError):
+        IndexEntry(4, 0, 0, EntryType.REGULAR)  # missing filename
+    with pytest.raises(ValueError):
+        IndexEntry(4, 0, 0, EntryType.NEVER, "oops")
+
+
+def test_store_save_load_regular(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    chunk = patterned_chunk()
+    entry = store.save(chunk)
+    assert entry.type == EntryType.REGULAR
+    assert entry.filename == "4;1;2"
+    loaded = store.load(4, 1, 2)
+    np.testing.assert_array_equal(loaded.data, chunk.data)
+
+
+def test_store_save_special_chunks_are_tag_only(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    store.save(Chunk.never(4, 0, 0))
+    store.save(Chunk.immediate(4, 0, 1))
+    # No chunk files written, only the index.
+    files = {p.name for p in (tmp_path / "Data").iterdir()}
+    assert files == {"_index.dat"}
+    assert store.load(4, 0, 0).is_never
+    assert store.load(4, 0, 1).is_immediate
+
+
+def test_store_missing_chunk_returns_none(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    assert store.load(4, 3, 3) is None
+
+
+def test_store_filename_collision_suffix(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    c1 = patterned_chunk(period=11)
+    c2 = patterned_chunk(period=13)
+    e1 = store.save(c1)
+    e2 = store.save(c2)  # same key -> collision -> suffix
+    assert e1.filename == "4;1;2"
+    assert e2.filename == "4;1;20"
+    # Duplicate keys: the most recent save wins on load.
+    np.testing.assert_array_equal(store.load(4, 1, 2).data, c2.data)
+
+
+def test_store_load_many_single_scan(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    store.save(Chunk.never(4, 0, 0))
+    store.save(patterned_chunk(4, 1, 2))
+    got = store.load_many([(4, 0, 0), (4, 3, 3), (4, 1, 2)])
+    assert got[0].is_never
+    assert got[1] is None
+    assert got[2].key == (4, 1, 2)
+
+
+def test_store_completed_keys_resume_filtering(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    store.save(Chunk.never(4, 0, 0))
+    store.save(Chunk.never(10, 5, 5))
+    store.save(Chunk.never(20, 7, 7))
+    assert store.completed_keys() == {(4, 0, 0), (10, 5, 5), (20, 7, 7)}
+    assert store.completed_keys(levels=[4, 20]) == {(4, 0, 0), (20, 7, 7)}
+    # A fresh store instance over the same dir sees the same state (restart).
+    store2 = ChunkStore(str(tmp_path))
+    assert store2.completed_keys(levels=[10]) == {(10, 5, 5)}
+
+
+def test_store_payload_cache_roundtrip(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    chunk = patterned_chunk()
+    store.save(chunk)
+    p1 = store.load_payload(4, 1, 2)
+    p2 = store.load_payload(4, 1, 2)  # cached
+    assert p1 is p2
+    np.testing.assert_array_equal(Chunk.deserialize_data(p1), chunk.data)
+    assert store.load_payload(4, 3, 3) is None
+
+
+def test_store_survives_torn_index_tail(tmp_path):
+    store = ChunkStore(str(tmp_path))
+    store.save(Chunk.never(4, 0, 0))
+    with open(store.index_path, "ab") as f:
+        f.write(b"\x04\x00\x00")  # torn append
+    store2 = ChunkStore(str(tmp_path))
+    assert store2.completed_keys() == {(4, 0, 0)}
