@@ -96,6 +96,9 @@ def _make_backend(name: str, dtype: str):
     if name == "numpy":
         from distributedmandelbrot_tpu.worker import NumpyBackend
         return NumpyBackend()
+    if name == "native":
+        from distributedmandelbrot_tpu.worker import NativeBackend
+        return NativeBackend()
     if name == "jax":
         from distributedmandelbrot_tpu.worker import JaxBackend
         return JaxBackend(dtype=np_dtype)
@@ -112,7 +115,7 @@ def cmd_worker(argv: Sequence[str]) -> int:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int,
                         default=proto.DEFAULT_DISTRIBUTER_PORT)
-    parser.add_argument("--backend", choices=["jax", "numpy", "mesh"],
+    parser.add_argument("--backend", choices=["jax", "numpy", "native", "mesh"],
                         default="jax")
     parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
     parser.add_argument("--batch-size", type=int, default=0,

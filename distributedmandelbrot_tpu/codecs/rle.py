@@ -26,10 +26,22 @@ def find_runs(data: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return (ends - starts).astype(np.uint32), data[starts]
 
 
+def _native():
+    """The native module when usable, else None (lazy; never raises)."""
+    try:
+        from distributedmandelbrot_tpu import native
+        return native if native.native_supported() else None
+    except Exception:  # pragma: no cover - import/build environment issues
+        return None
+
+
 class RleCodec:
     code = 0x01
 
     def encode(self, data: np.ndarray) -> bytes:
+        native = _native()
+        if native is not None:
+            return native.rle_encode(data)
         counts, values = find_runs(data)
         records = np.empty(counts.size, dtype=_REC_DTYPE)
         records["count"] = counts
@@ -37,6 +49,12 @@ class RleCodec:
         return records.tobytes()
 
     def decode(self, body: bytes, expected_size: int) -> np.ndarray:
+        native = _native()
+        if native is not None:
+            return native.rle_decode(body, expected_size)
+        return self._decode_py(body, expected_size)
+
+    def _decode_py(self, body: bytes, expected_size: int) -> np.ndarray:
         if len(body) % _REC_DTYPE.itemsize != 0:
             raise ValueError(
                 f"RLE body length {len(body)} is not a multiple of "
@@ -52,5 +70,8 @@ class RleCodec:
         return np.repeat(records["value"], counts)
 
     def encoded_size(self, data: np.ndarray) -> int:
+        native = _native()
+        if native is not None:
+            return native.rle_encoded_size(data)
         counts, _ = find_runs(data)
         return counts.size * _REC_DTYPE.itemsize

@@ -1,0 +1,99 @@
+"""numpy-facing wrappers over the native library (None-safe: callers check
+:func:`distributedmandelbrot_tpu.native.build.available` or catch
+``RuntimeError`` and fall back to the Python paths)."""
+
+from __future__ import annotations
+
+import ctypes
+import sys
+
+import numpy as np
+
+from distributedmandelbrot_tpu.native import build
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_F64P = ctypes.POINTER(ctypes.c_double)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+
+
+def _lib():
+    lib = build.load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return lib
+
+
+def _u8ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(_U8P)
+
+
+def native_supported() -> bool:
+    # The record format is little-endian; the C++ writes host-endian.
+    return sys.byteorder == "little" and build.available()
+
+
+def rle_encoded_size(data: np.ndarray) -> int:
+    data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    return int(_lib().dmtpu_rle_encoded_size(_u8ptr(data), data.size))
+
+
+def rle_encode(data: np.ndarray) -> bytes:
+    lib = _lib()
+    data = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    size = int(lib.dmtpu_rle_encoded_size(_u8ptr(data), data.size))
+    out = np.empty(size, dtype=np.uint8)
+    written = int(lib.dmtpu_rle_encode(_u8ptr(data), data.size,
+                                       _u8ptr(out), out.size))
+    if written != size:
+        raise RuntimeError(f"native RLE encode wrote {written}, "
+                           f"expected {size}")
+    return out.tobytes()
+
+
+def rle_decode(body: bytes, expected_size: int) -> np.ndarray:
+    lib = _lib()
+    src = np.frombuffer(body, dtype=np.uint8)
+    out = np.empty(expected_size, dtype=np.uint8)
+    rc = int(lib.dmtpu_rle_decode(_u8ptr(src), src.size, _u8ptr(out),
+                                  out.size))
+    if rc == -1:
+        raise ValueError(
+            f"RLE body length {len(body)} is not a multiple of 5")
+    if rc == -2:
+        raise ValueError("encountered RLE run of length 0")
+    if rc in (-3, -4):
+        raise ValueError(f"RLE decodes to the wrong total "
+                         f"(expected {expected_size})")
+    if rc != 0:
+        raise RuntimeError(f"native RLE decode failed: {rc}")
+    return out
+
+
+def escape_pixels(c_real: np.ndarray, c_imag: np.ndarray, max_iter: int, *,
+                  clamp: bool = False, n_threads: int = 0) -> np.ndarray:
+    """uint8 pixels, bit-identical to the numpy golden path, multithreaded."""
+    lib = _lib()
+    c_real = np.ascontiguousarray(c_real, dtype=np.float64).ravel()
+    c_imag = np.ascontiguousarray(c_imag, dtype=np.float64).ravel()
+    if c_real.size != c_imag.size:
+        raise ValueError("coordinate arrays must have equal size")
+    out = np.empty(c_real.size, dtype=np.uint8)
+    lib.dmtpu_escape_pixels_f64(
+        c_real.ctypes.data_as(_F64P), c_imag.ctypes.data_as(_F64P),
+        c_real.size, max_iter, int(clamp), _u8ptr(out), n_threads)
+    return out
+
+
+def escape_counts(c_real: np.ndarray, c_imag: np.ndarray, max_iter: int, *,
+                  n_threads: int = 0) -> np.ndarray:
+    """Raw int32 escape counts (for smooth coloring / analysis)."""
+    lib = _lib()
+    c_real = np.ascontiguousarray(c_real, dtype=np.float64).ravel()
+    c_imag = np.ascontiguousarray(c_imag, dtype=np.float64).ravel()
+    if c_real.size != c_imag.size:
+        raise ValueError("coordinate arrays must have equal size")
+    out = np.empty(c_real.size, dtype=np.int32)
+    lib.dmtpu_escape_counts_f64(
+        c_real.ctypes.data_as(_F64P), c_imag.ctypes.data_as(_F64P),
+        c_real.size, max_iter, out.ctypes.data_as(_I32P), n_threads)
+    return out

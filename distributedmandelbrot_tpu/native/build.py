@@ -1,0 +1,106 @@
+"""Lazy native build: compile the C++ sources into one shared library.
+
+Built on first use with g++ (cached; rebuilt when sources change), loaded
+via ctypes.  Everything native is optional — callers fall back to the pure
+numpy paths when the toolchain or library is unavailable, and
+``DMTPU_NATIVE=0`` disables it outright.
+
+``-ffp-contract=off`` is load-bearing: it keeps the escape kernel's float64
+arithmetic bit-identical to the numpy golden (XLA's FMA contraction is
+exactly what makes the JAX paths *non*-bit-exact; see ops/escape_time.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger("dmtpu.native")
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libdmtpu_native.so")
+_SOURCES = ("rle.cc", "escape.cc")
+
+_CXXFLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17", "-ffp-contract=off",
+             "-pthread"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(os.path.getmtime(os.path.join(_SRC_DIR, s)) > lib_mtime
+               for s in _SOURCES)
+
+
+def _build() -> None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    sources = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    tmp = _LIB_PATH + ".tmp"
+    cmd = ["g++", *_CXXFLAGS, "-o", tmp, *sources]
+    logger.info("building native library: %s", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, _LIB_PATH)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on demand; None when unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried:
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DMTPU_NATIVE", "1") == "0":
+            logger.info("native library disabled via DMTPU_NATIVE=0")
+            return None
+        try:
+            if _needs_build():
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            logger.warning("native library unavailable, using pure-Python "
+                           "paths: %s", detail.strip()[:500])
+            return None
+        _configure(lib)
+        _lib = lib
+        return _lib
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.dmtpu_rle_encoded_size.restype = ctypes.c_size_t
+    lib.dmtpu_rle_encoded_size.argtypes = [u8p, ctypes.c_size_t]
+    lib.dmtpu_rle_encode.restype = ctypes.c_size_t
+    lib.dmtpu_rle_encode.argtypes = [u8p, ctypes.c_size_t, u8p,
+                                     ctypes.c_size_t]
+    lib.dmtpu_rle_decode.restype = ctypes.c_int
+    lib.dmtpu_rle_decode.argtypes = [u8p, ctypes.c_size_t, u8p,
+                                     ctypes.c_size_t]
+    lib.dmtpu_escape_pixels_f64.restype = None
+    lib.dmtpu_escape_pixels_f64.argtypes = [f64p, f64p, ctypes.c_size_t,
+                                            ctypes.c_int32, ctypes.c_int,
+                                            u8p, ctypes.c_int]
+    lib.dmtpu_escape_counts_f64.restype = None
+    lib.dmtpu_escape_counts_f64.argtypes = [f64p, f64p, ctypes.c_size_t,
+                                            ctypes.c_int32, i32p,
+                                            ctypes.c_int]
+
+
+def available() -> bool:
+    return load() is not None

@@ -2,9 +2,10 @@
 
 from distributedmandelbrot_tpu.worker.backends import (ComputeBackend,
                                                        JaxBackend,
+                                                       NativeBackend,
                                                        NumpyBackend)
 from distributedmandelbrot_tpu.worker.client import DistributerClient
 from distributedmandelbrot_tpu.worker.worker import Worker
 
-__all__ = ["ComputeBackend", "JaxBackend", "NumpyBackend",
+__all__ = ["ComputeBackend", "JaxBackend", "NativeBackend", "NumpyBackend",
            "DistributerClient", "Worker"]

@@ -1,0 +1,97 @@
+"""Native C++ paths: build, parity with the Python/golden implementations."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu import native
+from distributedmandelbrot_tpu.codecs.rle import RleCodec, find_runs
+from distributedmandelbrot_tpu.core import TileSpec
+from distributedmandelbrot_tpu.ops import reference as ref
+
+pytestmark = pytest.mark.skipif(not native.native_supported(),
+                                reason="native library unavailable")
+
+
+def test_rle_encode_matches_python():
+    rng = np.random.default_rng(3)
+    for _ in range(5):
+        runs = rng.integers(1, 40, size=rng.integers(1, 200))
+        vals = rng.integers(0, 5, size=runs.size).astype(np.uint8)
+        data = np.repeat(vals, runs)
+        counts, values = find_runs(data)
+        py_records = b"".join(struct.pack("<IB", c, v)
+                              for c, v in zip(counts, values))
+        assert native.rle_encode(data) == py_records
+
+
+def test_rle_decode_roundtrip_and_errors():
+    data = np.repeat(np.array([7, 0, 255], np.uint8), [1000, 1, 65536])
+    body = native.rle_encode(data)
+    np.testing.assert_array_equal(native.rle_decode(body, data.size), data)
+    with pytest.raises(ValueError):
+        native.rle_decode(body[:-1], data.size)  # not a multiple of 5
+    with pytest.raises(ValueError):
+        native.rle_decode(struct.pack("<IB", 0, 1), 0)  # zero run
+    with pytest.raises(ValueError):
+        native.rle_decode(struct.pack("<IB", 9, 1), 4)  # overflow
+    with pytest.raises(ValueError):
+        native.rle_decode(struct.pack("<IB", 2, 1), 4)  # underfill
+
+
+def test_codec_uses_native_transparently():
+    """RleCodec must produce identical bytes whichever path is active."""
+    codec = RleCodec()
+    data = np.repeat(np.arange(16, dtype=np.uint8), 1000)
+    body = codec.encode(data)
+    counts, values = find_runs(data)
+    assert len(body) == counts.size * 5
+    np.testing.assert_array_equal(codec.decode(body, data.size), data)
+
+
+@pytest.mark.parametrize("max_iter", [16, 256, 1000])
+def test_escape_pixels_bit_identical_to_golden(max_iter):
+    """The native kernel (built with -ffp-contract=off) is the fast
+    bit-exact parity anchor: byte-for-byte equal to the numpy golden."""
+    spec = TileSpec(-0.8, 0.1, 0.2, 0.2, width=96, height=96)
+    cr, ci = spec.grid_2d()
+    golden = ref.scale_counts_to_uint8(
+        ref.escape_counts(cr, ci, max_iter), max_iter).ravel()
+    got = native.escape_pixels(cr, ci, max_iter)
+    np.testing.assert_array_equal(got, golden)
+    # Multithreading must not change results.
+    got4 = native.escape_pixels(cr, ci, max_iter, n_threads=4)
+    np.testing.assert_array_equal(got4, golden)
+
+
+def test_escape_counts_matches_golden():
+    spec = TileSpec(-0.2, -0.1, 0.4, 0.4, width=64, height=64)
+    cr, ci = spec.grid_2d()
+    golden = ref.escape_counts(cr, ci, 300)
+    np.testing.assert_array_equal(
+        native.escape_counts(cr, ci, 300).reshape(golden.shape), golden)
+
+
+def test_native_backend_end_to_end():
+    from distributedmandelbrot_tpu.core import Workload
+    from distributedmandelbrot_tpu.worker import NativeBackend
+
+    backend = NativeBackend(definition=64)
+    [pixels] = backend.compute_batch([Workload(4, 64, 1, 2)])
+    spec = TileSpec.for_chunk(4, 1, 2, definition=64)
+    cr, ci = spec.grid_2d()
+    golden = ref.scale_counts_to_uint8(
+        ref.escape_counts(cr, ci, 64), 64).ravel()
+    np.testing.assert_array_equal(pixels, golden)
+
+
+def test_scaling_wrap_parity_native():
+    """The uint8 wrap at the escape ceiling must match the reference."""
+    spec = TileSpec(0.25, 0.0, 0.02, 0.02, width=32, height=32)
+    cr, ci = spec.grid_2d()
+    golden = ref.scale_counts_to_uint8(ref.escape_counts(cr, ci, 1000), 1000)
+    got = native.escape_pixels(cr, ci, 1000)
+    np.testing.assert_array_equal(got, golden.ravel())
+    clamped = native.escape_pixels(cr, ci, 1000, clamp=True)
+    assert (clamped >= got).all()
