@@ -2,15 +2,20 @@
 
 The reference's CUDA kernel (``DistributedMandelbrotWorkerCUDA.py:39-68``)
 returns per-pixel at the escape iteration.  SIMD/vector hardware has no
-per-element early return, so the TPU-native form is *masked iteration*:
-every pixel advances under a mask that freezes it once escaped (freezing
-also prevents inf/nan pollution from continued squaring).  Early exit is
-recovered at tile granularity with a segmented ``lax.while_loop`` — run
-``segment`` masked iterations at a time (an unrolled ``fori_loop`` body XLA
-fuses into one elementwise loop nest), then stop when the whole tile has
-escaped or the iteration budget is spent.  For typical views most of the
-tile escapes early, so segments capture most of the CUDA early-exit win
-without data-dependent control flow inside the hot loop.
+per-element early return, so the TPU-native form is *masked iteration* in
+the select-free shape of :func:`escape_loop`: every pixel keeps iterating
+unconditionally (escaped orbits diverge to inf, possibly NaN via inf-inf
+— harmless, since a sticky ``active`` mask stops their count from
+advancing and the final count is recovered arithmetically).  Because
+inf/NaN in escaped lanes is *by design*, ``jax_debug_nans`` /
+``jax_debug_infs`` will abort on perfectly valid renders — leave them off
+around these kernels.  Early exit is recovered at tile granularity with a
+segmented ``lax.while_loop`` — run ``segment`` unconditional iterations
+at a time (an unrolled body XLA fuses into one elementwise loop nest),
+then stop when the whole tile has escaped or the iteration budget is
+spent.  For typical views most of the tile escapes early, so segments
+capture most of the CUDA early-exit win without data-dependent control
+flow inside the hot loop.
 
 Two precision paths:
 
@@ -49,6 +54,87 @@ from distributedmandelbrot_tpu.utils.precision import ensure_x64
 
 DEFAULT_SEGMENT = 32
 
+# Cap on how many escape iterations are ever unrolled into a flat op chain.
+# Segments larger than this run as an inner fori_loop of MAX_UNROLL-step
+# unrolled bodies: identical semantics, but compile time stays bounded —
+# XLA:CPU's backend goes superlinear past a few hundred unrolled steps
+# (seg=299 f64: >9 min flat vs 0.9 s capped) and Mosaic blows up similarly.
+MAX_UNROLL = 64
+
+
+def unrolled_steps(step_fn, state, segment: int, max_unroll: int = MAX_UNROLL):
+    """Apply ``step_fn`` ``segment`` times: fori_loop over full
+    ``max_unroll``-step unrolled chunks, remainder unrolled flat."""
+    full, rem = divmod(segment, max_unroll)
+    if full:
+        def chunk(_, s):
+            for _ in range(max_unroll):
+                s = step_fn(s)
+            return s
+        state = lax.fori_loop(0, full, chunk, state) if full > 1 else \
+            chunk(0, state)
+    for _ in range(rem):
+        state = step_fn(state)
+    return state
+
+
+def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int):
+    """The shared segmented escape recurrence (single source of truth for
+    the XLA, sharded, and Pallas kernels).
+
+    Select-free form: escaped pixels are never frozen — they keep iterating
+    (diverging to inf, then possibly NaN via inf-inf) while a sticky
+    ``active`` mask, cleared at the first ``|z|^2 >= 4`` test, stops their
+    count from advancing.  The stickiness matters: exact arithmetic
+    guarantees ``|z|`` can never re-enter the bailout disk once outside
+    (for ``|c| <= 2``, ``|z_new| >= |z|^2 - |c| >= 2``; the square's
+    corners ``|c| in (2, 2*sqrt(2)]`` escape at iteration 1 and grow as
+    ``|z_{k+1}| >= |z_k|(|z_k|-1)``), but the inequality is tight at the
+    boundary and floating-point rounding could momentarily dip a
+    barely-escaped orbit back under 4 — the mask makes the recorded count
+    immune to that (and to any downstream NaN comparison semantics).
+
+    The escape iteration is recovered arithmetically: ``n`` counts the
+    updates a pixel stayed bounded through, so a pixel escaping at ``e``
+    has ``n = e - 1``, and ``n >= total_steps`` means "never escaped
+    within budget" -> 0 (this also cancels escapes recorded during the
+    last segment's overrun past ``total_steps``).  Per pixel per iteration
+    the loop costs 5 mul/add, 1 compare, 1 and, 1 count add.
+
+    ``zr0``/``zi0`` are the initial ``z`` (normally equal to ``c``; passed
+    explicitly so shard_map callers can derive them with the union of both
+    inputs' varying manual axes).  Returns int32 escape counts.
+    """
+    four = jnp.asarray(4.0, jnp.result_type(zr0))
+    segment = max(1, min(segment, total_steps))
+
+    def one_step(state):
+        zr, zi, zr2, zi2, active, n = state
+        zi = (zr + zr) * zi + c_imag
+        zr = zr2 - zi2 + c_real
+        zr2 = zr * zr
+        zi2 = zi * zi
+        active = active & (zr2 + zi2 < four)
+        n = n + active.astype(jnp.int32)
+        return (zr, zi, zr2, zi2, active, n)
+
+    def segment_body(carry):
+        state, it = carry
+        # Fixed-trip segment; unroll capped so compile time stays bounded.
+        return (unrolled_steps(one_step, state, segment), it + segment)
+
+    def segment_cond(carry):
+        state, it = carry
+        # Keep going while budget remains and any pixel is still bounded.
+        return (it <= total_steps) & jnp.any(state[4])
+
+    mix = zr0 * 0 + zi0 * 0  # union of varying axes under shard_map
+    init = ((zr0, zi0, zr0 * zr0, zi0 * zi0, mix == 0,
+             mix.astype(jnp.int32)), jnp.asarray(1, jnp.int32))
+    (zr, zi, zr2, zi2, active, n), it = lax.while_loop(
+        segment_cond, segment_body, init)
+    return jnp.where(n >= total_steps, 0, n + 1)
+
 
 def escape_counts(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
                   segment: int = DEFAULT_SEGMENT) -> jax.Array:
@@ -74,48 +160,12 @@ def _escape_counts_jit(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
     dtype = jnp.result_type(c_real)
     c_real = c_real.astype(dtype)
     c_imag = c_imag.astype(dtype)
-    four = jnp.asarray(4.0, dtype)
-    two = jnp.asarray(2.0, dtype)
 
     total_steps = max_iter - 1  # iterations 1 .. max_iter-1
     if total_steps <= 0:
         return jnp.zeros(c_real.shape, jnp.int32)
-    segment = max(1, min(segment, total_steps))
-
-    def one_step(state, it):
-        zr, zi, counts = state
-        active = counts == 0
-        new_zr = zr * zr - zi * zi + c_real
-        new_zi = two * zr * zi + c_imag
-        zr = jnp.where(active, new_zr, zr)
-        zi = jnp.where(active, new_zi, zi)
-        escaped = active & (zr * zr + zi * zi >= four)
-        counts = jnp.where(escaped, it, counts)
-        return (zr, zi, counts)
-
-    def segment_body(carry):
-        zr, zi, counts, it = carry
-        state = (zr, zi, counts)
-        # Unrolled fixed-trip segment; `it + k` stays a traced scalar.
-        for k in range(segment):
-            state = one_step(state, it + k)
-        zr, zi, counts = state
-        return (zr, zi, counts, it + segment)
-
-    def segment_cond(carry):
-        zr, zi, counts, it = carry
-        # Keep going while budget remains and any pixel is still active.
-        # Pixels that never escape stay active to the end, exactly like the
-        # reference's full-depth loop.
-        return (it <= total_steps) & jnp.any(counts == 0)
-
-    init = (c_real, c_imag, jnp.zeros(c_real.shape, jnp.int32),
-            jnp.asarray(1, jnp.int32))
-    zr, zi, counts, it = lax.while_loop(segment_cond, segment_body, init)
-    # The last segment may overrun past total_steps; cancel counts recorded
-    # beyond the budget (they belong to iterations the reference never runs).
-    counts = jnp.where(counts > total_steps, 0, counts)
-    return counts
+    return escape_loop(c_real, c_imag, c_real, c_imag,
+                       total_steps=total_steps, segment=segment)
 
 
 def scale_counts_to_uint8(counts: jax.Array, *, max_iter: int,

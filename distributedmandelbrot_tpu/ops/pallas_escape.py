@@ -26,6 +26,7 @@ import numpy as np
 from jax import lax
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
+from distributedmandelbrot_tpu.ops.escape_time import escape_loop
 
 
 def _pallas():
@@ -35,7 +36,8 @@ def _pallas():
     from jax.experimental.pallas import tpu as pltpu
     return pl, pltpu
 
-DEFAULT_BLOCK_H = 256
+DEFAULT_BLOCK_H = 128  # 5 f32 + 1 i32 carries x 128x1024 ~ 3 MB, well under
+                       # the ~16 MB scoped-VMEM limit (256 rows OOMed at 23.5M)
 DEFAULT_SEGMENT = 32
 
 
@@ -55,40 +57,16 @@ def _escape_block_kernel(params_ref, out_ref, *, max_iter: int, segment: int,
     c_real = start_r + col.astype(dtype) * step
     c_imag = start_i + row.astype(dtype) * step
 
-    four = jnp.asarray(4.0, dtype)
-    two = jnp.asarray(2.0, dtype)
     total_steps = max_iter - 1
 
-    def one_step(state, it):
-        zr, zi, counts = state
-        active = counts == 0
-        new_zr = zr * zr - zi * zi + c_real
-        new_zi = two * zr * zi + c_imag
-        zr = jnp.where(active, new_zr, zr)
-        zi = jnp.where(active, new_zi, zi)
-        escaped = active & (zr * zr + zi * zi >= four)
-        counts = jnp.where(escaped, it, counts)
-        return (zr, zi, counts)
-
-    def body(carry):
-        zr, zi, counts, it = carry
-        state = (zr, zi, counts)
-        for k in range(segment):
-            state = one_step(state, it + k)
-        zr, zi, counts = state
-        return (zr, zi, counts, it + segment)
-
-    def cond(carry):
-        _, _, counts, it = carry
-        return (it <= total_steps) & jnp.any(counts == 0)
-
+    # Shared recurrence with the XLA/sharded paths — see
+    # ops/escape_time.py:escape_loop for the select-free form, the sticky
+    # active mask, and the count recovery.
     if total_steps <= 0:
         counts = jnp.zeros(shape, jnp.int32)
     else:
-        init = (c_real, c_imag, jnp.zeros(shape, jnp.int32),
-                jnp.asarray(1, jnp.int32))
-        _, _, counts, _ = lax.while_loop(cond, body, init)
-        counts = jnp.where(counts > total_steps, 0, counts)
+        counts = escape_loop(c_real, c_imag, c_real, c_imag,
+                             total_steps=total_steps, segment=segment)
 
     vals = (counts * 256 + (max_iter - 1)) // max_iter
     if clamp:

@@ -36,7 +36,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
-from distributedmandelbrot_tpu.ops.escape_time import DEFAULT_SEGMENT
+from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
+                                                       escape_loop)
 from distributedmandelbrot_tpu.parallel.mesh import ROW_AXIS, TILE_AXIS
 
 try:
@@ -55,50 +56,22 @@ def _device_grid(start_r, start_i, step, shape, dtype, row_offset=0):
 
 
 def _masked_escape(c_real, c_imag, max_iter_cap: int, segment: int):
-    """The segmented masked escape loop (same semantics as ops.escape_time)."""
-    dtype = c_real.dtype
-    four = jnp.asarray(4.0, dtype)
-    two = jnp.asarray(2.0, dtype)
+    """The segmented escape loop (ops.escape_time.escape_loop; see there
+    for the recurrence and count recovery)."""
     total_steps = max_iter_cap - 1
     if total_steps <= 0:
         return jnp.zeros(c_real.shape, jnp.int32)
-    segment = max(1, min(segment, total_steps))
 
-    def one_step(state, it):
-        zr, zi, counts = state
-        active = counts == 0
-        new_zr = zr * zr - zi * zi + c_real
-        new_zi = two * zr * zi + c_imag
-        zr = jnp.where(active, new_zr, zr)
-        zi = jnp.where(active, new_zi, zi)
-        escaped = active & (zr * zr + zi * zi >= four)
-        counts = jnp.where(escaped, it, counts)
-        return (zr, zi, counts)
-
-    def body(carry):
-        zr, zi, counts, it = carry
-        state = (zr, zi, counts)
-        for k in range(segment):
-            state = one_step(state, it + k)
-        zr, zi, counts = state
-        return (zr, zi, counts, it + segment)
-
-    def cond(carry):
-        _, _, counts, it = carry
-        return (it <= total_steps) & jnp.any(counts == 0)
-
-    # Derive every carry from BOTH coordinate arrays rather than fresh
-    # constants (or one input alone) so that, under shard_map, each carry
-    # has the union of the inputs' varying-manual-axes — e.g. in the
-    # row-sharded path c_imag varies over the rows axis but c_real is
-    # replicated, and a carry typed off only one of them fails while_loop
-    # typing when the body mixes in the other.
+    # Derive the initial z from BOTH coordinate arrays rather than one
+    # input alone so that, under shard_map, every while_loop carry has the
+    # union of the inputs' varying-manual-axes — e.g. in the row-sharded
+    # path c_imag varies over the rows axis but c_real is replicated, and
+    # a carry typed off only one of them fails while_loop typing when the
+    # body mixes in the other.
     zr0 = c_real + 0.0 * c_imag
     zi0 = c_imag + 0.0 * c_real
-    counts0 = (zr0 * 0).astype(jnp.int32)
-    init = (zr0, zi0, counts0, jnp.asarray(1, jnp.int32))
-    _, _, counts, _ = lax.while_loop(cond, body, init)
-    return jnp.where(counts > total_steps, 0, counts)
+    return escape_loop(zr0, zi0, c_real, c_imag, total_steps=total_steps,
+                       segment=segment)
 
 
 def _scale_pixels(counts, mrd, clamp: bool):
