@@ -107,6 +107,111 @@ def bench_throughput(tile: int, tiles: int, max_iter: int, dtype: str,
     }
 
 
+def _mpix(pixels: int, seconds: float) -> float:
+    return pixels / seconds / 1e6
+
+
+def bench_config1(repeats: int) -> dict:
+    """BASELINE config 1: 256^2, max_iter=256, full view, CPU reference path."""
+    from distributedmandelbrot_tpu.core.geometry import TileSpec
+    from distributedmandelbrot_tpu.ops import reference as ref
+
+    spec = TileSpec(-2.0, -1.25, 2.5, 2.5, width=256, height=256)
+    cr, ci = spec.grid_2d()
+
+    def run():
+        ref.scale_counts_to_uint8(ref.escape_counts(cr, ci, 256), 256)
+
+    v = _mpix(256 * 256, _time_best(run, repeats))
+    return {"metric": "config1 CPU-reference 256^2 mi=256 full view",
+            "value": round(v, 2), "unit": "Mpix/s"}
+
+
+def bench_config2(repeats: int, segment: int) -> dict:
+    """BASELINE config 2: 1024^2, max_iter=1000, seahorse, one device."""
+    from distributedmandelbrot_tpu.core.geometry import TileSpec
+    from distributedmandelbrot_tpu.ops import compute_tile
+    span = 0.005
+    spec = TileSpec(SEAHORSE[0], SEAHORSE[1], span, span,
+                    width=1024, height=1024)
+    times = []
+    compute_tile(spec, 1000, segment=segment)  # warmup/compile
+    for _ in range(max(repeats * 3, 5)):  # per-tile turnaround distribution
+        t0 = time.perf_counter()
+        compute_tile(spec, 1000, segment=segment)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    p50 = times[len(times) // 2]
+    return {"metric": "config2 single-device 1024^2 mi=1000 seahorse",
+            "value": round(_mpix(1024 * 1024, min(times)), 2),
+            "unit": "Mpix/s", "p50_tile_turnaround_s": round(p50, 4)}
+
+
+def bench_config3(repeats: int, segment: int) -> dict:
+    """BASELINE config 3: 8x1024^2 batch, max_iter=5000, mesh-sharded,
+    plus 1->N scaling efficiency."""
+    jax, mesh, batched_escape_pixels = _mesh_and_kernel()
+    params = _bench_params(1024, 8)
+    mrds = np.full(8, 5000, dtype=np.int64)
+
+    def run_mesh(m):
+        return lambda: batched_escape_pixels(m, params, mrds, definition=1024,
+                                             dtype=np.float32, segment=segment)
+
+    t_n = _time_best(run_mesh(mesh), repeats)
+    out = {"metric": f"config3 {mesh.devices.size}-device 8x1024^2 mi=5000",
+           "value": round(_mpix(8 * 1024 * 1024, t_n), 2), "unit": "Mpix/s"}
+    if mesh.devices.size > 1:
+        from distributedmandelbrot_tpu.parallel import tile_mesh
+        t_1 = _time_best(run_mesh(tile_mesh(1)), repeats)
+        out["scaling_efficiency_1_to_n"] = round(
+            t_1 / (t_n * mesh.devices.size), 3)
+    return out
+
+
+def bench_config4(repeats: int) -> dict:
+    """BASELINE config 4: deep zoom at scale 1e-10, max_iter=50000,
+    float64 + smooth coloring (128^2 probe tile)."""
+    from distributedmandelbrot_tpu.core.geometry import TileSpec
+    from distributedmandelbrot_tpu.ops import compute_tile_smooth
+
+    # Misiurewicz-point neighborhood: boundary-rich at every depth.
+    spec = TileSpec(-0.77568377, 0.13646737, 1e-10, 1e-10,
+                    width=128, height=128)
+    run = lambda: compute_tile_smooth(spec, 50000, dtype=np.float64)
+    v = _mpix(128 * 128, _time_best(run, max(1, repeats - 1)))
+    return {"metric": "config4 deep-zoom 1e-10 mi=50000 f64+smooth 128^2",
+            "value": round(v, 3), "unit": "Mpix/s"}
+
+
+def bench_config5(repeats: int, segment: int) -> dict:
+    """BASELINE config 5 (local-mesh stand-in for v5e-16): 60-frame zoom,
+    each frame a mesh-sharded tile batch through batched dispatch sizes.
+    True multi-host needs a slice; this measures the per-host pipeline."""
+    jax, mesh, batched_escape_pixels = _mesh_and_kernel()
+    n = max(8, mesh.devices.size)
+    frames = 60
+    tile = 256  # keep the stand-in affordable; rate scales to 4096
+    base_span = 3.0
+
+    def run():
+        for f in range(frames):
+            span = base_span * (0.93 ** f)
+            params = np.empty((n, 3))
+            for i in range(n):
+                params[i] = (SEAHORSE[0] - span / 2 + (i % 4) * span / 4,
+                             SEAHORSE[1] - span / 2 + (i // 4) * span / 4,
+                             span / 4 / (tile - 1))
+            batched_escape_pixels(mesh, params, np.full(n, 1000, np.int64),
+                                  definition=tile, dtype=np.float32,
+                                  segment=segment)
+
+    v = _mpix(frames * n * tile * tile, _time_best(run, max(1, repeats - 1)))
+    return {"metric": f"config5 zoom-animation {frames}f x {n}x{tile}^2 "
+                      f"mi=1000 ({mesh.devices.size} device(s))",
+            "value": round(v, 2), "unit": "Mpix/s"}
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--tile", type=int, default=1024)
@@ -115,7 +220,25 @@ def main() -> int:
     parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
     parser.add_argument("--repeats", type=int, default=3)
     parser.add_argument("--segment", type=int, default=256)
+    parser.add_argument("--all", action="store_true",
+                        help="run the 5 BASELINE.md configs (one JSON "
+                             "line each) instead of the headline metric")
     args = parser.parse_args()
+
+    if args.all:
+        failed = 0
+        for fn in (bench_config1,
+                   lambda r: bench_config2(r, args.segment),
+                   lambda r: bench_config3(r, args.segment),
+                   bench_config4,
+                   lambda r: bench_config5(r, args.segment)):
+            try:
+                print(json.dumps(fn(args.repeats)), flush=True)
+            except Exception as e:  # finish the sweep, but fail the run
+                failed += 1
+                print(f"# config failed: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+        return 1 if failed else 0
 
     result = bench_throughput(args.tile, args.tiles, args.max_iter,
                               args.dtype, args.repeats, args.segment)

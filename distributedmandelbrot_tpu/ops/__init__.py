@@ -3,8 +3,11 @@
 from distributedmandelbrot_tpu.ops import reference
 from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
                                                        compute_tile,
+                                                       compute_tile_smooth,
                                                        escape_counts,
+                                                       escape_smooth,
                                                        scale_counts_to_uint8)
 
-__all__ = ["reference", "DEFAULT_SEGMENT", "compute_tile", "escape_counts",
+__all__ = ["reference", "DEFAULT_SEGMENT", "compute_tile",
+           "compute_tile_smooth", "escape_counts", "escape_smooth",
            "scale_counts_to_uint8"]

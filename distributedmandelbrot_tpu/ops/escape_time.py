@@ -78,6 +78,31 @@ def unrolled_steps(step_fn, state, segment: int, max_unroll: int = MAX_UNROLL):
     return state
 
 
+def segmented_while(one_step, state, *, total_steps: int, segment: int,
+                    active_of):
+    """Run ``one_step`` in fixed-trip unrolled segments under a
+    ``lax.while_loop`` until the iteration budget is spent or
+    ``active_of(state)`` is all-False (tile-granular early exit).  The last
+    segment may overrun past ``total_steps``; callers cancel overrun
+    effects arithmetically (see :func:`escape_loop`).  Shared scaffolding
+    for the parity and smooth kernels."""
+    segment = max(1, min(segment, total_steps))
+
+    def segment_body(carry):
+        s, it = carry
+        # Fixed-trip segment; unroll capped so compile time stays bounded.
+        return (unrolled_steps(one_step, s, segment), it + segment)
+
+    def segment_cond(carry):
+        s, it = carry
+        # Keep going while budget remains and any pixel is still bounded.
+        return (it <= total_steps) & jnp.any(active_of(s))
+
+    state, _ = lax.while_loop(segment_cond, segment_body,
+                              (state, jnp.asarray(1, jnp.int32)))
+    return state
+
+
 def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int):
     """The shared segmented escape recurrence (single source of truth for
     the XLA, sharded, and Pallas kernels).
@@ -106,7 +131,6 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int):
     inputs' varying manual axes).  Returns int32 escape counts.
     """
     four = jnp.asarray(4.0, jnp.result_type(zr0))
-    segment = max(1, min(segment, total_steps))
 
     def one_step(state):
         zr, zi, zr2, zi2, active, n = state
@@ -118,21 +142,11 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int):
         n = n + active.astype(jnp.int32)
         return (zr, zi, zr2, zi2, active, n)
 
-    def segment_body(carry):
-        state, it = carry
-        # Fixed-trip segment; unroll capped so compile time stays bounded.
-        return (unrolled_steps(one_step, state, segment), it + segment)
-
-    def segment_cond(carry):
-        state, it = carry
-        # Keep going while budget remains and any pixel is still bounded.
-        return (it <= total_steps) & jnp.any(state[4])
-
     mix = zr0 * 0 + zi0 * 0  # union of varying axes under shard_map
-    init = ((zr0, zi0, zr0 * zr0, zi0 * zi0, mix == 0,
-             mix.astype(jnp.int32)), jnp.asarray(1, jnp.int32))
-    (zr, zi, zr2, zi2, active, n), it = lax.while_loop(
-        segment_cond, segment_body, init)
+    init = (zr0, zi0, zr0 * zr0, zi0 * zi0, mix == 0, mix.astype(jnp.int32))
+    zr, zi, zr2, zi2, active, n = segmented_while(
+        one_step, init, total_steps=total_steps, segment=segment,
+        active_of=lambda s: s[4])
     return jnp.where(n >= total_steps, 0, n + 1)
 
 
@@ -198,6 +212,112 @@ def _scale_counts_jit(counts: jax.Array, *, max_iter: int,
     if clamp:
         vals = jnp.minimum(vals, 255)
     return vals.astype(jnp.uint8)  # int->uint8 wraps mod 256 deterministically
+
+
+def escape_smooth(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
+                  segment: int = DEFAULT_SEGMENT,
+                  bailout: float = 256.0) -> jax.Array:
+    """Continuous (smooth-colored) escape value per element; 0 if never
+    escaped.
+
+    The quality-mode companion to :func:`escape_counts` (the reference has
+    no smooth coloring — this is the deep-zoom rendering extension of
+    BASELINE.md config 4): returns the renormalized iteration count
+    ``nu = e + 1 - log2(ln|z_e| / ln(bailout))`` where ``e`` is the escape
+    iteration against radius ``bailout``.  A large bailout (default 256)
+    makes the log-log correction accurate, eliminating the color banding of
+    integer counts.
+
+    In-set classification follows :func:`escape_counts` semantics: the
+    kernel tracks the radius-2 bounded count alongside the radius-
+    ``bailout`` orbit, so ``nu == 0`` iff the radius-2 budget was
+    exhausted, even for pixels whose radius-2 escape lands in the last
+    iterations of the budget (the loop runs a few extra segments so their
+    orbit can reach the smoothing radius).  As with every JAX path here,
+    agreement with the numpy golden is statistical, not bit-exact — FMA
+    contraction can shift O(1) chaotic-boundary pixels (module docstring).
+
+    Unlike the select-free parity loop, escaped pixels freeze here — their
+    ``z`` at escape is the payload.  Values are returned in the kernel
+    dtype (float32 fast path / float64 deep zoom).
+    """
+    dt = getattr(c_real, "dtype", None)
+    if dt is not None and np.dtype(dt) == np.float64:
+        ensure_x64()
+    return _escape_smooth_jit(c_real, c_imag, max_iter=max_iter,
+                              segment=segment, bailout=float(bailout))
+
+
+@partial(jax.jit, static_argnames=("max_iter", "segment", "bailout"))
+def _escape_smooth_jit(c_real: jax.Array, c_imag: jax.Array, *,
+                       max_iter: int, segment: int,
+                       bailout: float) -> jax.Array:
+    dtype = jnp.result_type(c_real)
+    c_real = c_real.astype(dtype)
+    c_imag = c_imag.astype(dtype)
+    total_steps = max_iter - 1
+    if total_steps <= 0:
+        return jnp.zeros(c_real.shape, dtype)
+    four = jnp.asarray(4.0, dtype)
+    b2 = jnp.asarray(bailout * bailout, dtype)
+
+    def one_step(state):
+        zr, zi, active, n, bounded2, n2 = state
+        nzi = (zr + zr) * zi + c_imag
+        nzr = zr * zr - zi * zi + c_real
+        zr = jnp.where(active, nzr, zr)
+        zi = jnp.where(active, nzi, zi)
+        m2 = zr * zr + zi * zi
+        active = active & (m2 < b2)
+        n = n + active.astype(jnp.int32)
+        # Radius-2 count runs alongside (sticky, like the parity loop) so
+        # in-set classification matches escape_counts exactly.
+        bounded2 = bounded2 & (m2 < four)
+        n2 = n2 + bounded2.astype(jnp.int32)
+        return (zr, zi, active, n, bounded2, n2)
+
+    # Extra budget lets orbits that cross radius 2 in the last iterations
+    # still reach the smoothing radius; the radius-2 count is what's
+    # compared against total_steps, so the extra steps never change
+    # classification.  From |z| > 2 the orbit at least squares-minus-|c|
+    # each step, so bailout is reached within a handful of steps except
+    # for orbits hovering at 2+eps (which get nu = n+2 via the clamp).
+    extra = 8 + int(np.ceil(np.log2(np.log2(max(bailout, 4.0)))))
+    mix = c_real * 0 + c_imag * 0
+    init = (c_real + mix, c_imag + mix, mix == 0, mix.astype(jnp.int32),
+            mix == 0, mix.astype(jnp.int32))
+    zr, zi, active, n, bounded2, n2 = segmented_while(
+        one_step, init, total_steps=total_steps + extra, segment=segment,
+        active_of=lambda s: s[2])
+
+    # Frozen |z_e| is in [bailout, ~bailout^2 + |c|) — one squaring past
+    # the test — so mag2 is in [bailout^2, ~bailout^4) and log_ratio in
+    # [1, ~2); nu = n + 2 - log2(log_ratio) can therefore never go
+    # negative.  The clamp guards lanes that never reached the smoothing
+    # radius within the extra budget (hovering just outside radius 2):
+    # they get log_ratio 1 -> nu = n + 2.
+    mag2 = jnp.maximum(zr * zr + zi * zi, b2)
+    log_ratio = jnp.log(mag2) / jnp.asarray(2.0 * np.log(bailout), dtype)
+    nu = (n + 2).astype(dtype) - jnp.log2(log_ratio)
+    # In-set iff the radius-2 count exhausted the reference budget (n2
+    # counts only iterations 1..total_steps thanks to the sticky mask and
+    # the fact that an overrun past total_steps implies n2 already
+    # saturated or the pixel escaped radius 2 earlier).
+    return jnp.where(n2 >= total_steps, jnp.zeros((), dtype), nu)
+
+
+def compute_tile_smooth(spec: TileSpec, max_iter: int, *,
+                        dtype: np.dtype = np.float64,
+                        segment: int = DEFAULT_SEGMENT,
+                        bailout: float = 256.0) -> np.ndarray:
+    """One tile through the smooth-coloring path -> 2-D float array."""
+    if np.dtype(dtype) == np.float64:
+        ensure_x64()
+    c_real, c_imag = spec.grid_2d()
+    nu = escape_smooth(jnp.asarray(c_real, dtype=dtype),
+                       jnp.asarray(c_imag, dtype=dtype),
+                       max_iter=max_iter, segment=segment, bailout=bailout)
+    return np.asarray(nu)
 
 
 def compute_tile(spec: TileSpec, max_iter: int, *,

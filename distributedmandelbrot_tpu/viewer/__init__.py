@@ -1,8 +1,9 @@
 """Read-side client and rendering."""
 
 from distributedmandelbrot_tpu.viewer.client import DataClient, FetchStatus
-from distributedmandelbrot_tpu.viewer.render import (show, stitch_level,
+from distributedmandelbrot_tpu.viewer.render import (show, smooth_to_rgba,
+                                                     stitch_level,
                                                      value_to_rgba)
 
-__all__ = ["DataClient", "FetchStatus", "value_to_rgba", "stitch_level",
-           "show"]
+__all__ = ["DataClient", "FetchStatus", "value_to_rgba", "smooth_to_rgba",
+           "stitch_level", "show"]

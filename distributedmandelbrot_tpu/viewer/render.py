@@ -18,20 +18,40 @@ import numpy as np
 from distributedmandelbrot_tpu.core.geometry import CHUNK_WIDTH
 
 
-def value_to_rgba(values: np.ndarray, colormap: str = "jet") -> np.ndarray:
-    """Flat or 2-D uint8 values -> float RGBA array (reference pipeline)."""
+def _masked_colormap(vs: np.ndarray, in_set: np.ndarray,
+                     colormap: str) -> np.ndarray:
+    """Shared tail of both render paths: colormap ``vs``, paint in-set
+    pixels black."""
     import matplotlib
 
+    mapped = matplotlib.colormaps[colormap](vs).astype(float)
+    black = np.array((0.0, 0.0, 0.0, 1.0))
+    return np.where(in_set[..., None], black, mapped)
+
+
+def value_to_rgba(values: np.ndarray, colormap: str = "jet") -> np.ndarray:
+    """Flat or 2-D uint8 values -> float RGBA array (reference pipeline)."""
     if values.ndim == 1:
         side = int(round(values.size ** 0.5))
         if side * side != values.size:
             raise ValueError(f"cannot square-reshape {values.size} pixels")
         values = values.reshape((side, side))
-    vs = values.astype(float) / 256.0
-    vs = 1.0 - vs
-    mapped = matplotlib.colormaps[colormap](vs).astype(float)
-    black = np.array((0.0, 0.0, 0.0, 1.0))
-    return np.where(vs[..., None] == 1.0, black, mapped)
+    vs = 1.0 - values.astype(float) / 256.0
+    return _masked_colormap(vs, vs == 1.0, colormap)
+
+
+def smooth_to_rgba(nu: np.ndarray, max_iter: int,
+                   colormap: str = "jet") -> np.ndarray:
+    """Continuous escape values (:func:`...ops.escape_smooth`) -> RGBA.
+
+    Same visual convention as :func:`value_to_rgba` — in-set (0) pixels
+    black, others through the inverted colormap — but band-free: the
+    fractional part of ``nu`` varies continuously across iteration
+    boundaries.  Log-scaled so deep zooms (large max_iter) keep contrast.
+    """
+    nu = np.asarray(nu, float)
+    vs = np.log1p(np.maximum(nu, 0.0)) / np.log1p(float(max_iter))
+    return _masked_colormap(1.0 - np.clip(vs, 0.0, 1.0), nu <= 0.0, colormap)
 
 
 def stitch_level(fetch: Callable[[int, int], Optional[np.ndarray]],

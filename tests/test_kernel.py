@@ -111,3 +111,67 @@ def test_compute_tile_f32_close_to_golden():
     got = compute_tile(spec, 256, dtype=np.float32)
     mismatch = (got != golden.ravel()).mean()
     assert mismatch < 0.02, f"f32 path diverges on {mismatch:.1%} of pixels"
+
+
+# ---------------------------------------------------------------------------
+# Smooth (continuous) coloring — the quality/deep-zoom extension.
+
+@pytest.mark.parametrize("spec", VIEWS)
+@pytest.mark.parametrize("max_iter", [2, 17, 256])
+def test_smooth_classification_matches_integer_path(spec, max_iter):
+    """nu == 0 iff escape_counts == 0 — including pixels whose radius-2
+    escape lands in the last iterations of the budget.  Tolerance matches
+    the integer-path golden tests: FMA contraction may shift O(1)
+    chaotic-boundary pixels across the budget edge (module docstring)."""
+    from distributedmandelbrot_tpu.ops import escape_smooth
+    cr, ci = grids(spec)
+    nu = np.asarray(escape_smooth(cr, ci, max_iter=max_iter))
+    counts = np.asarray(ref.escape_counts(cr, ci, max_iter))
+    mismatch = ((nu == 0.0) != (counts == 0)).mean()
+    assert mismatch <= 5e-4, f"{mismatch:.2%} classification divergence"
+    assert (nu[nu != 0] > 0.0).all()
+    assert np.isfinite(nu).all()
+
+
+def test_smooth_tracks_integer_counts():
+    """nu and the radius-2 escape count agree to within the bailout shift:
+    raising the radius from 2 to B delays escape by ~log2(log2 B) items."""
+    from distributedmandelbrot_tpu.ops import escape_smooth
+    spec = TileSpec(-0.8, 0.1, 0.2, 0.2, width=64, height=64)
+    cr, ci = grids(spec)
+    nu = np.asarray(escape_smooth(cr, ci, max_iter=512, bailout=256.0))
+    counts = np.asarray(ref.escape_counts(cr, ci, 512)).astype(float)
+    esc = counts != 0
+    # Escaping against B=256 happens ~3 iterations after |z|>2; allow slack.
+    delta = nu[esc] - counts[esc]
+    assert np.percentile(delta, 5) > -1.0 and np.percentile(delta, 95) < 6.0
+
+
+def test_smooth_is_band_free_on_a_gradient():
+    """Along a line crossing several integer-count bands, smooth values must
+    be strictly monotone (no plateaus/banding) where counts are monotone."""
+    from distributedmandelbrot_tpu.ops import escape_smooth
+    # Walk outward on the real axis from near the set toward fast escape.
+    cr = np.linspace(0.26, 1.8, 512)
+    ci = np.zeros_like(cr)
+    nu = np.asarray(escape_smooth(cr, ci, max_iter=256))
+    assert (nu > 0).all()
+    # Escape time decreases monotonically as c moves away from the set.
+    assert (np.diff(nu) < 0).mean() > 0.99
+
+
+def test_smooth_f64_path_and_tile_helper():
+    from distributedmandelbrot_tpu.ops import compute_tile_smooth
+    spec = TileSpec(-0.748, 0.09, 0.005, 0.005, width=32, height=32)
+    nu = compute_tile_smooth(spec, 2000, dtype=np.float64)
+    assert nu.shape == (32, 32) and nu.dtype == np.float64
+    assert np.isfinite(nu).all()
+
+
+def test_smooth_rgba_rendering():
+    from distributedmandelbrot_tpu.viewer import smooth_to_rgba
+    nu = np.array([[0.0, 1.5], [200.0, 255.9]])
+    rgba = smooth_to_rgba(nu, 256)
+    assert rgba.shape == (2, 2, 4)
+    np.testing.assert_array_equal(rgba[0, 0], [0, 0, 0, 1])  # in-set black
+    assert (rgba[..., 3] == 1).all()
