@@ -44,6 +44,33 @@ def _add_common(parser: argparse.ArgumentParser) -> None:
                         help="errors only")
 
 
+_NP_DTYPES = {"f32": np.float32, "f64": np.float64}
+
+
+def _join_negative_values(argv: Sequence[str], flags: Sequence[str]) -> list:
+    """Merge ``--flag -0.8,0.156`` into ``--flag=-0.8,0.156`` so argparse
+    doesn't mistake the negative value for an option."""
+    out, it = [], iter(list(argv))
+    for tok in it:
+        if tok in flags:
+            val = next(it, None)
+            if val is None:
+                out.append(tok)
+            else:
+                out.append(f"{tok}={val}")
+        else:
+            out.append(tok)
+    return out
+
+
+def _save_png(path: str, rgba) -> None:
+    import matplotlib
+    matplotlib.use("Agg")
+    from matplotlib import pyplot as plt
+    plt.imsave(path, rgba)
+    print(f"wrote {path} ({rgba.shape[1]}x{rgba.shape[0]})")
+
+
 def cmd_coordinator(argv: Sequence[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="dmtpu coordinator",
@@ -92,7 +119,7 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
 
 
 def _make_backend(name: str, dtype: str):
-    np_dtype = {"f32": np.float32, "f64": np.float64}[dtype]
+    np_dtype = _NP_DTYPES[dtype]
     if name == "numpy":
         from distributedmandelbrot_tpu.worker import NumpyBackend
         return NumpyBackend()
@@ -221,26 +248,87 @@ def _viewer_fetch_and_render(parser, args, client) -> int:
 
     rgba = value_to_rgba(values, colormap=args.colormap)
     if args.out:
-        import matplotlib
-        matplotlib.use("Agg")
-        from matplotlib import pyplot as plt
-        plt.imsave(args.out, rgba)
-        print(f"wrote {args.out} ({rgba.shape[1]}x{rgba.shape[0]})")
+        _save_png(args.out, rgba)
     else:  # pragma: no cover - needs a display
         from distributedmandelbrot_tpu.viewer import show
         show(rgba)
     return 0
 
 
+def cmd_render(argv: Sequence[str]) -> int:
+    """Local (farm-less) rendering of any view — Mandelbrot or Julia,
+    integer or smooth coloring.  Capability extension; the reference can
+    only view farm-computed chunks."""
+    parser = argparse.ArgumentParser(
+        prog="dmtpu render",
+        description="Render a view locally on the default JAX backend.")
+    parser.add_argument("--fractal", choices=["mandelbrot", "julia"],
+                        default="mandelbrot")
+    parser.add_argument("--c", default="-0.8,0.156",
+                        help="Julia constant as RE,IM")
+    parser.add_argument("--center", default=None,
+                        help="view center (default: -0.5,0 for mandelbrot, "
+                             "0,0 for julia)")
+    parser.add_argument("--span", type=float, default=3.0,
+                        help="view side length in the complex plane")
+    parser.add_argument("--definition", type=int, default=1024,
+                        help="output pixels per side")
+    parser.add_argument("--max-iter", type=int, default=256)
+    parser.add_argument("--smooth", action="store_true",
+                        help="band-free continuous coloring (f64)")
+    parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
+    parser.add_argument("--colormap", default="jet")
+    parser.add_argument("--out", required=True, help="output PNG path")
+    _add_common(parser)
+    # argparse rejects negative-valued "--c -0.8,0.156" (looks like an
+    # option); pre-join such pairs into "--c=-0.8,0.156".
+    args = parser.parse_args(_join_negative_values(argv, ("--c", "--center")))
+    _configure_logging(args)
+
+    from distributedmandelbrot_tpu.core.geometry import TileSpec
+    from distributedmandelbrot_tpu.viewer import smooth_to_rgba, value_to_rgba
+
+    def _pair(s: str) -> tuple:
+        a, b = s.split(",")
+        return float(a), float(b)
+
+    default_center = "0,0" if args.fractal == "julia" else "-0.5,0.0"
+    cx, cy = _pair(args.center or default_center)
+    spec = TileSpec(cx - args.span / 2, cy - args.span / 2,
+                    args.span, args.span,
+                    width=args.definition, height=args.definition)
+    np_dtype = _NP_DTYPES[args.dtype]
+    julia_c = complex(*_pair(args.c)) if args.fractal == "julia" else None
+
+    if args.smooth:
+        from distributedmandelbrot_tpu.ops import compute_tile_smooth
+        nu = compute_tile_smooth(spec, args.max_iter, dtype=np.float64,
+                                 julia_c=julia_c)
+        rgba = smooth_to_rgba(nu, args.max_iter, colormap=args.colormap)
+    else:
+        if julia_c is not None:
+            from distributedmandelbrot_tpu.ops import compute_tile_julia
+            values = compute_tile_julia(spec, julia_c, args.max_iter,
+                                        dtype=np_dtype)
+        else:
+            from distributedmandelbrot_tpu.ops import compute_tile
+            values = compute_tile(spec, args.max_iter, dtype=np_dtype)
+        rgba = value_to_rgba(values.reshape(spec.height, spec.width),
+                             colormap=args.colormap)
+
+    _save_png(args.out, rgba)
+    return 0
+
+
 COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
-            "viewer": cmd_viewer}
+            "viewer": cmd_viewer, "render": cmd_render}
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
-              "{coordinator|worker|viewer} [options]\n"
+              "{coordinator|worker|viewer|render} [options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

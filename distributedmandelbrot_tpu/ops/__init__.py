@@ -3,11 +3,15 @@
 from distributedmandelbrot_tpu.ops import reference
 from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
                                                        compute_tile,
+                                                       compute_tile_julia,
                                                        compute_tile_smooth,
                                                        escape_counts,
+                                                       escape_counts_julia,
                                                        escape_smooth,
+                                                       escape_smooth_julia,
                                                        scale_counts_to_uint8)
 
 __all__ = ["reference", "DEFAULT_SEGMENT", "compute_tile",
-           "compute_tile_smooth", "escape_counts", "escape_smooth",
+           "compute_tile_julia", "compute_tile_smooth", "escape_counts",
+           "escape_counts_julia", "escape_smooth", "escape_smooth_julia",
            "scale_counts_to_uint8"]

@@ -182,6 +182,59 @@ def _escape_counts_jit(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
                        total_steps=total_steps, segment=segment)
 
 
+def escape_counts_julia(z_real: jax.Array, z_imag: jax.Array,
+                        c: complex, *, max_iter: int,
+                        segment: int = DEFAULT_SEGMENT) -> jax.Array:
+    """Julia-set escape counts: z starts at the pixel, ``c`` is a constant.
+
+    A capability extension past the reference (which renders only the
+    Mandelbrot set) that falls out of the kernel design: the shared
+    recurrence (:func:`escape_loop`) already takes the initial ``z``
+    independently of ``c``, so the Julia family reuses the identical
+    segmented select-free loop, uint8 scaling, and tile plumbing.  Same
+    count semantics as :func:`escape_counts` (iterations 1..max_iter-1,
+    first test after the first update, 0 = never escaped).
+    """
+    dt = getattr(z_real, "dtype", None)
+    if dt is not None and np.dtype(dt) == np.float64:
+        ensure_x64()
+    c = complex(c)
+    dtype = jnp.result_type(z_real)
+    # c is traced (not static) so sweeping constants — a Julia animation —
+    # reuses one compiled executable.
+    return _escape_counts_julia_jit(z_real, z_imag,
+                                    jnp.asarray(c.real, dtype),
+                                    jnp.asarray(c.imag, dtype),
+                                    max_iter=max_iter, segment=segment)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "segment"))
+def _escape_counts_julia_jit(z_real: jax.Array, z_imag: jax.Array,
+                             cr: jax.Array, ci: jax.Array,
+                             *, max_iter: int, segment: int) -> jax.Array:
+    dtype = jnp.result_type(z_real)
+    total_steps = max_iter - 1
+    if total_steps <= 0:
+        return jnp.zeros(z_real.shape, jnp.int32)
+    return escape_loop(z_real.astype(dtype), z_imag.astype(dtype), cr, ci,
+                       total_steps=total_steps, segment=segment)
+
+
+def compute_tile_julia(spec: TileSpec, c: complex, max_iter: int, *,
+                       dtype: np.dtype = np.float32,
+                       segment: int = DEFAULT_SEGMENT,
+                       clamp: bool = False) -> np.ndarray:
+    """One Julia tile end-to-end -> flat uint8 pixels (canonical order)."""
+    if np.dtype(dtype) == np.float64:
+        ensure_x64()
+    z_real, z_imag = spec.grid_2d()
+    counts = escape_counts_julia(jnp.asarray(z_real, dtype=dtype),
+                                 jnp.asarray(z_imag, dtype=dtype), c,
+                                 max_iter=max_iter, segment=segment)
+    pixels = scale_counts_to_uint8(counts, max_iter=max_iter, clamp=clamp)
+    return np.asarray(pixels).ravel()
+
+
 def scale_counts_to_uint8(counts: jax.Array, *, max_iter: int,
                           clamp: bool = False) -> jax.Array:
     """See :func:`_scale_counts_jit`; widens beyond int32 when needed."""
@@ -244,20 +297,42 @@ def escape_smooth(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
     dt = getattr(c_real, "dtype", None)
     if dt is not None and np.dtype(dt) == np.float64:
         ensure_x64()
-    return _escape_smooth_jit(c_real, c_imag, max_iter=max_iter,
-                              segment=segment, bailout=float(bailout))
+    return _escape_smooth_jit(c_real, c_imag, c_real, c_imag,
+                              max_iter=max_iter, segment=segment,
+                              bailout=float(bailout))
+
+
+def escape_smooth_julia(z_real: jax.Array, z_imag: jax.Array, c: complex, *,
+                        max_iter: int, segment: int = DEFAULT_SEGMENT,
+                        bailout: float = 256.0) -> jax.Array:
+    """Smooth coloring for the Julia family (z starts at the pixel, ``c``
+    constant and traced — constant sweeps reuse one executable).  Same
+    semantics as :func:`escape_smooth`."""
+    dt = getattr(z_real, "dtype", None)
+    if dt is not None and np.dtype(dt) == np.float64:
+        ensure_x64()
+    c = complex(c)
+    dtype = jnp.result_type(z_real)
+    return _escape_smooth_jit(z_real, z_imag,
+                              jnp.asarray(c.real, dtype),
+                              jnp.asarray(c.imag, dtype),
+                              max_iter=max_iter, segment=segment,
+                              bailout=float(bailout))
 
 
 @partial(jax.jit, static_argnames=("max_iter", "segment", "bailout"))
-def _escape_smooth_jit(c_real: jax.Array, c_imag: jax.Array, *,
+def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
+                       c_real: jax.Array, c_imag: jax.Array, *,
                        max_iter: int, segment: int,
                        bailout: float) -> jax.Array:
-    dtype = jnp.result_type(c_real)
+    dtype = jnp.result_type(zr0)
+    zr0 = zr0.astype(dtype)
+    zi0 = zi0.astype(dtype)
     c_real = c_real.astype(dtype)
     c_imag = c_imag.astype(dtype)
     total_steps = max_iter - 1
     if total_steps <= 0:
-        return jnp.zeros(c_real.shape, dtype)
+        return jnp.zeros(zr0.shape, dtype)
     four = jnp.asarray(4.0, dtype)
     b2 = jnp.asarray(bailout * bailout, dtype)
 
@@ -283,8 +358,8 @@ def _escape_smooth_jit(c_real: jax.Array, c_imag: jax.Array, *,
     # each step, so bailout is reached within a handful of steps except
     # for orbits hovering at 2+eps (which get nu = n+2 via the clamp).
     extra = 8 + int(np.ceil(np.log2(np.log2(max(bailout, 4.0)))))
-    mix = c_real * 0 + c_imag * 0
-    init = (c_real + mix, c_imag + mix, mix == 0, mix.astype(jnp.int32),
+    mix = zr0 * 0 + zi0 * 0
+    init = (zr0 + mix, zi0 + mix, mix == 0, mix.astype(jnp.int32),
             mix == 0, mix.astype(jnp.int32))
     zr, zi, active, n, bounded2, n2 = segmented_while(
         one_step, init, total_steps=total_steps + extra, segment=segment,
@@ -309,14 +384,24 @@ def _escape_smooth_jit(c_real: jax.Array, c_imag: jax.Array, *,
 def compute_tile_smooth(spec: TileSpec, max_iter: int, *,
                         dtype: np.dtype = np.float64,
                         segment: int = DEFAULT_SEGMENT,
-                        bailout: float = 256.0) -> np.ndarray:
-    """One tile through the smooth-coloring path -> 2-D float array."""
+                        bailout: float = 256.0,
+                        julia_c: complex | None = None) -> np.ndarray:
+    """One tile through the smooth-coloring path -> 2-D float array.
+
+    With ``julia_c`` set, renders the Julia set for that constant instead
+    of the Mandelbrot set.
+    """
     if np.dtype(dtype) == np.float64:
         ensure_x64()
-    c_real, c_imag = spec.grid_2d()
-    nu = escape_smooth(jnp.asarray(c_real, dtype=dtype),
-                       jnp.asarray(c_imag, dtype=dtype),
-                       max_iter=max_iter, segment=segment, bailout=bailout)
+    g_real, g_imag = spec.grid_2d()
+    g_real = jnp.asarray(g_real, dtype=dtype)
+    g_imag = jnp.asarray(g_imag, dtype=dtype)
+    if julia_c is None:
+        nu = escape_smooth(g_real, g_imag, max_iter=max_iter,
+                           segment=segment, bailout=bailout)
+    else:
+        nu = escape_smooth_julia(g_real, g_imag, julia_c, max_iter=max_iter,
+                                 segment=segment, bailout=bailout)
     return np.asarray(nu)
 
 

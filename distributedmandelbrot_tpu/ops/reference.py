@@ -43,6 +43,33 @@ def escape_counts(c_real: np.ndarray, c_imag: np.ndarray,
     return counts
 
 
+def escape_counts_julia(z_real: np.ndarray, z_imag: np.ndarray, c: complex,
+                        max_iter: int) -> np.ndarray:
+    """Julia-family golden: z starts at the pixel, ``c`` is constant.
+
+    Same loop protocol as :func:`escape_counts` (iterations 1..max_iter-1,
+    test after update, 0 = never escaped); pins the semantics of the JAX
+    Julia kernel (a capability extension — the reference renders only the
+    Mandelbrot set).
+    """
+    zr = np.asarray(z_real, dtype=np.float64).copy()
+    zi = np.asarray(z_imag, dtype=np.float64).copy()
+    cr, ci = np.float64(c.real), np.float64(c.imag)
+    counts = np.zeros(zr.shape, dtype=np.int32)
+    active = np.ones(zr.shape, dtype=bool)
+    for it in range(1, max_iter):
+        new_zr = zr * zr - zi * zi + cr
+        new_zi = 2.0 * zr * zi + ci
+        zr = np.where(active, new_zr, zr)
+        zi = np.where(active, new_zi, zi)
+        escaped = active & (zr * zr + zi * zi >= 4.0)
+        counts = np.where(escaped, np.int32(it), counts)
+        active &= ~escaped
+        if not active.any():
+            break
+    return counts
+
+
 def scale_counts_to_uint8(counts: np.ndarray, max_iter: int,
                           clamp: bool = False) -> np.ndarray:
     """Scale escape counts to the uint8 pixel encoding.

@@ -41,9 +41,9 @@ from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
 from distributedmandelbrot_tpu.parallel.mesh import ROW_AXIS, TILE_AXIS
 
 try:
-    from jax.experimental.shard_map import shard_map
-except ImportError:  # newer JAX moved it
-    from jax.sharding import shard_map  # type: ignore
+    from jax import shard_map  # JAX >= 0.8
+except ImportError:  # older JAX
+    from jax.experimental.shard_map import shard_map  # type: ignore
 
 
 def _device_grid(start_r, start_i, step, shape, dtype, row_offset=0):

@@ -175,3 +175,62 @@ def test_smooth_rgba_rendering():
     assert rgba.shape == (2, 2, 4)
     np.testing.assert_array_equal(rgba[0, 0], [0, 0, 0, 1])  # in-set black
     assert (rgba[..., 3] == 1).all()
+
+
+# ---------------------------------------------------------------------------
+# Julia family — capability extension reusing the shared recurrence.
+
+JULIA_CS = [complex(-0.8, 0.156), complex(0.285, 0.01), complex(-0.4, 0.6)]
+
+
+@pytest.mark.parametrize("c", JULIA_CS)
+def test_julia_f64_matches_golden(c):
+    from distributedmandelbrot_tpu.ops import escape_counts_julia
+    spec = TileSpec(-1.5, -1.5, 3.0, 3.0, width=64, height=64)
+    zr, zi = grids(spec)
+    got = np.asarray(escape_counts_julia(zr, zi, c, max_iter=256))
+    golden = ref.escape_counts_julia(zr, zi, c, 256)
+    mismatch = (got != golden).mean()
+    assert mismatch <= 5e-4, f"{mismatch:.2%} pixels diverge"
+
+
+def test_julia_tile_end_to_end_uint8():
+    from distributedmandelbrot_tpu.ops import compute_tile_julia
+    spec = TileSpec(-1.5, -1.5, 3.0, 3.0, width=64, height=64)
+    zr, zi = grids(spec)
+    c = JULIA_CS[0]
+    golden = ref.scale_counts_to_uint8(
+        ref.escape_counts_julia(zr, zi, c, 256), 256).ravel()
+    got = compute_tile_julia(spec, c, 256, dtype=np.float64)
+    assert got.dtype == np.uint8 and got.shape == golden.shape
+    mismatch = (got != golden).mean()
+    assert mismatch <= 5e-4
+
+
+def test_julia_c_zero_is_unit_disk():
+    """c=0: |z| <= 1 never escapes; |z| > 1 escapes (squaring doubles the
+    log-magnitude each step)."""
+    from distributedmandelbrot_tpu.ops import escape_counts_julia
+    zr = np.array([0.0, 0.5, 0.999, 1.5, 2.5])
+    zi = np.zeros_like(zr)
+    counts = np.asarray(escape_counts_julia(zr, zi, 0j, max_iter=256))
+    assert (counts[:3] == 0).all()
+    assert (counts[3:] > 0).all()
+
+
+def test_julia_smooth_classification_and_reuse():
+    """Julia smooth path: in-set iff integer Julia path says so, and
+    sweeping c must NOT recompile (c is traced, not static)."""
+    from distributedmandelbrot_tpu.ops import (escape_counts_julia,
+                                               escape_smooth_julia)
+    from distributedmandelbrot_tpu.ops.escape_time import _escape_smooth_jit
+    spec = TileSpec(-1.5, -1.5, 3.0, 3.0, width=48, height=48)
+    zr, zi = grids(spec)
+    before = _escape_smooth_jit._cache_size()
+    for c in JULIA_CS:
+        nu = np.asarray(escape_smooth_julia(zr, zi, c, max_iter=128))
+        counts = np.asarray(escape_counts_julia(zr, zi, c, max_iter=128))
+        mismatch = ((nu == 0.0) != (counts == 0)).mean()
+        assert mismatch <= 5e-4, f"c={c}: {mismatch:.2%} divergence"
+    # One compilation serves all three constants (same shapes/dtype).
+    assert _escape_smooth_jit._cache_size() - before <= 1
