@@ -166,7 +166,12 @@ class Distributer:
                           writer: asyncio.StreamWriter) -> None:
         w = Workload.from_wire(
             await framing.read_exact(reader, WORKLOAD_WIRE_SIZE))
-        if not self.scheduler.can_accept(w):
+        # Claim (consume) the lease at echo time, as the reference does
+        # (Distributer.cs:404): a concurrent second submission for the same
+        # tile is rejected instead of double-matching while this payload is
+        # still in flight.
+        token = self.scheduler.claim(w)
+        if token is None:
             framing.write_byte(writer, proto.RESPONSE_REJECT)
             await writer.drain()
             self.counters.inc("results_rejected")
@@ -174,10 +179,19 @@ class Distributer:
             return
         framing.write_byte(writer, proto.RESPONSE_ACCEPT)
         await writer.drain()
-        data = await framing.read_exact(reader, CHUNK_PIXELS)
-        if not self.scheduler.complete(w):
-            # Lease expired between accept and payload arrival; drop.
-            self.counters.inc("results_rejected")
+        try:
+            data = await framing.read_exact(reader, CHUNK_PIXELS)
+        except ConnectionError:  # read_exact maps short reads to this too
+            # Payload never arrived; make the tile grantable again now
+            # rather than waiting out the claim's expiry.
+            self.scheduler.release_claim(w, token)
+            self.counters.inc("results_dropped")
+            logger.info("dropped result for %s (connection lost mid-upload)",
+                        w)
+            raise
+        if not self.scheduler.finish_claim(w, token):
+            # Claim expired between accept and payload arrival; drop.
+            self.counters.inc("results_dropped")
             logger.info("dropped result for %s (lease expired mid-upload)", w)
             return
         self.counters.inc("results_accepted")

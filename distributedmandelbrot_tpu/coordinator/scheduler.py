@@ -64,6 +64,8 @@ class TileScheduler:
         self.clock = clock if clock is not None else MonotonicClock()
         self._completed: set[Key] = set(completed or ())
         self._leases: dict[Key, Lease] = {}
+        self._claims: dict[Key, tuple[int, Lease]] = {}
+        self._claim_seq = 0  # claim identity; see claim()
         self._retry: deque[Workload] = deque()
         self._cursor = self._grid_iter()
         self._cursor_done = False
@@ -104,6 +106,9 @@ class TileScheduler:
     def _grantable(self, w: Workload, now: float) -> bool:
         if w.key in self._completed:
             return False
+        claim = self._claims.get(w.key)
+        if claim is not None and not claim[1].expired(now):
+            return False  # a result for this tile is mid-upload
         lease = self._leases.get(w.key)
         return lease is None or lease.expired(now)
 
@@ -129,7 +134,15 @@ class TileScheduler:
         now = self.clock.now()
         w = self._next_needed(now)
         if w is None:
-            return None
+            # Lazy expiry, matching the reference's per-request re-check
+            # (Distributer.cs:317-330): when the frontier is empty, requeue
+            # any expired leases/claims right now instead of making the
+            # worker wait for the periodic sweep.  O(|leases|), and only on
+            # the otherwise-idle path.
+            if self.sweep():
+                w = self._next_needed(now)
+            if w is None:
+                return None
         self._leases[w.key] = Lease(w, now + self.lease_timeout)
         return w
 
@@ -151,13 +164,58 @@ class TileScheduler:
         return (lease is not None and not lease.expired(self.clock.now())
                 and lease.workload.matches(w))
 
-    def complete(self, w: Workload) -> bool:
-        """Record a completed tile; returns False for stale/unknown results."""
+    def claim(self, w: Workload) -> Optional[int]:
+        """Atomically consume the matching lease at accept time; returns an
+        opaque claim token, or None if the result is not acceptable.
+
+        The reference matches-and-removes the lease when the 16-byte echo
+        arrives, *before* the payload (``Distributer.cs:404``); doing the
+        same here closes the window where a second worker's submission for
+        the same tile could match the lease while the first payload is
+        still in flight.  The claim keeps the lease's expiry: a payload
+        that dawdles past it is dropped (`finish_claim`), and the sweep
+        requeues expired claims just like expired leases.
+
+        The token carries the claim's identity: if this claim expires
+        mid-upload and the tile is re-leased and re-claimed by another
+        submission, the dawdler's late ``finish_claim``/``release_claim``
+        is a no-op instead of consuming the live claim.
+        """
         if not self.can_accept(w):
+            return None
+        self._claim_seq += 1
+        self._claims[w.key] = (self._claim_seq, self._leases.pop(w.key))
+        return self._claim_seq
+
+    def finish_claim(self, w: Workload, token: int) -> bool:
+        """Record completion after the claimed result's payload landed."""
+        entry = self._claims.get(w.key)
+        if entry is None or entry[0] != token:
+            return False  # claim expired and was swept / superseded
+        del self._claims[w.key]
+        if entry[1].expired(self.clock.now()):
+            self._retry.append(entry[1].workload)
             return False
-        del self._leases[w.key]
         self._completed.add(w.key)
         return True
+
+    def release_claim(self, w: Workload, token: int) -> None:
+        """Abort a claim (payload never arrived); tile grantable again."""
+        entry = self._claims.get(w.key)
+        if entry is None or entry[0] != token:
+            return  # superseded; nothing to release
+        del self._claims[w.key]
+        if w.key not in self._completed:
+            self._retry.append(entry[1].workload)
+
+    def complete(self, w: Workload) -> bool:
+        """Record a completed tile; returns False for stale/unknown results.
+
+        Single-step composite of :meth:`claim` + :meth:`finish_claim` for
+        callers with no payload phase (tests, embedders).
+        """
+        token = self.claim(w)
+        return token is not None and self.finish_claim(w, token)
 
     def reopen(self, w: Workload) -> None:
         """Un-complete a tile whose persistence failed so it is granted again.
@@ -173,11 +231,19 @@ class TileScheduler:
     # -- maintenance ------------------------------------------------------
 
     def sweep(self) -> int:
-        """Drop expired leases and requeue their tiles; returns count swept."""
+        """Drop expired leases/claims and requeue their tiles."""
         now = self.clock.now()
+        swept = 0
         expired = [k for k, l in self._leases.items() if l.expired(now)]
         for key in expired:
             lease = self._leases.pop(key)
             if key not in self._completed:
                 self._retry.append(lease.workload)
-        return len(expired)
+        swept += len(expired)
+        expired = [k for k, (_, l) in self._claims.items() if l.expired(now)]
+        for key in expired:
+            _, lease = self._claims.pop(key)
+            if key not in self._completed:
+                self._retry.append(lease.workload)
+        swept += len(expired)
+        return swept

@@ -103,3 +103,32 @@ def test_rgba_rendering_matches_reference_semantics():
     assert rgba.shape == (8, 8, 4)
     np.testing.assert_array_equal(rgba[1, 1], [0.0, 0.0, 0.0, 1.0])  # in-set
     assert rgba[0, 0, :3].sum() > 0  # escaped pixel is colored
+
+
+def test_worker_crash_lease_expiry_redistribution_over_the_wire(tmp_path):
+    """Fault injection end-to-end (survey §5.3): worker A leases a tile and
+    goes silent (crash); after the lease expires the coordinator re-grants
+    the SAME tile to worker B, accepts B's result, and rejects A's late
+    submission — at-least-once with dedup, over the real wire."""
+    import time
+
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, 12)],
+                            lease_timeout=0.5, sweep_period=0.2) as farm:
+        client_a = DistributerClient("127.0.0.1", farm.distributer_port)
+        client_b = DistributerClient("127.0.0.1", farm.distributer_port)
+
+        wl_a = client_a.request()
+        assert wl_a is not None  # A holds the only tile...
+        assert client_b.request() is None  # ...so B gets nothing
+        # Precompute now so B's own lease can't expire mid-compute below
+        # (a full golden tile takes seconds; the lease here is 0.5 s).
+        pixels = NumpyBackend().compute_batch([wl_a])[0]
+        time.sleep(0.8)  # A "crashed"; lease expires
+
+        wl_b = client_b.request()  # redistribution
+        assert wl_b is not None and wl_b.key == wl_a.key
+        assert client_b.submit(wl_b, pixels) is True
+        # A comes back from the dead: duplicate result must be rejected.
+        assert client_a.submit(wl_a, pixels) is False
+        farm.wait_saves_settled(expected_accepted=1)
+        assert farm.scheduler.is_complete()

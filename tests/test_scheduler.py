@@ -147,3 +147,74 @@ def test_outstanding_leases_tracks_expiry():
     assert sched.outstanding_leases == 2
     clock.advance(11.0)
     assert sched.outstanding_leases == 0
+
+
+def test_claim_consumes_lease_so_concurrent_submit_rejected():
+    """The ingest race: while worker B's payload is in flight (lease
+    claimed), worker A's late echo for the same tile must be rejected —
+    the lease is matched exactly once (reference Distributer.cs:404)."""
+    sched, clock = make(levels=((1, 12),), timeout=10.0)
+    w = sched.acquire()
+    clock.advance(11)       # A's lease expires
+    w_b = sched.acquire()   # redistribution to B
+    assert w_b.key == w.key
+    tok_b = sched.claim(w_b)             # B's echo arrives, payload starts
+    assert tok_b is not None
+    assert sched.claim(w) is None        # A's echo mid-upload: rejected
+    assert sched.can_accept(w) is False
+    assert sched.acquire() is None       # claimed tile is not re-granted
+    assert sched.finish_claim(w_b, tok_b) is True
+    assert sched.is_complete()
+
+
+def test_release_claim_requeues_tile():
+    """Connection dies mid-payload: the claim is released and the tile is
+    immediately grantable again."""
+    sched, clock = make(levels=((1, 12),), timeout=10.0)
+    w = sched.acquire()
+    tok = sched.claim(w)
+    assert tok is not None
+    sched.release_claim(w, tok)
+    w2 = sched.acquire()
+    assert w2 is not None and w2.key == w.key
+    assert sched.complete(w2) is True
+
+
+def test_claim_expiring_mid_upload_drops_result_and_requeues():
+    sched, clock = make(levels=((1, 12),), timeout=10.0)
+    w = sched.acquire()
+    tok = sched.claim(w)
+    clock.advance(11)                    # payload dawdles past the expiry
+    assert sched.finish_claim(w, tok) is False
+    w2 = sched.acquire()                 # tile grantable again
+    assert w2 is not None and w2.key == w.key
+
+
+def test_sweep_requeues_expired_claims():
+    sched, clock = make(levels=((1, 12),), timeout=10.0)
+    w = sched.acquire()
+    assert sched.claim(w) is not None
+    clock.advance(11)
+    assert sched.sweep() == 1
+    assert sched.acquire() is not None
+
+
+def test_stale_claim_token_cannot_consume_superseding_claim():
+    """A's claim expires mid-upload; B re-leases and re-claims the tile.
+    A's late finish/release with its stale token must be a no-op — B's
+    live claim survives and B's result is the one accepted."""
+    sched, clock = make(levels=((1, 12),), timeout=10.0)
+    w_a = sched.acquire()
+    tok_a = sched.claim(w_a)
+    assert tok_a is not None
+    clock.advance(11)                    # A's claim expires mid-upload
+    w_b = sched.acquire()                # lazy sweep requeues; B re-leases
+    assert w_b is not None and w_b.key == w_a.key
+    tok_b = sched.claim(w_b)
+    assert tok_b is not None
+    # A's dawdling payload lands / connection dies: both are no-ops now.
+    assert sched.finish_claim(w_a, tok_a) is False
+    sched.release_claim(w_a, tok_a)
+    assert sched.acquire() is None       # B's claim still blocks granting
+    assert sched.finish_claim(w_b, tok_b) is True
+    assert sched.is_complete()
