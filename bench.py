@@ -87,7 +87,7 @@ def bench_throughput(tile: int, tiles: int, max_iter: int, dtype: str,
 
                 def pallas_run():
                     for s in specs:
-                        compute_tile_pallas(s, max_iter, segment=segment)
+                        compute_tile_pallas(s, max_iter)
 
                 results["pallas"] = \
                     pixels / _time_best(pallas_run, repeats) / 1e6

@@ -129,6 +129,16 @@ def _make_backend(name: str, dtype: str):
     if name == "jax":
         from distributedmandelbrot_tpu.worker import JaxBackend
         return JaxBackend(dtype=np_dtype)
+    if name == "pallas":
+        if dtype != "f32":
+            raise SystemExit(
+                "--backend pallas is f32-only (the TPU throughput path); "
+                "use --backend jax for f64")
+        from distributedmandelbrot_tpu.worker import PallasBackend
+        return PallasBackend()
+    if name == "auto":
+        from distributedmandelbrot_tpu.worker import auto_backend
+        return auto_backend(dtype=np_dtype)
     if name == "mesh":
         from distributedmandelbrot_tpu.parallel import MeshBackend
         return MeshBackend(dtype=np_dtype)
@@ -142,8 +152,12 @@ def cmd_worker(argv: Sequence[str]) -> int:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int,
                         default=proto.DEFAULT_DISTRIBUTER_PORT)
-    parser.add_argument("--backend", choices=["jax", "numpy", "native", "mesh"],
-                        default="jax")
+    parser.add_argument("--backend",
+                        choices=["auto", "jax", "pallas", "numpy", "native",
+                                 "mesh"],
+                        default="auto",
+                        help="auto = Pallas TPU kernel when a TPU is live, "
+                             "else the portable JAX path")
     parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
     parser.add_argument("--batch-size", type=int, default=0,
                         help="tiles leased per exchange "

@@ -1,14 +1,29 @@
 """Pallas TPU escape-time kernel.
 
 Why a hand kernel when XLA already fuses the masked loop: *block-granular
-early exit*.  The XLA path's segmented ``while_loop`` iterates until the
-slowest pixel of the whole tile finishes; this kernel walks the tile in
-``(block_h, width)`` VMEM blocks — the grid is sequential on a TPU core —
-and each block runs its own escape loop, exiting as soon as *its* pixels
-are done.  On mixed tiles (fast-escaping sky + deep interior) that recovers
-most of the CUDA reference's per-pixel early-return
+early exit* and *zero HBM loop-carry traffic*.  The XLA path's segmented
+``while_loop`` iterates until the slowest pixel of the whole tile finishes,
+and on large tiles XLA materializes the loop carry in HBM between segment
+bodies; this kernel walks the tile in ``(block_h, block_w)`` VMEM blocks —
+the grid is sequential on a TPU core — and each block runs its own escape
+loop entirely out of VMEM, exiting as soon as *its* pixels are done.  On
+mixed tiles (fast-escaping sky + deep interior) that recovers most of the
+CUDA reference's per-pixel early-return
 (``DistributedMandelbrotWorkerCUDA.py:62-67``) without divergent control
 flow: VPU-friendly masked math inside, coarse-grained exit outside.
+
+Mosaic constraint that shapes the whole kernel: this TPU toolchain cannot
+legalize ``scf.while`` whose yield carries *vectors* (every variant dies
+with "failed to legalize operation 'scf.yield'" once the carry
+disaggregates into per-vreg values — round 1 crashed on exactly this).
+``scf.for`` (``lax.fori_loop``) vector carries *do* legalize, and
+``lax.while_loop`` is fine when the carry is scalars only.  So the
+data-dependent escape loop keeps its vector state (``zr, zi, active, n``)
+in VMEM scratch refs and carries just two scalars through the while:
+the iteration counter and the live-pixel count.  Each body iteration
+loads the state, runs a small fixed unroll (:data:`DEFAULT_UNROLL`) of
+the recurrence as straight-line vector code, stores the state back, and
+reduces the mask to the scalar live count that drives the loop condition.
 
 Everything stays on device: coordinates are generated in-kernel from three
 scalars (SMEM), output is the uint8 tile block (VMEM), no HBM coordinate
@@ -26,8 +41,6 @@ import numpy as np
 from jax import lax
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
-from distributedmandelbrot_tpu.ops.escape_time import escape_loop
-
 
 def _pallas():
     """Import pallas lazily: on some builds the import itself fails unless
@@ -36,61 +49,125 @@ def _pallas():
     from jax.experimental.pallas import tpu as pltpu
     return pl, pltpu
 
-DEFAULT_BLOCK_H = 128  # 5 f32 + 1 i32 carries x 128x1024 ~ 3 MB, well under
-                       # the ~16 MB scoped-VMEM limit (256 rows OOMed at 23.5M)
-DEFAULT_SEGMENT = 32
+# Block shape: one early-exit domain.  Swept on a real v5e (2048^2 view,
+# depth 1000, K=8 tiles per dispatch to amortize the tunnel latency):
+# (64,128) and (32,128) tie at the top — ~395 Mpix/s on the full -2..2
+# view and ~232 Mpix/s on the seahorse zoom — vs 282/145 at (256,256)
+# and 291/115 at (8,128): small blocks separate sky from interior (finer
+# early exit), until per-block loop overhead bites below 32 rows.
+DEFAULT_BLOCK_H = 64
+DEFAULT_BLOCK_W = 128
+
+# Escape-loop steps per while-iteration (between early-exit checks).
+# Each step is ~12 straight-line vector ops; the unroll amortizes the
+# scratch load/store and the live-count reduction.  32 and 64 measure
+# within noise of each other; 16 loses ~10% on deep views.
+DEFAULT_UNROLL = 32
 
 
-def _escape_block_kernel(params_ref, out_ref, *, max_iter: int, segment: int,
-                         block_h: int, clamp: bool):
-    """One (block_h, W) block: device grid -> masked escape loop -> uint8."""
+def _escape_block_kernel(params_ref, out_ref, zr_ref, zi_ref, act_ref, n_ref,
+                         *, max_iter: int, unroll: int, block_h: int,
+                         block_w: int, clamp: bool):
+    """One (block_h, block_w) block: in-kernel grid -> escape loop -> uint8.
+
+    Semantics pinned to the reference kernel
+    (``DistributedMandelbrotWorkerCUDA.py:39-68,96-98``): z starts at c,
+    counts 1..max_iter-1, bailout |z|^2 >= 4 after the update, 0 = never
+    escaped, uint8 scaling ceil(v*256/max_iter) with wrap.
+    """
     pl, _ = _pallas()
     i = pl.program_id(0)
+    j = pl.program_id(1)
     start_r = params_ref[0, 0]
     start_i = params_ref[0, 1]
     step = params_ref[0, 2]
     shape = out_ref.shape
     dtype = params_ref.dtype
 
-    col = lax.broadcasted_iota(jnp.int32, shape, 1)
+    col = lax.broadcasted_iota(jnp.int32, shape, 1) + j * block_w
     row = lax.broadcasted_iota(jnp.int32, shape, 0) + i * block_h
     c_real = start_r + col.astype(dtype) * step
     c_imag = start_i + row.astype(dtype) * step
 
     total_steps = max_iter - 1
-
-    # Shared recurrence with the XLA/sharded paths — see
-    # ops/escape_time.py:escape_loop for the select-free form, the sticky
-    # active mask, and the count recovery.
     if total_steps <= 0:
-        counts = jnp.zeros(shape, jnp.int32)
-    else:
-        counts = escape_loop(c_real, c_imag, c_real, c_imag,
-                             total_steps=total_steps, segment=segment)
+        out_ref[:] = jnp.zeros(shape, jnp.uint8)
+        return
 
+    four = jnp.asarray(4.0, dtype)
+
+    zr_ref[:] = c_real
+    zi_ref[:] = c_imag
+    act_ref[:] = jnp.ones(shape, jnp.int32)
+    n_ref[:] = jnp.zeros(shape, jnp.int32)
+
+    # Select-free escape recurrence with a sticky active mask; see
+    # ops/escape_time.py:escape_loop for why stickiness matters and how
+    # the count recovers the escape iteration.  Vector state lives in the
+    # scratch refs; the while carries scalars only (Mosaic constraint).
+    # The mask stays int32 end-to-end — i1 vectors can appear only as
+    # transient compare results, never in carries or stores.
+    def seg_body(carry):
+        it, _ = carry
+        zr = zr_ref[:]
+        zi = zi_ref[:]
+        act = act_ref[:]
+        n = n_ref[:]
+        zr2 = zr * zr
+        zi2 = zi * zi
+        for _ in range(unroll):
+            zi = (zr + zr) * zi + c_imag
+            zr = zr2 - zi2 + c_real
+            zr2 = zr * zr
+            zi2 = zi * zi
+            act = act & (zr2 + zi2 < four).astype(jnp.int32)
+            n = n + act
+        zr_ref[:] = zr
+        zi_ref[:] = zi
+        act_ref[:] = act
+        n_ref[:] = n
+        # dtype pinned: under x64 a bare sum would widen to int64 and
+        # break the while carry's type invariance.
+        return (it + unroll, jnp.sum(act, dtype=jnp.int32))
+
+    def seg_cond(carry):
+        it, live = carry
+        return (it <= total_steps) & (live > 0)
+
+    lax.while_loop(seg_cond, seg_body,
+                   (jnp.asarray(1, jnp.int32),
+                    jnp.asarray(block_h * block_w, jnp.int32)))
+
+    n = n_ref[:]
+    counts = jnp.where(n >= total_steps, 0, n + 1)
     vals = (counts * 256 + (max_iter - 1)) // max_iter
     if clamp:
         vals = jnp.minimum(vals, 255)
     out_ref[:] = vals.astype(jnp.uint8)
 
 
-@partial(jax.jit, static_argnames=("height", "width", "max_iter", "segment",
-                                   "block_h", "clamp", "interpret"))
+@partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
+                                   "block_h", "block_w", "clamp", "interpret"))
 def _pallas_escape(params, *, height: int, width: int, max_iter: int,
-                   segment: int = DEFAULT_SEGMENT,
-                   block_h: int = DEFAULT_BLOCK_H, clamp: bool = False,
+                   unroll: int = DEFAULT_UNROLL,
+                   block_h: int = DEFAULT_BLOCK_H,
+                   block_w: int = DEFAULT_BLOCK_W, clamp: bool = False,
                    interpret: bool = False):
     pl, pltpu = _pallas()
     kernel = partial(_escape_block_kernel, max_iter=max_iter,
-                     segment=max(1, min(segment, max(1, max_iter - 1))),
-                     block_h=block_h, clamp=clamp)
+                     unroll=max(1, min(unroll, max(1, max_iter - 1))),
+                     block_h=block_h, block_w=block_w, clamp=clamp)
     return pl.pallas_call(
         kernel,
-        grid=(height // block_h,),
-        in_specs=[pl.BlockSpec((1, 3), lambda i: (0, 0),
+        grid=(height // block_h, width // block_w),
+        in_specs=[pl.BlockSpec((1, 3), lambda i, j: (0, 0),
                                memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec((block_h, width), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block_h, block_w), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((height, width), jnp.uint8),
+        scratch_shapes=[pltpu.VMEM((block_h, block_w), jnp.float32),
+                        pltpu.VMEM((block_h, block_w), jnp.float32),
+                        pltpu.VMEM((block_h, block_w), jnp.int32),
+                        pltpu.VMEM((block_h, block_w), jnp.int32)],
         interpret=interpret,
     )(params)
 
@@ -113,9 +190,27 @@ def pallas_importable() -> bool:
         return False
 
 
+def _fit_block(extent: int, block: int, floor: int) -> int:
+    """Largest power-of-two divisor of ``extent`` that is <= ``block``,
+    subject to the hardware granule ``floor`` (32 sublanes x 128 lanes for
+    a uint8 output block): blocks below the granule force Mosaic padding
+    on the store path, so such extents are rejected and callers fall back
+    to the XLA path."""
+    if extent % block == 0 and block % floor == 0:
+        return block
+    fit = 1 << (extent.bit_length() - 1)
+    fit = min(fit, block)
+    while fit >= floor and extent % fit:
+        fit //= 2
+    if fit < floor or fit % floor:
+        raise ValueError(f"tile extent {extent} unsupported by pallas path")
+    return fit
+
+
 def compute_tile_pallas(spec: TileSpec, max_iter: int, *,
-                        segment: int = DEFAULT_SEGMENT,
+                        unroll: int = DEFAULT_UNROLL,
                         block_h: int = DEFAULT_BLOCK_H,
+                        block_w: int | None = None,
                         clamp: bool = False,
                         interpret: bool | None = None) -> np.ndarray:
     """Compute one tile with the Pallas kernel; flat uint8, real-fastest.
@@ -123,19 +218,16 @@ def compute_tile_pallas(spec: TileSpec, max_iter: int, *,
     ``interpret=None`` auto-selects interpreter mode off-TPU (slow; for
     functional testing only).
     """
-    if spec.height % block_h:
-        block_h = max(32, 1 << (spec.height.bit_length() - 1))
-        while spec.height % block_h:
-            block_h //= 2
-        if block_h < 8:
-            raise ValueError(
-                f"tile height {spec.height} unsupported by pallas path")
+    if block_w is None:
+        block_w = min(DEFAULT_BLOCK_W, spec.width)
+    block_h = _fit_block(spec.height, min(block_h, spec.height), floor=32)
+    block_w = _fit_block(spec.width, block_w, floor=128)
     if interpret is None:
         interpret = not pallas_available()
     step = spec.range_real / (spec.width - 1)
     params = jnp.asarray([[spec.start_real, spec.start_imag, step]],
                          jnp.float32)
     out = _pallas_escape(params, height=spec.height, width=spec.width,
-                         max_iter=max_iter, segment=segment, block_h=block_h,
-                         clamp=clamp, interpret=interpret)
+                         max_iter=max_iter, unroll=unroll, block_h=block_h,
+                         block_w=block_w, clamp=clamp, interpret=interpret)
     return np.asarray(out).ravel()

@@ -3,9 +3,11 @@
 from distributedmandelbrot_tpu.worker.backends import (ComputeBackend,
                                                        JaxBackend,
                                                        NativeBackend,
-                                                       NumpyBackend)
+                                                       NumpyBackend,
+                                                       PallasBackend,
+                                                       auto_backend)
 from distributedmandelbrot_tpu.worker.client import DistributerClient
 from distributedmandelbrot_tpu.worker.worker import Worker
 
 __all__ = ["ComputeBackend", "JaxBackend", "NativeBackend", "NumpyBackend",
-           "DistributerClient", "Worker"]
+           "PallasBackend", "auto_backend", "DistributerClient", "Worker"]

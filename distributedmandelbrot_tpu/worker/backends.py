@@ -7,6 +7,9 @@ interchangeable implementations:
 
 - :class:`NumpyBackend` — the bit-exact golden path (slow; parity anchor)
 - :class:`JaxBackend` — single-device ``jit`` kernel, f32 fast / f64 exact-ish
+- :class:`PallasBackend` — the TPU throughput path (block-early-exit
+  Pallas kernel, f32); selected automatically on TPU by
+  :func:`auto_backend`
 - the sharded mesh backend lives in
   :mod:`distributedmandelbrot_tpu.parallel` (batch pmap/shard_map)
 
@@ -95,3 +98,47 @@ class JaxBackend:
                                          w.max_iter, dtype=self.dtype,
                                          segment=self.segment)
                 for w in workloads]
+
+
+class PallasBackend:
+    """TPU throughput path: the Pallas block-early-exit kernel (f32 only;
+    coordinates generated in-kernel, so nothing but three scalars crosses
+    host->device per tile).  Falls back to interpret mode off-TPU, which
+    is correct but slow — use :func:`auto_backend` unless testing."""
+
+    def __init__(self, definition: int = CHUNK_WIDTH,
+                 clamp: bool = False) -> None:
+        from distributedmandelbrot_tpu.ops.pallas_escape import (
+            compute_tile_pallas)
+        self._compute = compute_tile_pallas
+        self.definition = definition
+        self.clamp = clamp
+
+    def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
+        out = []
+        for w in workloads:
+            spec = _spec_for(w, self.definition)
+            try:
+                out.append(self._compute(spec, w.max_iter, clamp=self.clamp))
+            except ValueError:
+                # Tile smaller than the kernel's (32, 128) block granule —
+                # the XLA path handles any shape.
+                out.append(escape_time.compute_tile(spec, w.max_iter,
+                                                    clamp=self.clamp))
+        return out
+
+
+def auto_backend(definition: int = CHUNK_WIDTH,
+                 dtype: np.dtype = np.float32) -> ComputeBackend:
+    """Best available single-device backend: Pallas on a live TPU (f32
+    fast path), JAX otherwise (and always for f64 — the Pallas kernel is
+    f32-only — or for tiles below the kernel's 128-lane block floor)."""
+    if np.dtype(dtype) == np.float32 and definition >= 128:
+        try:
+            from distributedmandelbrot_tpu.ops.pallas_escape import (
+                pallas_available)
+            if pallas_available():
+                return PallasBackend(definition=definition)
+        except Exception:
+            pass
+    return JaxBackend(definition=definition, dtype=dtype)

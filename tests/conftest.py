@@ -28,7 +28,17 @@ jax.config.update("jax_enable_x64", True)
 try:  # Drop any remotely-tunneled accelerator plugin registered at startup.
     import jax._src.xla_bridge as _xb
 
+    # Pop every factory FIRST: if the jax-internal attrs used below ever
+    # change shape, the exception must not leave the tunnel-blocking
+    # factories registered (the whole suite would hang at backend init).
     for _plat in ("axon", "tpu"):
         _xb._backend_factories.pop(_plat, None)
+    for _plat in ("axon", "tpu"):
+        # Keep the platform *name* known: jax.experimental.pallas registers
+        # tpu-platform MLIR lowerings at import, and known_platforms() is
+        # derived from the factory registry we just popped — without this,
+        # the pallas import itself raises NotImplementedError and the
+        # kernel can't even run in interpret mode.
+        _xb._experimental_plugins.add(_plat)
 except Exception:
     pass
