@@ -37,6 +37,23 @@ def _configure_logging(args: argparse.Namespace) -> None:
         logging.getLogger("dmtpu").setLevel(logging.ERROR)
 
 
+def _configure_channel_logging(args: argparse.Namespace) -> None:
+    """Per-server info/error log enables (reference: -dli/-dle/-sli/-sle,
+    ``Program.cs:305-325,362-381``): disabling info leaves errors; disabling
+    errors silences the channel entirely (the reference's error callback is
+    the last-resort channel, so 'false' means fully off)."""
+    for chan, info, err in (
+            ("dmtpu.distributer", args.distributer_log_info,
+             args.distributer_log_error),
+            ("dmtpu.dataserver", args.data_server_log_info,
+             args.data_server_log_error)):
+        log = logging.getLogger(chan)
+        if err == "false":
+            log.setLevel(logging.CRITICAL + 1)
+        elif info == "false":
+            log.setLevel(logging.ERROR)
+
+
 def _add_common(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="debug logging")
@@ -93,10 +110,28 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
                         help="seconds between expired-lease sweeps")
     parser.add_argument("--fsync-index", action="store_true",
                         help="fsync the tile index on every append")
+    parser.add_argument("--read-timeout", type=float,
+                        default=proto.DEFAULT_READ_TIMEOUT,
+                        help="per-read socket deadline in seconds "
+                             "(reference's toggleable receive timeout)")
+    parser.add_argument("--no-read-timeout", action="store_true",
+                        help="disable socket read deadlines "
+                             "(reference: -t false)")
+    # Per-channel log toggles (reference: -dli/-dle/-sli/-sle,
+    # Program.cs:305-325,362-381).
+    parser.add_argument("--distributer-log-info", choices=["true", "false"],
+                        default="true")
+    parser.add_argument("--distributer-log-error", choices=["true", "false"],
+                        default="true")
+    parser.add_argument("--data-server-log-info", choices=["true", "false"],
+                        default="true")
+    parser.add_argument("--data-server-log-error", choices=["true", "false"],
+                        default="true")
     parser.add_argument("--no-info-log", action="store_true")
     _add_common(parser)
     args = parser.parse_args(argv)
     _configure_logging(args)
+    _configure_channel_logging(args)
 
     from distributedmandelbrot_tpu.coordinator import Coordinator
 
@@ -106,6 +141,7 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
         distributer_port=args.distributer_port,
         dataserver_port=args.dataserver_port,
         lease_timeout=args.lease_timeout, sweep_period=args.sweep_period,
+        read_timeout=None if args.no_read_timeout else args.read_timeout,
         fsync_index=args.fsync_index)
     total = coordinator.scheduler.total_tiles
     done = coordinator.scheduler.completed_count

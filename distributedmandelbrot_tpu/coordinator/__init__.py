@@ -5,8 +5,10 @@ from distributedmandelbrot_tpu.coordinator.clock import (Clock, ManualClock,
                                                          MonotonicClock)
 from distributedmandelbrot_tpu.coordinator.dataserver import DataServer
 from distributedmandelbrot_tpu.coordinator.distributer import Distributer
+from distributedmandelbrot_tpu.coordinator.embed import EmbeddedCoordinator
 from distributedmandelbrot_tpu.coordinator.scheduler import (Lease,
                                                              TileScheduler)
 
 __all__ = ["Coordinator", "Clock", "ManualClock", "MonotonicClock",
-           "DataServer", "Distributer", "Lease", "TileScheduler"]
+           "DataServer", "Distributer", "EmbeddedCoordinator", "Lease",
+           "TileScheduler"]

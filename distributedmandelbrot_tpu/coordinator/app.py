@@ -33,6 +33,7 @@ class Coordinator:
                  dataserver_port: int = proto.DEFAULT_DATASERVER_PORT,
                  lease_timeout: float = proto.DEFAULT_LEASE_TIMEOUT,
                  sweep_period: float = proto.DEFAULT_SWEEP_PERIOD,
+                 read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
                  clock: Optional[Clock] = None,
                  fsync_index: bool = False) -> None:
         self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index)
@@ -48,9 +49,11 @@ class Coordinator:
         self.distributer = Distributer(self.scheduler, self.store, host=host,
                                        port=distributer_port,
                                        sweep_period=sweep_period,
+                                       read_timeout=read_timeout,
                                        counters=self.counters)
         self.dataserver = DataServer(self.store, host=host,
                                      port=dataserver_port,
+                                     read_timeout=read_timeout,
                                      counters=self.counters)
 
     async def start(self) -> None:

@@ -31,10 +31,12 @@ _QUERY = struct.Struct("<III")
 class DataServer:
     def __init__(self, store: ChunkStore, *, host: str = "0.0.0.0",
                  port: int = proto.DEFAULT_DATASERVER_PORT,
+                 read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
                  counters: Optional[Counters] = None) -> None:
         self.store = store
         self.host = host
         self.port = port
+        self.read_timeout = read_timeout
         self.counters = counters if counters is not None else Counters()
         self._server: Optional[asyncio.Server] = None
 
@@ -55,9 +57,17 @@ class DataServer:
         try:
             while True:
                 try:
-                    raw = await framing.read_exact(reader, _QUERY.size)
-                except ConnectionError:
-                    break  # clean EOF between queries
+                    # Same per-read deadline as the write side (reference:
+                    # DataServer.cs:11): idle or stalled clients are closed
+                    # and re-dial instead of pinning this task.
+                    raw = await framing.read_exact(reader, _QUERY.size) \
+                        if self.read_timeout is None else \
+                        await asyncio.wait_for(
+                            framing.read_exact(reader, _QUERY.size),
+                            self.read_timeout)
+                except (ConnectionError, TimeoutError,
+                        asyncio.TimeoutError):
+                    break  # clean EOF / idle close between queries
                 level, index_real, index_imag = _QUERY.unpack(raw)
                 await self._serve_query(writer, level, index_real, index_imag)
                 await writer.drain()

@@ -47,16 +47,33 @@ class Distributer:
                  host: str = "0.0.0.0",
                  port: int = proto.DEFAULT_DISTRIBUTER_PORT,
                  sweep_period: float = proto.DEFAULT_SWEEP_PERIOD,
+                 read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
                  counters: Optional[Counters] = None) -> None:
         self.scheduler = scheduler
         self.store = store
         self.host = host
         self.port = port
         self.sweep_period = sweep_period
+        self.read_timeout = read_timeout
         self.counters = counters if counters is not None else Counters()
         self._server: Optional[asyncio.Server] = None
         self._sweep_task: Optional[asyncio.Task] = None
         self._save_tasks: set[asyncio.Task] = set()
+
+    async def _read(self, coro):
+        """Apply the configured read deadline (reference: the toggleable
+        socket receive timeout, Distributer.cs:17).  A client that stalls
+        mid-frame raises TimeoutError and loses the connection instead of
+        pinning a handler task (and its claim) until lease expiry."""
+        if self.read_timeout is None:
+            return await coro
+        try:
+            return await asyncio.wait_for(coro, self.read_timeout)
+        except (TimeoutError, asyncio.TimeoutError):
+            # asyncio.TimeoutError only aliases the builtin from 3.11 on;
+            # catching both keeps 3.10 (pyproject's floor) correct.
+            self.counters.inc("read_timeouts")
+            raise
 
     # -- lifecycle --------------------------------------------------------
 
@@ -95,9 +112,15 @@ class Distributer:
         try:
             while True:
                 try:
-                    purpose = await framing.read_byte(reader)
-                except ConnectionError:
-                    break  # clean EOF between messages
+                    # Idle deadline too: a silent client is disconnected
+                    # (it re-dials) instead of pinning this task forever.
+                    purpose = await framing.read_byte(reader) \
+                        if self.read_timeout is None else \
+                        await asyncio.wait_for(framing.read_byte(reader),
+                                               self.read_timeout)
+                except (ConnectionError, TimeoutError,
+                        asyncio.TimeoutError):
+                    break  # clean EOF / idle close between messages
                 if purpose == proto.PURPOSE_REQUEST:
                     await self._handle_request(writer)
                 elif purpose == proto.PURPOSE_RESPONSE:
@@ -111,7 +134,8 @@ class Distributer:
                                  purpose, peer)
                     break
                 await writer.drain()
-        except (ConnectionError, asyncio.CancelledError):
+        except (ConnectionError, TimeoutError, asyncio.TimeoutError,
+                asyncio.CancelledError):
             pass  # per-connection failures never take down the accept loop
         except Exception:
             logger.exception("error serving %s", peer)
@@ -135,7 +159,7 @@ class Distributer:
 
     async def _handle_batch_request(self, reader: asyncio.StreamReader,
                                     writer: asyncio.StreamWriter) -> None:
-        count = await framing.read_u32(reader)
+        count = await self._read(framing.read_u32(reader))
         grants = self.scheduler.acquire_batch(min(count, MAX_BATCH))
         if not grants:
             framing.write_byte(writer, proto.WORKLOAD_NOT_AVAILABLE)
@@ -158,14 +182,14 @@ class Distributer:
         # submission is bounded sequential work, and truncating would
         # desynchronize the stream mid-batch.  A lying count just ends in
         # EOF, which the connection handler treats as a clean close.
-        count = await framing.read_u32(reader)
+        count = await self._read(framing.read_u32(reader))
         for _ in range(count):
             await self._ingest_one(reader, writer)
 
     async def _ingest_one(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
         w = Workload.from_wire(
-            await framing.read_exact(reader, WORKLOAD_WIRE_SIZE))
+            await self._read(framing.read_exact(reader, WORKLOAD_WIRE_SIZE)))
         # Claim (consume) the lease at echo time, as the reference does
         # (Distributer.cs:404): a concurrent second submission for the same
         # tile is rejected instead of double-matching while this payload is
@@ -180,14 +204,16 @@ class Distributer:
         framing.write_byte(writer, proto.RESPONSE_ACCEPT)
         await writer.drain()
         try:
-            data = await framing.read_exact(reader, CHUNK_PIXELS)
-        except ConnectionError:  # read_exact maps short reads to this too
-            # Payload never arrived; make the tile grantable again now
-            # rather than waiting out the claim's expiry.
+            data = await self._read(framing.read_exact(reader, CHUNK_PIXELS))
+        except (ConnectionError, TimeoutError, asyncio.TimeoutError):
+            # read_exact maps short reads to ConnectionError; a stalled
+            # upload raises TimeoutError.  Either way the payload never
+            # arrived: make the tile grantable again now rather than
+            # waiting out the claim's expiry.
             self.scheduler.release_claim(w, token)
             self.counters.inc("results_dropped")
-            logger.info("dropped result for %s (connection lost mid-upload)",
-                        w)
+            logger.info("dropped result for %s (upload stalled or "
+                        "connection lost)", w)
             raise
         if not self.scheduler.finish_claim(w, token):
             # Claim expired between accept and payload arrival; drop.

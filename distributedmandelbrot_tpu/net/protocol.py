@@ -54,3 +54,10 @@ DEFAULT_DATASERVER_PORT = 59011
 # Scheduling defaults (reference: Distributer.cs:22,24 — 1 h lease, 5 min sweep)
 DEFAULT_LEASE_TIMEOUT = 3600.0
 DEFAULT_SWEEP_PERIOD = 300.0
+
+# Socket read deadline (reference: a 100 ms per-recv timeout on every client
+# socket, CLI-toggleable — Distributer.cs:17, DataServer.cs:11,
+# Program.cs:259-268).  The asyncio equivalent is a per-read deadline; the
+# default is far looser than 100 ms because a read here spans a whole frame
+# (up to the 16 MiB payload), not one recv syscall.  None disables.
+DEFAULT_READ_TIMEOUT = 60.0

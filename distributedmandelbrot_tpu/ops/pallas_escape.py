@@ -191,20 +191,55 @@ def pallas_importable() -> bool:
 
 
 def _fit_block(extent: int, block: int, floor: int) -> int:
-    """Largest power-of-two divisor of ``extent`` that is <= ``block``,
-    subject to the hardware granule ``floor`` (32 sublanes x 128 lanes for
-    a uint8 output block): blocks below the granule force Mosaic padding
-    on the store path, so such extents are rejected and callers fall back
-    to the XLA path."""
+    """``block`` if it divides ``extent``, else the largest power-of-two
+    divisor of ``extent`` below it — subject to the hardware granule
+    ``floor`` (32 sublanes x 128 lanes for a uint8 output block): blocks
+    below the granule force Mosaic padding on the store path, so such
+    extents are rejected and callers fall back to the XLA path."""
     if extent % block == 0 and block % floor == 0:
         return block
-    fit = 1 << (extent.bit_length() - 1)
-    fit = min(fit, block)
+    # Power-of-two floor of the candidate, so halving walks every
+    # power-of-two divisor candidate down to the granule.
+    fit = 1 << (min(block, extent).bit_length() - 1)
     while fit >= floor and extent % fit:
         fit //= 2
     if fit < floor or fit % floor:
         raise ValueError(f"tile extent {extent} unsupported by pallas path")
     return fit
+
+
+def fit_blocks(height: int, width: int, *,
+               block_h: int = DEFAULT_BLOCK_H,
+               block_w: int | None = None) -> tuple[int, int]:
+    """The (block_h, block_w) the kernel will actually use for a tile, with
+    granule validation — raises ValueError for unsupported extents.  Every
+    caller of :func:`_pallas_escape` must size blocks through here, or a
+    non-divisible tile silently computes only ``extent // block`` blocks."""
+    if block_w is None:
+        block_w = min(DEFAULT_BLOCK_W, width)
+    return (_fit_block(height, min(block_h, height), floor=32),
+            _fit_block(width, block_w, floor=128))
+
+
+def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
+                               unroll: int = DEFAULT_UNROLL,
+                               block_h: int = DEFAULT_BLOCK_H,
+                               block_w: int | None = None,
+                               clamp: bool = False,
+                               interpret: bool | None = None) -> jax.Array:
+    """Dispatch one tile's kernel; returns the (height, width) uint8 tile
+    still on device.  Callers that pipeline (dispatch batch, then
+    materialize) overlap compute with device->host transfers."""
+    block_h, block_w = fit_blocks(spec.height, spec.width,
+                                  block_h=block_h, block_w=block_w)
+    if interpret is None:
+        interpret = not pallas_available()
+    step = spec.range_real / (spec.width - 1)
+    params = jnp.asarray([[spec.start_real, spec.start_imag, step]],
+                         jnp.float32)
+    return _pallas_escape(params, height=spec.height, width=spec.width,
+                          max_iter=max_iter, unroll=unroll, block_h=block_h,
+                          block_w=block_w, clamp=clamp, interpret=interpret)
 
 
 def compute_tile_pallas(spec: TileSpec, max_iter: int, *,
@@ -218,16 +253,7 @@ def compute_tile_pallas(spec: TileSpec, max_iter: int, *,
     ``interpret=None`` auto-selects interpreter mode off-TPU (slow; for
     functional testing only).
     """
-    if block_w is None:
-        block_w = min(DEFAULT_BLOCK_W, spec.width)
-    block_h = _fit_block(spec.height, min(block_h, spec.height), floor=32)
-    block_w = _fit_block(spec.width, block_w, floor=128)
-    if interpret is None:
-        interpret = not pallas_available()
-    step = spec.range_real / (spec.width - 1)
-    params = jnp.asarray([[spec.start_real, spec.start_imag, step]],
-                         jnp.float32)
-    out = _pallas_escape(params, height=spec.height, width=spec.width,
-                         max_iter=max_iter, unroll=unroll, block_h=block_h,
-                         block_w=block_w, clamp=clamp, interpret=interpret)
+    out = compute_tile_pallas_device(spec, max_iter, unroll=unroll,
+                                     block_h=block_h, block_w=block_w,
+                                     clamp=clamp, interpret=interpret)
     return np.asarray(out).ravel()

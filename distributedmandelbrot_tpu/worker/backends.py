@@ -109,23 +109,27 @@ class PallasBackend:
     def __init__(self, definition: int = CHUNK_WIDTH,
                  clamp: bool = False) -> None:
         from distributedmandelbrot_tpu.ops.pallas_escape import (
-            compute_tile_pallas)
-        self._compute = compute_tile_pallas
+            compute_tile_pallas_device)
+        self._dispatch = compute_tile_pallas_device
         self.definition = definition
         self.clamp = clamp
 
     def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
-        out = []
+        # Two-phase: dispatch every tile's kernel first (the device queue
+        # runs them back to back), then materialize — compute of tile k
+        # overlaps the device->host transfer of tile k-1.
+        pending: list = []
         for w in workloads:
             spec = _spec_for(w, self.definition)
             try:
-                out.append(self._compute(spec, w.max_iter, clamp=self.clamp))
+                pending.append(self._dispatch(spec, w.max_iter,
+                                              clamp=self.clamp))
             except ValueError:
                 # Tile smaller than the kernel's (32, 128) block granule —
                 # the XLA path handles any shape.
-                out.append(escape_time.compute_tile(spec, w.max_iter,
-                                                    clamp=self.clamp))
-        return out
+                pending.append(escape_time.compute_tile(spec, w.max_iter,
+                                                        clamp=self.clamp))
+        return [np.asarray(p).ravel() for p in pending]
 
 
 def auto_backend(definition: int = CHUNK_WIDTH,
