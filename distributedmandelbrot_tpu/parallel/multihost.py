@@ -51,3 +51,66 @@ def is_primary() -> bool:
 def global_tile_mesh() -> Mesh:
     """1-D mesh over every device of every participating host."""
     return Mesh(np.array(jax.devices()), (TILE_AXIS,))
+
+
+def batched_escape_pixels_multihost(mesh: Mesh,
+                                    starts_steps_local: np.ndarray,
+                                    mrds_local: np.ndarray, *,
+                                    definition: int,
+                                    dtype=np.float32,
+                                    segment: Optional[int] = None,
+                                    clamp: bool = False) -> np.ndarray:
+    """SPMD tile batch over a multi-host mesh.
+
+    Every process calls this with its *own* tiles (the global batch is the
+    concatenation in process order); each gets back its local results as
+    uint8 ``(k_local, definition, definition)``.  Compilation is a
+    collective — all processes must make the same call with the same
+    static shapes, the SPMD contract of ``jax.distributed``.  The local
+    tile count must be identical on every process and a multiple of the
+    local device count (lease batching already works in device-count
+    multiples, so this falls out of batched dispatch).
+    """
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributedmandelbrot_tpu.ops.escape_time import DEFAULT_SEGMENT
+    from distributedmandelbrot_tpu.parallel.sharding import (
+        _batched_escape_sharded)
+
+    if segment is None:
+        segment = DEFAULT_SEGMENT
+    k_local = starts_steps_local.shape[0]
+    n_local = jax.local_device_count()
+    cap_local = int(mrds_local.max()) if k_local else 0
+    # One collective establishes BOTH agreement points before any branch
+    # can raise: validating k_local before the allgather would strand the
+    # other processes inside the collective when one host's batch is bad
+    # (they'd hang, not error).  The static iteration cap must be global
+    # because it shapes the compiled program; callers batch per level, so
+    # this is a max over identical values in practice.
+    gathered = multihost_utils.process_allgather(
+        np.asarray([k_local, cap_local], np.int64))
+    ks = gathered.reshape(-1, 2)[:, 0]
+    cap = int(gathered.reshape(-1, 2)[:, 1].max())
+    if (ks != k_local).any() or k_local == 0 or k_local % n_local:
+        raise ValueError(
+            f"every process must contribute the same non-zero multiple of "
+            f"its {n_local} local devices; local batches were {ks.tolist()}")
+    # Same widening policy as the single-host batched_escape_pixels
+    # (sharding.py): counts*256 must not overflow int32.
+    if cap - 1 > (1 << 23) or np.dtype(dtype) == np.float64:
+        from distributedmandelbrot_tpu.utils.precision import ensure_x64
+        ensure_x64()
+    mrd_dtype = np.int64 if cap - 1 > (1 << 23) else np.int32
+
+    sharding = NamedSharding(mesh, P(TILE_AXIS))
+    params = jax.make_array_from_process_local_data(
+        sharding, np.asarray(starts_steps_local, dtype))
+    mrd_arr = jax.make_array_from_process_local_data(
+        sharding, np.asarray(mrds_local, mrd_dtype))
+    out = _batched_escape_sharded(params, mrd_arr, mesh=mesh,
+                                  definition=definition, max_iter_cap=cap,
+                                  segment=segment, clamp=clamp)
+    shards = sorted(out.addressable_shards, key=lambda s: s.index[0].start)
+    return np.concatenate([np.asarray(s.data) for s in shards])

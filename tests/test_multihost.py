@@ -1,0 +1,120 @@
+"""Real 2-process ``jax.distributed`` exercise of parallel/multihost.py.
+
+Two subprocesses (2 virtual CPU devices each -> a 4-device global mesh)
+initialize the distributed runtime against a shared coordinator port,
+build :func:`global_tile_mesh`, compute a tiny tile batch through
+:func:`batched_escape_pixels_multihost`, and each verifies its local
+results against the numpy golden.  This is the CI-scale stand-in for a
+multi-host TPU slice (BASELINE.md config 5's topology), same as the
+virtual-device substitution the rest of the suite uses.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # the f64 parity path below
+try:  # drop any tunnel-blocking plugin, keep names known (see conftest.py)
+    import jax._src.xla_bridge as _xb
+    for _p in ("axon", "tpu"):
+        _xb._backend_factories.pop(_p, None)
+    for _p in ("axon", "tpu"):
+        _xb._experimental_plugins.add(_p)
+except Exception:
+    pass
+
+import numpy as np
+
+from distributedmandelbrot_tpu.parallel import multihost
+from distributedmandelbrot_tpu.core.geometry import TileSpec
+from distributedmandelbrot_tpu.ops import reference as ref
+
+port, pid = sys.argv[1], int(sys.argv[2])
+multihost.initialize(coordinator_address="127.0.0.1:" + port,
+                     num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert multihost.is_primary() == (pid == 0)
+
+mesh = multihost.global_tile_mesh()
+assert mesh.devices.size == 4, mesh.devices.size
+
+# Process p contributes tiles (2, 64, i, p) for i in 0..1: global batch of 4.
+definition = 64
+level, mrd = 2, 48
+params = np.empty((2, 3))
+specs = []
+for i in range(2):
+    spec = TileSpec.for_chunk(level, i, pid, definition=definition)
+    specs.append(spec)
+    params[i] = (spec.start_real, spec.start_imag,
+                 spec.range_real / (definition - 1))
+mrds = np.full(2, mrd, np.int64)
+
+local = multihost.batched_escape_pixels_multihost(
+    mesh, params, mrds, definition=definition, dtype=np.float64)
+assert local.shape == (2, definition, definition), local.shape
+assert local.dtype == np.uint8
+
+for i, spec in enumerate(specs):
+    # Device grids are start + k*step (not linspace), so compare against
+    # the golden on the same grid: exact in f64 up to FMA contraction.
+    step = spec.range_real / (definition - 1)
+    cr = spec.start_real + np.arange(definition)[None, :] * step
+    ci = spec.start_imag + np.arange(definition)[:, None] * step
+    want = ref.scale_counts_to_uint8(
+        ref.escape_counts(np.broadcast_to(cr, (definition, definition)),
+                          np.broadcast_to(ci, (definition, definition)), mrd),
+        mrd)
+    mism = (local[i] != want).mean()
+    assert mism <= 0.001, f"tile {i}: {mism:.2%} vs golden"
+
+print(f"proc {pid} OK")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_mesh(tmp_path):
+    port = _free_port()
+    script = tmp_path / "mh_worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen([sys.executable, str(script), str(port),
+                               str(pid)],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for pid in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert f"proc {pid} OK" in out
