@@ -103,6 +103,38 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int):
     return lambda: run(params)
 
 
+def _pallas_sharded_chain(mesh, params_np: np.ndarray, mrds: np.ndarray,
+                          tile: int):
+    """The shard_map-wrapped Pallas path, reduced on device — the mesh-
+    apples-to-apples twin of _xla_chain."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributedmandelbrot_tpu.ops.pallas_escape import (fit_blocks,
+                                                             DEFAULT_UNROLL)
+    from distributedmandelbrot_tpu.parallel.mesh import TILE_AXIS
+    from distributedmandelbrot_tpu.parallel.sharding import (
+        _batched_pallas_sharded, pad_to_mesh)
+
+    cap = int(mrds.max())
+    block_h, block_w = fit_blocks(tile, tile)
+    params_np, mrds = pad_to_mesh(params_np, mrds, mesh.devices.size)
+    sharding = NamedSharding(mesh, P(TILE_AXIS))
+    params = jax.device_put(jnp.asarray(params_np, jnp.float32), sharding)
+    mrd_arr = jax.device_put(jnp.asarray(mrds, jnp.int32), sharding)
+
+    @jax.jit
+    def run(params, mrd_arr):
+        out = _batched_pallas_sharded(params, mrd_arr, mesh=mesh,
+                                      definition=tile, max_iter_cap=cap,
+                                      unroll=DEFAULT_UNROLL, block_h=block_h,
+                                      block_w=block_w, clamp=False)
+        return jnp.sum(out.astype(jnp.int32), dtype=jnp.int32)
+
+    return lambda: run(params, mrd_arr)
+
+
 def _xla_chain(mesh, params_np: np.ndarray, mrds: np.ndarray, tile: int,
                segment: int, np_dtype):
     """The sharded XLA path, reduced on device (same methodology)."""
@@ -112,21 +144,14 @@ def _xla_chain(mesh, params_np: np.ndarray, mrds: np.ndarray, tile: int,
 
     from distributedmandelbrot_tpu.parallel.mesh import TILE_AXIS
     from distributedmandelbrot_tpu.parallel.sharding import (
-        _batched_escape_sharded)
+        _batched_escape_sharded, pad_to_mesh)
 
     cap = int(mrds.max())
-    if cap - 1 > (1 << 23):
+    if cap - 1 >= (1 << 23):
         raise ValueError("device-chain bench is int32-only; "
                          "max_iter above 2^23 needs the library path")
-    # Pad to a mesh-size multiple with trivial tiles (mirrors
-    # batched_escape_pixels); pad tiles escape immediately, so they don't
-    # perturb the measurement.
-    n_dev = mesh.devices.size
-    pad = (-params_np.shape[0]) % n_dev
-    if pad:
-        params_np = np.concatenate(
-            [params_np, np.tile([[3.0, 3.0, 0.0]], (pad, 1))])
-        mrds = np.concatenate([mrds, np.ones(pad, mrds.dtype)])
+    # Pad tiles escape immediately, so they don't perturb the measurement.
+    params_np, mrds = pad_to_mesh(params_np, mrds, mesh.devices.size)
     sharding = NamedSharding(mesh, P(TILE_AXIS))
     params = jax.device_put(jnp.asarray(params_np, np_dtype), sharding)
     mrd_arr = jax.device_put(jnp.asarray(mrds, jnp.int32), sharding)
@@ -241,7 +266,7 @@ def bench_config2(repeats: int, segment: int) -> dict:
 
 def bench_config3(repeats: int, segment: int) -> dict:
     """BASELINE config 3: 8x1024^2 batch, max_iter=5000, mesh-sharded,
-    plus 1->N scaling efficiency."""
+    best compute path, plus 1->N scaling efficiency."""
     jax, mesh, _ = _mesh_and_kernel()
     n = max(8, mesh.devices.size)
     params = _bench_params(1024, n)
@@ -249,8 +274,21 @@ def bench_config3(repeats: int, segment: int) -> dict:
 
     t_n = _time_chain(_xla_chain(mesh, params, mrds, 1024, segment,
                                  np.float32), repeats)
-    out = {"metric": f"config3 {mesh.devices.size}-device {n}x1024^2 mi=5000",
-           "value": round(_mpix(n * 1024 * 1024, t_n), 2), "unit": "Mpix/s"}
+    best, path = t_n, "xla"
+    try:
+        from distributedmandelbrot_tpu.ops.pallas_escape import (
+            pallas_available)
+        if pallas_available():
+            t_p = _time_chain(
+                _pallas_sharded_chain(mesh, params, mrds, 1024), repeats)
+            if t_p < best:
+                best, path = t_p, "pallas"
+    except Exception as e:
+        print(f"# config3 pallas path skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    out = {"metric": f"config3 {mesh.devices.size}-device {n}x1024^2 "
+                     f"mi=5000 ({path} path)",
+           "value": round(_mpix(n * 1024 * 1024, best), 2), "unit": "Mpix/s"}
     if mesh.devices.size > 1:
         from distributedmandelbrot_tpu.parallel import tile_mesh
         t_1 = _time_chain(_xla_chain(tile_mesh(1), params, mrds, 1024,

@@ -238,7 +238,7 @@ def compute_tile_julia(spec: TileSpec, c: complex, max_iter: int, *,
 def scale_counts_to_uint8(counts: jax.Array, *, max_iter: int,
                           clamp: bool = False) -> jax.Array:
     """See :func:`_scale_counts_jit`; widens beyond int32 when needed."""
-    if max_iter - 1 > (1 << 23):  # counts*256 would overflow int32's 2^31
+    if max_iter - 1 >= (1 << 23):  # counts*256 would reach int32's 2^31
         ensure_x64()
     return _scale_counts_jit(counts, max_iter=max_iter, clamp=clamp)
 
@@ -260,7 +260,7 @@ def _scale_counts_jit(counts: jax.Array, *, max_iter: int,
     int32, so the wrapper enables x64 and the math widens to int64 (still
     exact; the same gap argument holds through the uint32 wire range).
     """
-    wide = jnp.int64 if max_iter - 1 > (1 << 23) else jnp.int32
+    wide = jnp.int64 if max_iter - 1 >= (1 << 23) else jnp.int32
     vals = (counts.astype(wide) * 256 + (max_iter - 1)) // max_iter
     if clamp:
         vals = jnp.minimum(vals, 255)

@@ -65,15 +65,20 @@ DEFAULT_BLOCK_W = 128
 DEFAULT_UNROLL = 32
 
 
-def _escape_block_kernel(params_ref, out_ref, zr_ref, zi_ref, act_ref, n_ref,
-                         *, max_iter: int, unroll: int, block_h: int,
-                         block_w: int, clamp: bool):
+def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
+                         act_ref, n_ref, *, max_iter: int, unroll: int,
+                         block_h: int, block_w: int, clamp: bool):
     """One (block_h, block_w) block: in-kernel grid -> escape loop -> uint8.
 
     Semantics pinned to the reference kernel
     (``DistributedMandelbrotWorkerCUDA.py:39-68,96-98``): z starts at c,
-    counts 1..max_iter-1, bailout |z|^2 >= 4 after the update, 0 = never
-    escaped, uint8 scaling ceil(v*256/max_iter) with wrap.
+    counts 1..mrd-1, bailout |z|^2 >= 4 after the update, 0 = never
+    escaped, uint8 scaling ceil(v*256/mrd) with wrap.
+
+    ``max_iter`` is the *static* compile-time cap; the tile's actual
+    budget ``mrd <= max_iter`` arrives as an SMEM scalar, so one compiled
+    executable serves a mixed-budget batch (the sharded dispatch path)
+    and the loop still exits at the tile's own budget.
     """
     pl, _ = _pallas()
     i = pl.program_id(0)
@@ -81,6 +86,7 @@ def _escape_block_kernel(params_ref, out_ref, zr_ref, zi_ref, act_ref, n_ref,
     start_r = params_ref[0, 0]
     start_i = params_ref[0, 1]
     step = params_ref[0, 2]
+    mrd = mrd_ref[0, 0]
     shape = out_ref.shape
     dtype = params_ref.dtype
 
@@ -93,6 +99,7 @@ def _escape_block_kernel(params_ref, out_ref, zr_ref, zi_ref, act_ref, n_ref,
     if total_steps <= 0:
         out_ref[:] = jnp.zeros(shape, jnp.uint8)
         return
+    dyn_steps = mrd - 1  # this tile's own budget (traced, <= total_steps)
 
     four = jnp.asarray(4.0, dtype)
 
@@ -132,15 +139,15 @@ def _escape_block_kernel(params_ref, out_ref, zr_ref, zi_ref, act_ref, n_ref,
 
     def seg_cond(carry):
         it, live = carry
-        return (it <= total_steps) & (live > 0)
+        return (it <= dyn_steps) & (live > 0)
 
     lax.while_loop(seg_cond, seg_body,
                    (jnp.asarray(1, jnp.int32),
                     jnp.asarray(block_h * block_w, jnp.int32)))
 
     n = n_ref[:]
-    counts = jnp.where(n >= total_steps, 0, n + 1)
-    vals = (counts * 256 + (max_iter - 1)) // max_iter
+    counts = jnp.where(n >= dyn_steps, 0, n + 1)
+    vals = (counts * 256 + (mrd - 1)) // mrd
     if clamp:
         vals = jnp.minimum(vals, 255)
     out_ref[:] = vals.astype(jnp.uint8)
@@ -148,12 +155,16 @@ def _escape_block_kernel(params_ref, out_ref, zr_ref, zi_ref, act_ref, n_ref,
 
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
                                    "block_h", "block_w", "clamp", "interpret"))
-def _pallas_escape(params, *, height: int, width: int, max_iter: int,
-                   unroll: int = DEFAULT_UNROLL,
+def _pallas_escape(params, mrd=None, *, height: int, width: int,
+                   max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, clamp: bool = False,
                    interpret: bool = False):
+    """``max_iter`` is the static compile cap; ``mrd`` (defaults to the
+    cap) is this tile's traced budget — see ``_escape_block_kernel``."""
     pl, pltpu = _pallas()
+    if mrd is None:
+        mrd = jnp.asarray([[max_iter]], jnp.int32)
     kernel = partial(_escape_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
                      block_h=block_h, block_w=block_w, clamp=clamp)
@@ -161,6 +172,8 @@ def _pallas_escape(params, *, height: int, width: int, max_iter: int,
         kernel,
         grid=(height // block_h, width // block_w),
         in_specs=[pl.BlockSpec((1, 3), lambda i, j: (0, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, 1), lambda i, j: (0, 0),
                                memory_space=pltpu.SMEM)],
         out_specs=pl.BlockSpec((block_h, block_w), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((height, width), jnp.uint8),
@@ -169,7 +182,7 @@ def _pallas_escape(params, *, height: int, width: int, max_iter: int,
                         pltpu.VMEM((block_h, block_w), jnp.int32),
                         pltpu.VMEM((block_h, block_w), jnp.int32)],
         interpret=interpret,
-    )(params)
+    )(params, mrd)
 
 
 def pallas_available() -> bool:

@@ -5,8 +5,9 @@ from distributedmandelbrot_tpu.parallel.mesh import (ROW_AXIS, TILE_AXIS,
                                                      local_devices, tile_mesh,
                                                      tile_row_mesh)
 from distributedmandelbrot_tpu.parallel.sharding import (
-    batched_escape_pixels, compute_tile_row_sharded)
+    batched_escape_pixels, batched_escape_pixels_pallas,
+    compute_tile_row_sharded)
 
 __all__ = ["MeshBackend", "ROW_AXIS", "TILE_AXIS", "local_devices",
            "tile_mesh", "tile_row_mesh", "batched_escape_pixels",
-           "compute_tile_row_sharded"]
+           "batched_escape_pixels_pallas", "compute_tile_row_sharded"]

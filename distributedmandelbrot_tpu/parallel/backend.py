@@ -20,16 +20,36 @@ from distributedmandelbrot_tpu.parallel.sharding import batched_escape_pixels
 
 
 class MeshBackend:
-    """Computes tile batches sharded over a device mesh."""
+    """Computes tile batches sharded over a device mesh.
+
+    ``kernel``: ``"auto"`` uses the Pallas block-early-exit kernel under
+    shard_map on a live TPU (f32 batches whose tile shape fits the block
+    granule), the XLA path otherwise; ``"xla"`` / ``"pallas"`` force."""
 
     def __init__(self, definition: int = CHUNK_WIDTH,
                  dtype: np.dtype = np.float32,
                  segment: int = DEFAULT_SEGMENT,
-                 mesh: Optional[Mesh] = None) -> None:
+                 mesh: Optional[Mesh] = None,
+                 kernel: str = "auto") -> None:
+        if kernel not in ("auto", "xla", "pallas"):
+            raise ValueError(f"unknown kernel {kernel!r}")
         self.definition = definition
         self.dtype = dtype
         self.segment = segment
         self.mesh = mesh if mesh is not None else tile_mesh()
+        self.kernel = kernel
+
+    def _use_pallas(self) -> bool:
+        if self.kernel == "pallas":
+            if np.dtype(self.dtype) != np.float32:
+                # Forcing must never silently compute something else.
+                raise ValueError("kernel='pallas' is f32-only")
+            return True
+        if self.kernel == "xla" or np.dtype(self.dtype) != np.float32:
+            return False
+        from distributedmandelbrot_tpu.ops.pallas_escape import (
+            pallas_available)
+        return pallas_available()
 
     def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
         if not workloads:
@@ -42,7 +62,20 @@ class MeshBackend:
             params[i] = (spec.start_real, spec.start_imag,
                          spec.range_real / (self.definition - 1))
             mrds[i] = w.max_iter
-        pixels = batched_escape_pixels(self.mesh, params, mrds,
-                                       definition=self.definition,
-                                       dtype=self.dtype, segment=self.segment)
+        pixels = None
+        if self._use_pallas():
+            from distributedmandelbrot_tpu.parallel.sharding import (
+                batched_escape_pixels_pallas)
+            try:
+                pixels = batched_escape_pixels_pallas(
+                    self.mesh, params, mrds, definition=self.definition)
+            except ValueError:
+                if self.kernel == "pallas":
+                    raise
+                pixels = None  # granule/cap mismatch -> XLA path
+        if pixels is None:
+            pixels = batched_escape_pixels(self.mesh, params, mrds,
+                                           definition=self.definition,
+                                           dtype=self.dtype,
+                                           segment=self.segment)
         return [pixels[i].ravel() for i in range(len(workloads))]

@@ -76,7 +76,7 @@ def batched_escape_pixels_multihost(mesh: Mesh,
 
     from distributedmandelbrot_tpu.ops.escape_time import DEFAULT_SEGMENT
     from distributedmandelbrot_tpu.parallel.sharding import (
-        _batched_escape_sharded)
+        INT32_SCALE_LIMIT, _batched_escape_sharded)
 
     if segment is None:
         segment = DEFAULT_SEGMENT
@@ -89,20 +89,25 @@ def batched_escape_pixels_multihost(mesh: Mesh,
     # (they'd hang, not error).  The static iteration cap must be global
     # because it shapes the compiled program; callers batch per level, so
     # this is a max over identical values in practice.
+    # The alignment flag is gathered too: with heterogeneous local device
+    # counts, validating k_local % n_local process-locally would raise on
+    # one host while the rest proceed into the sharded collective (hang).
+    ok_local = int(k_local > 0 and k_local % n_local == 0)
     gathered = multihost_utils.process_allgather(
-        np.asarray([k_local, cap_local], np.int64))
-    ks = gathered.reshape(-1, 2)[:, 0]
-    cap = int(gathered.reshape(-1, 2)[:, 1].max())
-    if (ks != k_local).any() or k_local == 0 or k_local % n_local:
+        np.asarray([k_local, cap_local, ok_local], np.int64)).reshape(-1, 3)
+    ks = gathered[:, 0]
+    cap = int(gathered[:, 1].max())
+    if (ks != k_local).any() or not gathered[:, 2].all():
         raise ValueError(
             f"every process must contribute the same non-zero multiple of "
-            f"its {n_local} local devices; local batches were {ks.tolist()}")
+            f"its local device count; local batches were {ks.tolist()}, "
+            f"alignment flags {gathered[:, 2].tolist()}")
     # Same widening policy as the single-host batched_escape_pixels
     # (sharding.py): counts*256 must not overflow int32.
-    if cap - 1 > (1 << 23) or np.dtype(dtype) == np.float64:
+    if cap - 1 >= INT32_SCALE_LIMIT or np.dtype(dtype) == np.float64:
         from distributedmandelbrot_tpu.utils.precision import ensure_x64
         ensure_x64()
-    mrd_dtype = np.int64 if cap - 1 > (1 << 23) else np.int32
+    mrd_dtype = np.int64 if cap - 1 >= INT32_SCALE_LIMIT else np.int32
 
     sharding = NamedSharding(mesh, P(TILE_AXIS))
     params = jax.make_array_from_process_local_data(

@@ -93,9 +93,28 @@ def _one_tile_pixels(params, mrd, *, definition: int, max_iter_cap: int,
                                   (definition, definition), params.dtype)
     counts = _masked_escape(c_real, c_imag, max_iter_cap, segment)
     counts = jnp.where(counts <= mrd - 1, counts, 0)
-    if max_iter_cap - 1 > (1 << 23):
+    if max_iter_cap - 1 >= INT32_SCALE_LIMIT:
         counts = counts.astype(jnp.int64)
     return _scale_pixels(counts, mrd, clamp)
+
+
+# Exact-int32 bound for the uint8 scaling: counts*256 with counts up to
+# cap-1 must stay below 2^31, so cap-1 strictly below 2^23 (a count of
+# exactly 2^23 would hit 2^31 and wrap).
+INT32_SCALE_LIMIT = (1 << 23)
+
+
+def pad_to_mesh(starts_steps: np.ndarray, mrds: np.ndarray,
+                n_dev: int) -> tuple[np.ndarray, np.ndarray]:
+    """Right-pad a tile batch to a multiple of the mesh size with trivial
+    tiles (far outside the set, budget 1 — they escape immediately)."""
+    pad = (-starts_steps.shape[0]) % n_dev
+    if pad:
+        pad_params = np.tile(np.array([[3.0, 3.0, 0.0]]), (pad, 1))
+        starts_steps = np.concatenate(
+            [starts_steps, pad_params.astype(starts_steps.dtype)])
+        mrds = np.concatenate([mrds, np.ones(pad, mrds.dtype)])
+    return starts_steps, mrds
 
 
 @partial(jax.jit,
@@ -130,15 +149,9 @@ def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
     k = starts_steps.shape[0]
     if k == 0:
         return np.zeros((0, definition, definition), np.uint8)
-    n_dev = mesh.devices.size
-    pad = (-k) % n_dev
-    if pad:
-        pad_params = np.tile(np.array([[3.0, 3.0, 0.0]]), (pad, 1))
-        starts_steps = np.concatenate(
-            [starts_steps, pad_params.astype(starts_steps.dtype)])
-        mrds = np.concatenate([mrds, np.ones(pad, mrds.dtype)])
+    starts_steps, mrds = pad_to_mesh(starts_steps, mrds, mesh.devices.size)
     cap = int(mrds.max())
-    if cap - 1 > (1 << 23):  # counts*256 must not overflow int32
+    if cap - 1 >= INT32_SCALE_LIMIT:  # counts*256 must not overflow int32
         from distributedmandelbrot_tpu.utils.precision import ensure_x64
         ensure_x64()
         mrd_dtype = jnp.int64
@@ -155,6 +168,72 @@ def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
     return np.asarray(out)[:k]
 
 
+@partial(jax.jit,
+         static_argnames=("mesh", "definition", "max_iter_cap", "unroll",
+                          "block_h", "block_w", "clamp", "interpret"))
+def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
+                            max_iter_cap: int, unroll: int, block_h: int,
+                            block_w: int, clamp: bool,
+                            interpret: bool = False):
+    """The Pallas kernel under shard_map: each device walks its tile shard
+    sequentially, every tile running the block-early-exit kernel with its
+    own traced budget (static cap = the batch max)."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import _pallas_escape
+
+    def one_tile(p, m):
+        return _pallas_escape(p[None, :], m[None, None].astype(jnp.int32),
+                              height=definition, width=definition,
+                              max_iter=max_iter_cap, unroll=unroll,
+                              block_h=block_h, block_w=block_w, clamp=clamp,
+                              interpret=interpret)
+
+    def shard_fn(p_shard, m_shard):
+        return lax.map(lambda args: one_tile(*args), (p_shard, m_shard))
+
+    # check_vma off: pallas_call's out_shape is a plain ShapeDtypeStruct
+    # with no varying-mesh-axes annotation, which the checker rejects;
+    # the computation is per-tile with no collectives, so there is
+    # nothing for the check to protect.
+    return shard_map(shard_fn, mesh=mesh,
+                     in_specs=(P(TILE_AXIS), P(TILE_AXIS)),
+                     out_specs=P(TILE_AXIS), check_vma=False)(params, mrds)
+
+
+def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
+                                 mrds: np.ndarray, *, definition: int,
+                                 clamp: bool = False,
+                                 interpret: bool | None = None) -> np.ndarray:
+    """Pallas-kernel twin of :func:`batched_escape_pixels` (f32 only).
+
+    Raises ValueError when the tile shape doesn't fit the kernel's block
+    granule or the iteration cap needs int64 — callers fall back to the
+    XLA path (see :meth:`MeshBackend.compute_batch`).
+    """
+    from distributedmandelbrot_tpu.ops.pallas_escape import (fit_blocks,
+                                                             pallas_available,
+                                                             DEFAULT_UNROLL)
+
+    k = starts_steps.shape[0]
+    if k == 0:
+        return np.zeros((0, definition, definition), np.uint8)
+    cap = int(mrds.max())
+    if cap - 1 >= INT32_SCALE_LIMIT:
+        raise ValueError("pallas path is int32-only; cap needs the XLA path")
+    block_h, block_w = fit_blocks(definition, definition)
+    if interpret is None:
+        interpret = not pallas_available()
+    starts_steps, mrds = pad_to_mesh(starts_steps, mrds, mesh.devices.size)
+    sharding = NamedSharding(mesh, P(TILE_AXIS))
+    params = jax.device_put(jnp.asarray(starts_steps, jnp.float32), sharding)
+    mrd_arr = jax.device_put(jnp.asarray(mrds, jnp.int32), sharding)
+    out = _batched_pallas_sharded(params, mrd_arr, mesh=mesh,
+                                  definition=definition, max_iter_cap=cap,
+                                  unroll=DEFAULT_UNROLL, block_h=block_h,
+                                  block_w=block_w, clamp=clamp,
+                                  interpret=interpret)
+    return np.asarray(out)[:k]
+
+
 @partial(jax.jit, static_argnames=("mesh", "definition", "max_iter", "segment",
                                    "clamp"))
 def _row_sharded_tile(start_r, start_i, step, *, mesh: Mesh, definition: int,
@@ -167,7 +246,7 @@ def _row_sharded_tile(start_r, start_i, step, *, mesh: Mesh, definition: int,
         c_real, c_imag = _device_grid(sr, si, st, (rows_per, definition),
                                       sr.dtype, row_offset=offset)
         counts = _masked_escape(c_real, c_imag, max_iter, segment)
-        if max_iter - 1 > (1 << 23):
+        if max_iter - 1 >= INT32_SCALE_LIMIT:
             counts = counts.astype(jnp.int64)
         return _scale_pixels(counts, jnp.asarray(max_iter, counts.dtype),
                              clamp)
@@ -186,7 +265,7 @@ def compute_tile_row_sharded(mesh: Mesh, spec: TileSpec, max_iter: int, *,
             f"tile height {spec.height} not divisible by {n_rows} row shards")
     if spec.width != spec.height:
         raise ValueError("row sharding currently requires square tiles")
-    if max_iter - 1 > (1 << 23):  # int64 scaling path needs x64 types
+    if max_iter - 1 >= INT32_SCALE_LIMIT:  # int64 scaling needs x64 types
         from distributedmandelbrot_tpu.utils.precision import ensure_x64
         ensure_x64()
     step = spec.range_real / (spec.width - 1)

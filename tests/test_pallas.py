@@ -105,3 +105,59 @@ def test_pallas_on_tpu_matches_xla():
     got = compute_tile_pallas(spec, 1000)
     want = xla_f32_reference(spec, 1000)
     assert float((got != want).mean()) <= 0.02
+
+
+def test_pallas_sharded_batch_matches_xla_batch():
+    """The shard_map-wrapped Pallas path must agree with the XLA sharded
+    path on a mixed-budget batch over the virtual 8-device mesh (each
+    tile keeps its own traced budget under one static cap)."""
+    from distributedmandelbrot_tpu.parallel import (
+        batched_escape_pixels, batched_escape_pixels_pallas, tile_mesh)
+
+    mesh = tile_mesh()
+    k = 10  # exercises the ragged pad (10 tiles on 8 devices)
+    params = np.empty((k, 3))
+    mrds = np.empty(k, dtype=np.int64)
+    for i in range(k):
+        spec = TileSpec(-0.8 + 0.05 * (i % 4), 0.05 + 0.05 * (i // 4),
+                        0.2, 0.2, width=128, height=128)
+        params[i] = (spec.start_real, spec.start_imag, 0.2 / 127)
+        mrds[i] = (40, 90, 200)[i % 3]
+    got = batched_escape_pixels_pallas(mesh, params, mrds, definition=128,
+                                       interpret=True)
+    want = batched_escape_pixels(mesh, params, mrds, definition=128,
+                                 dtype=np.float32)
+    assert got.shape == want.shape == (k, 128, 128)
+    mism = float((got != want).mean())
+    assert mism <= 0.02, f"{mism:.2%} mismatch vs XLA sharded path"
+
+
+def test_mesh_backend_pallas_kernel_selection():
+    """kernel='pallas' forces the Pallas path (interpret off-TPU) and
+    produces golden-consistent chunks; granule-unfittable tiles raise."""
+    from distributedmandelbrot_tpu.core.workload import Workload
+    from distributedmandelbrot_tpu.ops import reference as ref
+    from distributedmandelbrot_tpu.parallel import MeshBackend
+
+    backend = MeshBackend(definition=128, kernel="pallas")
+    w = Workload(2, 48, 0, 1)
+    got = backend.compute_batch([w])[0]
+    spec = TileSpec.for_chunk(2, 0, 1, definition=128)
+    step = spec.range_real / 127
+    cr = np.float32(spec.start_real) + np.arange(128, dtype=np.float32) * \
+        np.float32(step)
+    ci = np.float32(spec.start_imag) + np.arange(128, dtype=np.float32) * \
+        np.float32(step)
+    want = ref.scale_counts_to_uint8(
+        ref.escape_counts(np.broadcast_to(cr, (128, 128)).astype(np.float64),
+                          np.broadcast_to(ci[:, None], (128, 128))
+                          .astype(np.float64), 48), 48).ravel()
+    assert float((got != want).mean()) <= 0.01
+
+    small = MeshBackend(definition=64, kernel="pallas")
+    with pytest.raises(ValueError):
+        small.compute_batch([w])
+
+    # auto falls back to XLA for the same unfittable shape instead.
+    auto = MeshBackend(definition=64, kernel="auto")
+    assert auto.compute_batch([w])[0].shape == (64 * 64,)
