@@ -146,10 +146,11 @@ def _xla_chain(mesh, params_np: np.ndarray, mrds: np.ndarray, tile: int,
     from distributedmandelbrot_tpu.parallel.sharding import (
         _batched_escape_sharded, pad_to_mesh)
 
+    from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
     cap = int(mrds.max())
-    if cap - 1 >= (1 << 23):
+    if cap - 1 >= INT32_SCALE_LIMIT:
         raise ValueError("device-chain bench is int32-only; "
-                         "max_iter above 2^23 needs the library path")
+                         "this max_iter needs the library path")
     # Pad tiles escape immediately, so they don't perturb the measurement.
     params_np, mrds = pad_to_mesh(params_np, mrds, mesh.devices.size)
     sharding = NamedSharding(mesh, P(TILE_AXIS))

@@ -117,6 +117,9 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
     parser.add_argument("--no-read-timeout", action="store_true",
                         help="disable socket read deadlines "
                              "(reference: -t false)")
+    parser.add_argument("--stats-period", type=float, default=60.0,
+                        help="seconds between progress/throughput log "
+                             "lines (0 disables)")
     # Per-channel log toggles (reference: -dli/-dle/-sli/-sle,
     # Program.cs:305-325,362-381).
     parser.add_argument("--distributer-log-info", choices=["true", "false"],
@@ -142,7 +145,7 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
         dataserver_port=args.dataserver_port,
         lease_timeout=args.lease_timeout, sweep_period=args.sweep_period,
         read_timeout=None if args.no_read_timeout else args.read_timeout,
-        fsync_index=args.fsync_index)
+        fsync_index=args.fsync_index, stats_period=args.stats_period)
     total = coordinator.scheduler.total_tiles
     done = coordinator.scheduler.completed_count
     print(f"coordinator: {len(settings)} level(s), {total} tiles "
@@ -154,7 +157,7 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
     return 0
 
 
-def _make_backend(name: str, dtype: str):
+def _make_backend(name: str, dtype: str, kernel: str = "auto"):
     np_dtype = _NP_DTYPES[dtype]
     if name == "numpy":
         from distributedmandelbrot_tpu.worker import NumpyBackend
@@ -177,7 +180,7 @@ def _make_backend(name: str, dtype: str):
         return auto_backend(dtype=np_dtype)
     if name == "mesh":
         from distributedmandelbrot_tpu.parallel import MeshBackend
-        return MeshBackend(dtype=np_dtype)
+        return MeshBackend(dtype=np_dtype, kernel=kernel)
     raise ValueError(f"unknown backend {name!r}")
 
 
@@ -201,13 +204,19 @@ def cmd_worker(argv: Sequence[str]) -> int:
     parser.add_argument("--poll", type=float, default=0.0,
                         help="keep polling every N seconds after the "
                              "coordinator drains (default: exit)")
+    parser.add_argument("--kernel", choices=["auto", "xla", "pallas"],
+                        default="auto",
+                        help="compute kernel for the mesh backend")
+    parser.add_argument("--profile", metavar="DIR", default="",
+                        help="capture a jax.profiler trace of the run into "
+                             "DIR (view with TensorBoard / Perfetto)")
     _add_common(parser)
     args = parser.parse_args(argv)
     _configure_logging(args)
 
     from distributedmandelbrot_tpu.worker import DistributerClient, Worker
 
-    backend = _make_backend(args.backend, args.dtype)
+    backend = _make_backend(args.backend, args.dtype, args.kernel)
     batch_size = args.batch_size
     if batch_size <= 0:
         if args.backend == "mesh":
@@ -217,6 +226,11 @@ def cmd_worker(argv: Sequence[str]) -> int:
             batch_size = 1
     worker = Worker(DistributerClient(args.host, args.port), backend,
                     batch_size=batch_size)
+    profiling = False
+    if args.profile:
+        import jax
+        jax.profiler.start_trace(args.profile)
+        profiling = True
     try:
         if args.poll > 0:
             worker.run_forever(poll_interval=args.poll)
@@ -232,6 +246,11 @@ def cmd_worker(argv: Sequence[str]) -> int:
         print(f"error: cannot reach coordinator at {args.host}:{args.port} "
               f"({e})", file=sys.stderr)
         return 1
+    finally:
+        if profiling:
+            import jax
+            jax.profiler.stop_trace()
+            print(f"profile trace written to {args.profile}", flush=True)
     return 0
 
 

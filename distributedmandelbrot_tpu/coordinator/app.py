@@ -35,7 +35,8 @@ class Coordinator:
                  sweep_period: float = proto.DEFAULT_SWEEP_PERIOD,
                  read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
                  clock: Optional[Clock] = None,
-                 fsync_index: bool = False) -> None:
+                 fsync_index: bool = False,
+                 stats_period: float = 0.0) -> None:
         self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index)
         completed = self.store.completed_keys(
             levels=[s.level for s in level_settings])
@@ -55,14 +56,50 @@ class Coordinator:
                                      port=dataserver_port,
                                      read_timeout=read_timeout,
                                      counters=self.counters)
+        self.stats_period = stats_period
+        self._stats_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
         await self.distributer.start()
         await self.dataserver.start()
+        if self.stats_period > 0:
+            self._stats_task = asyncio.create_task(self._stats_loop())
 
     async def stop(self) -> None:
+        if self._stats_task is not None:
+            self._stats_task.cancel()
+            try:
+                await self._stats_task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                # A previously-failed stats task must never prevent the
+                # services below from shutting down.
+                logger.exception("stats task had failed")
         await self.distributer.stop()
         await self.dataserver.stop()
+
+    async def _stats_loop(self) -> None:
+        """Periodic progress/throughput report (survey §5.1/§5.5 — the
+        reference has no observability at all; operators watch this)."""
+        last: dict[str, int] = {}
+        while True:
+            await asyncio.sleep(self.stats_period)
+            try:
+                snap = self.counters.snapshot()
+                delta = {k: v - last.get(k, 0) for k, v in snap.items()
+                         if v != last.get(k, 0)}
+                last = snap
+                logger.info(
+                    "stats: %d/%d tiles complete, %d leased; totals %s; "
+                    "last %.0fs %s",
+                    self.scheduler.completed_count,
+                    self.scheduler.total_tiles,
+                    self.scheduler.outstanding_leases, snap,
+                    self.stats_period, delta or "(idle)")
+            except Exception:
+                # Reporting must never kill itself (or shutdown, see stop).
+                logger.exception("stats reporting failed")
 
     async def run_forever(self) -> None:
         await self.start()

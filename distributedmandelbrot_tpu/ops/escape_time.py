@@ -54,6 +54,12 @@ from distributedmandelbrot_tpu.utils.precision import ensure_x64
 
 DEFAULT_SEGMENT = 32
 
+# Highest escape count whose exact uint8 scaling stays within int32:
+# the scaled value is counts*256 + (mrd-1) with mrd <= counts+1, so the
+# worst case is counts*257, and counts <= (2^31-1)//257 is safe.  Budgets
+# with max_iter - 1 >= this widen to int64 (and x64 mode).
+INT32_SCALE_LIMIT = (2**31 - 1) // 257 + 1  # 8,355,968
+
 # Cap on how many escape iterations are ever unrolled into a flat op chain.
 # Segments larger than this run as an inner fori_loop of MAX_UNROLL-step
 # unrolled bodies: identical semantics, but compile time stays bounded —
@@ -238,7 +244,7 @@ def compute_tile_julia(spec: TileSpec, c: complex, max_iter: int, *,
 def scale_counts_to_uint8(counts: jax.Array, *, max_iter: int,
                           clamp: bool = False) -> jax.Array:
     """See :func:`_scale_counts_jit`; widens beyond int32 when needed."""
-    if max_iter - 1 >= (1 << 23):  # counts*256 would reach int32's 2^31
+    if max_iter - 1 >= INT32_SCALE_LIMIT:  # scaling would wrap int32
         ensure_x64()
     return _scale_counts_jit(counts, max_iter=max_iter, clamp=clamp)
 
@@ -260,7 +266,7 @@ def _scale_counts_jit(counts: jax.Array, *, max_iter: int,
     int32, so the wrapper enables x64 and the math widens to int64 (still
     exact; the same gap argument holds through the uint32 wire range).
     """
-    wide = jnp.int64 if max_iter - 1 >= (1 << 23) else jnp.int32
+    wide = jnp.int64 if max_iter - 1 >= INT32_SCALE_LIMIT else jnp.int32
     vals = (counts.astype(wide) * 256 + (max_iter - 1)) // max_iter
     if clamp:
         vals = jnp.minimum(vals, 255)

@@ -243,6 +243,11 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
     """Dispatch one tile's kernel; returns the (height, width) uint8 tile
     still on device.  Callers that pipeline (dispatch batch, then
     materialize) overlap compute with device->host transfers."""
+    from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
+    if max_iter - 1 >= INT32_SCALE_LIMIT:
+        # In-kernel scaling is int32; such budgets need the XLA path
+        # (callers catch ValueError and fall back).
+        raise ValueError(f"max_iter {max_iter} too deep for the pallas path")
     block_h, block_w = fit_blocks(spec.height, spec.width,
                                   block_h=block_h, block_w=block_w)
     if interpret is None:

@@ -33,6 +33,9 @@ class MeshBackend:
                  kernel: str = "auto") -> None:
         if kernel not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown kernel {kernel!r}")
+        if kernel == "pallas" and np.dtype(dtype) != np.float32:
+            # Fail at construction, not after a tile has been leased.
+            raise ValueError("kernel='pallas' is f32-only")
         self.definition = definition
         self.dtype = dtype
         self.segment = segment
@@ -41,10 +44,7 @@ class MeshBackend:
 
     def _use_pallas(self) -> bool:
         if self.kernel == "pallas":
-            if np.dtype(self.dtype) != np.float32:
-                # Forcing must never silently compute something else.
-                raise ValueError("kernel='pallas' is f32-only")
-            return True
+            return True  # dtype validated at construction
         if self.kernel == "xla" or np.dtype(self.dtype) != np.float32:
             return False
         from distributedmandelbrot_tpu.ops.pallas_escape import (

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
 from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
+                                                       INT32_SCALE_LIMIT,
                                                        escape_loop)
 from distributedmandelbrot_tpu.parallel.mesh import ROW_AXIS, TILE_AXIS
 
@@ -96,12 +97,6 @@ def _one_tile_pixels(params, mrd, *, definition: int, max_iter_cap: int,
     if max_iter_cap - 1 >= INT32_SCALE_LIMIT:
         counts = counts.astype(jnp.int64)
     return _scale_pixels(counts, mrd, clamp)
-
-
-# Exact-int32 bound for the uint8 scaling: counts*256 with counts up to
-# cap-1 must stay below 2^31, so cap-1 strictly below 2^23 (a count of
-# exactly 2^23 would hit 2^31 and wrap).
-INT32_SCALE_LIMIT = (1 << 23)
 
 
 def pad_to_mesh(starts_steps: np.ndarray, mrds: np.ndarray,
