@@ -320,8 +320,35 @@ def bench_config4(repeats: int) -> dict:
         # ensure_x64 is global and sticky; later configs (and the farm)
         # must not inherit int64 promotion this TPU can't lower.
         jax.config.update("jax_enable_x64", was_x64)
-    return {"metric": "config4 deep-zoom 1e-10 mi=50000 f64+smooth 128^2",
-            "value": round(v, 3), "unit": "Mpix/s"}
+
+    # Perturbation path: f32 delta orbits against a bigint reference —
+    # the TPU-native deep-zoom answer (direct f64 emulates slowly and
+    # stops near 1e-16; perturbation reaches ~1e-30 in f32).  Timing
+    # includes the host-side reference orbit (re-derived per call).
+    # Same view as the f64 tile above: TileSpec's coords are the CORNER,
+    # DeepTileSpec's the center — corner + span/2 aligns them.
+    out = {"metric": "config4 deep-zoom 1e-10 mi=50000 128^2 "
+                     "(best of f64+smooth / f32 perturbation)",
+           "value": round(v, 3), "unit": "Mpix/s",
+           "smooth_f64_mpix_s": round(v, 3)}
+    try:
+        from distributedmandelbrot_tpu.ops import (DeepTileSpec,
+                                                   compute_counts_perturb)
+        dspec = DeepTileSpec("-0.77568376995", "0.13646737005",
+                             1e-10, width=128, height=128)
+
+        def run_perturb():
+            compute_counts_perturb(dspec, 50000, dtype=np.float32)
+            return np.zeros(())
+
+        v_p = _mpix(128 * 128, _time_chain(run_perturb,
+                                           max(1, repeats - 1)))
+        out["perturb_f32_mpix_s"] = round(v_p, 3)
+        out["value"] = round(max(v, v_p), 3)
+    except Exception as e:  # never let one path kill the bench sweep
+        print(f"# config4 perturbation skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    return out
 
 
 def bench_config5(repeats: int, segment: int) -> dict:

@@ -345,6 +345,10 @@ def cmd_render(argv: Sequence[str]) -> int:
     parser.add_argument("--max-iter", type=int, default=256)
     parser.add_argument("--smooth", action="store_true",
                         help="band-free continuous coloring (f64)")
+    parser.add_argument("--deep", action="store_true",
+                        help="perturbation deep zoom: center taken at "
+                             "arbitrary decimal precision, valid at any "
+                             "span (auto-selected below 1e-12)")
     parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
     parser.add_argument("--colormap", default="jet")
     parser.add_argument("--out", required=True, help="output PNG path")
@@ -362,12 +366,30 @@ def cmd_render(argv: Sequence[str]) -> int:
         return float(a), float(b)
 
     default_center = "0,0" if args.fractal == "julia" else "-0.5,0.0"
-    cx, cy = _pair(args.center or default_center)
+    center_str = args.center or default_center
+    cx, cy = _pair(center_str)
     spec = TileSpec(cx - args.span / 2, cy - args.span / 2,
                     args.span, args.span,
                     width=args.definition, height=args.definition)
     np_dtype = _NP_DTYPES[args.dtype]
     julia_c = complex(*_pair(args.c)) if args.fractal == "julia" else None
+
+    if args.deep or (args.span < 1e-12 and args.fractal == "mandelbrot"
+                     and not args.smooth):
+        if args.fractal == "julia" or args.smooth:
+            raise SystemExit("--deep supports mandelbrot integer counts")
+        from distributedmandelbrot_tpu.ops import (DeepTileSpec,
+                                                   compute_tile_perturb)
+        # Center strings pass through verbatim: their precision is NOT
+        # bounded by float64 (that's the point of the deep path).
+        c_re, c_im = center_str.split(",")
+        dspec = DeepTileSpec(c_re.strip(), c_im.strip(), args.span,
+                             width=args.definition, height=args.definition)
+        values = compute_tile_perturb(dspec, args.max_iter, dtype=np_dtype)
+        rgba = value_to_rgba(values.reshape(args.definition, args.definition),
+                             colormap=args.colormap)
+        _save_png(args.out, rgba)
+        return 0
 
     if args.smooth:
         from distributedmandelbrot_tpu.ops import compute_tile_smooth

@@ -1,0 +1,393 @@
+"""Perturbation-theory deep zoom: TPU-speed rendering at depths where
+direct iteration runs out of precision.
+
+The reference system's only deep-zoom story is float64 direct iteration
+(the CUDA kernel at ``DistributedMandelbrotWorkerCUDA.py:39-68`` is
+float64), which (a) emulates slowly on TPU and (b) hard-stops when the
+pixel pitch approaches 1e-16.  The classic perturbation decomposition
+removes both limits:
+
+    z_n = Z_n + dz_n
+
+where ``Z`` is ONE high-precision reference orbit for the tile center
+(computed host-side in fixed-point bigints — exact, stdlib-only) and the
+per-pixel delta obeys
+
+    dz_{n+1} = 2 Z_n dz_n + dz_n^2 + dc
+
+with every quantity now *relative* to the center, so f32/f64 device math
+suffices: the deltas span the tile (~pixel pitch scale), not the plane.
+The device kernel is a ``lax.scan`` over the truncated-orbit arrays —
+per-iteration reference values stream in as scan inputs, pixels advance
+in lockstep, and the MXU-free VPU math is identical in shape to the
+direct kernel's.
+
+Glitch handling (Pauldelbrot's criterion): where ``|z_n|`` collapses far
+below ``|Z_n|`` the catastrophic cancellation makes the delta orbit
+untrustworthy — those pixels are flagged on device and recomputed
+exactly on host in fixed point (typically a small fraction of a tile;
+the count is reported so callers can see it).  If the reference orbit
+itself escapes before the budget, iteration past that point cannot use
+the orbit — affected pixels are likewise flagged and recomputed.
+
+Capability extension past the reference: ``DeepTileSpec`` carries the
+center as *decimal strings*, so views with spans far below 1e-16 (where
+float64 cannot even address pixel coordinates) render fine — only the
+span and pixel offsets need floating point.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# Fixed-point precision floor for the reference orbit (fractional bits);
+# compute_counts_perturb widens automatically with depth so the orbit
+# always carries >= 64 bits below the pixel pitch.
+DEFAULT_PREC_BITS = 256
+
+# Pauldelbrot criterion: |z|^2 < GLITCH_TOL * |Z|^2 marks a pixel
+# glitched (cancellation ate the significand).
+GLITCH_TOL = 1e-6
+
+
+# -- host-side exact arithmetic (stdlib bigints) --------------------------
+
+
+def _to_fixed(value: str | float, bits: int) -> int:
+    """Decimal string (or float) -> fixed-point integer with ``bits``
+    fractional bits, exactly."""
+    if isinstance(value, float):
+        # Floats convert exactly: value = num/den in lowest binary terms.
+        from fractions import Fraction
+
+        f = Fraction(value)
+        return (f.numerator << bits) // f.denominator
+    s = str(value).strip()
+    neg = s.startswith("-")
+    s = s.lstrip("+-")
+    exp = 0
+    if "e" in s or "E" in s:
+        s, e = s.replace("E", "e").split("e")
+        exp = int(e)
+    if "." in s:
+        whole, frac = s.split(".")
+    else:
+        whole, frac = s, ""
+    digits = int((whole + frac) or "0")
+    exp -= len(frac)
+    # value = digits * 10^exp; scale by 2^bits exactly.
+    if exp >= 0:
+        num = digits * (10 ** exp) << bits
+    else:
+        num = (digits << bits) // (10 ** (-exp))
+    return -num if neg else num
+
+
+def _fixed_to_float(v: int, bits: int) -> float:
+    return float(v) / (1 << bits)
+
+
+def reference_orbit(center_re: str | float, center_im: str | float,
+                    max_iter: int, *,
+                    prec_bits: int = DEFAULT_PREC_BITS
+                    ) -> tuple[np.ndarray, np.ndarray, int]:
+    """High-precision escape-time orbit of the center, truncated to
+    float64 arrays.
+
+    Returns ``(Z_re, Z_im, valid_len)`` with ``Z[k] = z_{k+1}`` — the
+    orbit runs ``z_1 = c`` through ``z_{max_iter}`` (the last value the
+    reference convention ever tests), so a full in-set orbit has
+    ``valid_len == max_iter`` entries; an escaping center's orbit ends
+    with its first escaped value (stored, so pixels near the center can
+    still test against it).  Arithmetic is ``prec_bits``-bit fixed-point
+    bigint (stdlib): per-step rounding is 2^-prec_bits — for the default
+    256 bits, ~190 orders of magnitude below float64's own truncation.
+    """
+    return _orbit_fixed(_to_fixed(center_re, prec_bits),
+                        _to_fixed(center_im, prec_bits),
+                        max_iter, prec_bits)
+
+
+def _orbit_fixed(ca: int, cb: int, max_iter: int, bits: int
+                 ) -> tuple[np.ndarray, np.ndarray, int]:
+    one = 1 << bits
+    four = 4 * one * one  # |z|^2 comparisons happen at 2*bits scale
+    steps = max(1, max_iter)
+    z_re = np.empty(steps, np.float64)
+    z_im = np.empty(steps, np.float64)
+    a, b = ca, cb
+    n = 0
+    while n < steps:
+        z_re[n] = _fixed_to_float(a, bits)
+        z_im[n] = _fixed_to_float(b, bits)
+        n += 1
+        a2 = a * a
+        b2 = b * b
+        if a2 + b2 >= four:
+            break
+        a, b = (a2 - b2 >> bits) + ca, ((a * b) >> (bits - 1)) + cb
+    return z_re[:n], z_im[:n], n
+
+
+def escape_counts_exact(c_re: str | float, c_im: str | float, max_iter: int,
+                        *, prec_bits: int = DEFAULT_PREC_BITS) -> int:
+    """Reference-convention escape count of one point in fixed point
+    (the glitch-pixel fallback): 0 = never escaped within budget."""
+    return _escape_count_fixed(_to_fixed(c_re, prec_bits),
+                               _to_fixed(c_im, prec_bits),
+                               max_iter, prec_bits)
+
+
+# -- geometry -------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DeepTileSpec:
+    """A deep-zoom view: center as decimal strings (arbitrary precision),
+    span in plane units (a float — spans are small, centers are not).
+
+    Pixel (row, col) sits at center + ((col - (w-1)/2) * step,
+    (row - (h-1)/2) * step) with step = span / (width - 1): deltas from
+    the center are what the device kernel consumes, and they are
+    comfortably representable at any zoom.
+    """
+
+    center_re: str
+    center_im: str
+    span: float
+    width: int = 1024
+    height: int = 1024
+
+    @property
+    def step(self) -> float:
+        return self.span / (self.width - 1)
+
+    def delta_grids(self, dtype=np.float64) -> tuple[np.ndarray, np.ndarray]:
+        step = self.step
+        col = (np.arange(self.width) - (self.width - 1) / 2) * step
+        row = (np.arange(self.height) - (self.height - 1) / 2) * step
+        dre = np.broadcast_to(col, (self.height, self.width))
+        dim = np.broadcast_to(row[:, None], (self.height, self.width))
+        return dre.astype(dtype).copy(), dim.astype(dtype).copy()
+
+
+# -- device kernel --------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _perturb_scan(z_re, z_im, dc_re, dc_im, *, max_iter: int):
+    """Delta-orbit scan: returns (counts, glitched).
+
+    Step ``k`` receives ``Z[k] = z_{k+1}`` of the center orbit and the
+    carry holds ``dz_{k+1}`` (``dz_1 = dc``): it tests the full value
+    ``z_{k+1} = Z + dz`` and then advances the delta.  ``n`` counts
+    passed tests, so a pixel first escaping at ``z_e`` (reference count
+    ``it = e - 1``) accumulates ``n = e - 1``; pixels failing even the
+    untested-by-the-reference ``z_1`` probe (|c| > 2) get ``n = 0`` and
+    are clamped up to the reference's ``1``.  Passing every test through
+    ``z_{max_iter}`` (``n = max_iter``) means in-set -> 0.
+
+    ``glitched`` marks pixels whose delta lost significance (Pauldelbrot
+    cancellation) or that outlived an early-escaping reference orbit —
+    their counts are unreliable and must be recomputed exactly.
+    """
+    dtype = jnp.result_type(dc_re)
+    orbit_len = z_re.shape[0]
+    shape = dc_re.shape
+    four = jnp.asarray(4.0, dtype)
+    tol = jnp.asarray(GLITCH_TOL, dtype)
+
+    def step(carry, zs):
+        dzr, dzi, active, n, glitched = carry
+        zr, zi = zs
+        # Full value z_{k+1} = Z + dz; escape test on it.
+        fr = zr + dzr
+        fi = zi + dzi
+        mag2 = fr * fr + fi * fi
+        zmag2 = zr * zr + zi * zi
+        glitched = glitched | (active & (mag2 < tol * zmag2))
+        active = active & (mag2 < four)
+        n = n + active.astype(jnp.int32)
+        # dz_{k+2} = 2 Z_{k+1} dz + dz^2 + dc  (escaped lanes keep
+        # iterating, select-free — the sticky mask freezes their count).
+        ndzr = (zr + zr) * dzr - (zi + zi) * dzi \
+            + (dzr * dzr - dzi * dzi) + dc_re
+        ndzi = (zr + zr) * dzi + (zi + zi) * dzr + 2 * dzr * dzi + dc_im
+        return (ndzr, ndzi, active, n, glitched), None
+
+    init = (dc_re.astype(dtype), dc_im.astype(dtype),
+            jnp.ones(shape, jnp.bool_), jnp.zeros(shape, jnp.int32),
+            jnp.zeros(shape, jnp.bool_))
+    (dzr, dzi, active, n, glitched), _ = lax.scan(
+        step, init, (z_re.astype(dtype), z_im.astype(dtype)))
+
+    # Pixels still bounded when the (possibly escaped-early) reference
+    # orbit ran out: if the orbit covered the full budget they are
+    # in-set; otherwise their fate is unknown -> glitched.
+    if orbit_len < max_iter:
+        glitched = glitched | active
+    counts = jnp.where(n >= max_iter, 0, jnp.maximum(n, 1))
+    return counts, glitched, active
+
+
+def _find_reference(ca: int, cb: int, span: float, max_iter: int,
+                    bits: int, *, probes: int = 5, hops: int = 8
+                    ) -> tuple[np.ndarray, np.ndarray, int, float, float]:
+    """Pick a reference point whose orbit survives as long as possible.
+
+    The view center is rarely in the set, and an early-escaping reference
+    orbit strands every pixel that outlives it.  Iterative deepening
+    fixes that cheaply: compute the current candidate's orbit, scan a
+    coarse probe lattice of the tile against it (the same device kernel,
+    ``probes^2`` pixels — microseconds), and hop to a probe that outlives
+    the orbit; repeat until the orbit covers the full budget or nothing
+    in the lattice outlives it (tile is all-exterior — the longest-lived
+    candidate then covers all but a handful of pixels, which fall back
+    to exact recompute).  Returns the orbit and the chosen reference's
+    offset from the original center (plane units, pixel scale).
+    """
+    off_re = 0.0
+    off_im = 0.0
+    lat = np.linspace(-span / 2, span / 2, probes)
+    for _ in range(hops):
+        z_re, z_im, n = _orbit_fixed(ca, cb, max_iter, bits)
+        if n >= max_iter:
+            break
+        pre = np.broadcast_to(lat, (probes, probes)).ravel() - off_re
+        pim = np.repeat(lat, probes) - off_im
+        _, _, alive = _perturb_scan(
+            jnp.asarray(z_re), jnp.asarray(z_im),
+            jnp.asarray(pre.astype(np.float64)),
+            jnp.asarray(pim.astype(np.float64)), max_iter=max_iter)
+        # Hop targets are probes still bounded when the orbit ran out —
+        # NOT the glitched mask, which also contains cancellation-flagged
+        # probes that escaped earlier than the reference did.
+        alive = np.asarray(alive)
+        if not alive.any():
+            break  # every probe escapes before the orbit does
+        # Hop to the outliving probe nearest the view center.
+        idx = np.argwhere(alive).ravel()
+        best = idx[np.argmin(np.abs(pre[idx] + off_re)
+                             + np.abs(pim[idx] + off_im))]
+        d_re, d_im = float(pre[best]), float(pim[best])
+        ca += _to_fixed(d_re, bits)
+        cb += _to_fixed(d_im, bits)
+        off_re += d_re
+        off_im += d_im
+    else:
+        z_re, z_im, n = _orbit_fixed(ca, cb, max_iter, bits)
+    return z_re, z_im, n, off_re, off_im
+
+
+def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
+                           dtype=np.float32,
+                           prec_bits: int = DEFAULT_PREC_BITS,
+                           max_glitch_fix: int = 4096
+                           ) -> tuple[np.ndarray, int]:
+    """Escape counts for a deep-zoom tile via perturbation.
+
+    Returns ``(counts, n_glitched)``: int32 (height, width) counts in the
+    reference convention, and how many pixels needed the exact fixed-
+    point fallback.  Raises if more than ``max_glitch_fix`` pixels
+    glitch even with the auto-selected reference — exact recompute
+    would be quadratic; raise the probe density instead.
+
+    The delta dtype defaults to f32: deltas live at pixel scale, so the
+    precision of the *view location* comes from the bigint reference
+    orbit, not the device dtype.  The deltas themselves must still be
+    representable, which bounds f32 to spans above ~1e-30 (f64 reaches
+    ~1e-290); deeper spans are rejected rather than silently flushed to
+    a uniform tile.  ``prec_bits`` auto-widens so the orbit always
+    carries at least 64 bits below the pixel pitch.
+    """
+    if max_iter <= 1:
+        return np.zeros((spec.height, spec.width), np.int32), 0
+    span_floor = 1e-30 if np.dtype(dtype) == np.float32 else 1e-290
+    if spec.span < span_floor:
+        raise ValueError(
+            f"span {spec.span:g} below the {np.dtype(dtype).name} delta "
+            f"floor ({span_floor:g}); use a wider dtype")
+    if np.dtype(dtype) == np.float64:
+        from distributedmandelbrot_tpu.utils.precision import ensure_x64
+        ensure_x64()  # without x64, f64 requests silently truncate to f32
+    # Orbit precision tracks depth: >= 64 bits below the pixel pitch.
+    bits = max(prec_bits, int(-np.log2(max(spec.step, 1e-300))) + 64)
+    ca = _to_fixed(spec.center_re, bits)
+    cb = _to_fixed(spec.center_im, bits)
+    z_re, z_im, _, off_re, off_im = _find_reference(
+        ca, cb, spec.span, max_iter, bits)
+    dre, dim = spec.delta_grids(np.float64)
+    # Deltas are relative to the chosen reference, not the view center.
+    dre -= off_re
+    dim -= off_im
+    zr = jnp.asarray(z_re)
+    zi = jnp.asarray(z_im)
+    # Row-chunked: the scan carries 5 arrays through every step, so big
+    # tiles are walked in row bands to keep the carry VMEM-resident
+    # instead of thrashing HBM each iteration.
+    chunk = max(1, min(spec.height, (1 << 17) // max(1, spec.width)))
+    out_counts = []
+    out_glitched = []
+    for r0 in range(0, spec.height, chunk):
+        c_part, g_part, _ = _perturb_scan(
+            zr, zi,
+            jnp.asarray(dre[r0:r0 + chunk].astype(dtype)),
+            jnp.asarray(dim[r0:r0 + chunk].astype(dtype)),
+            max_iter=max_iter)
+        out_counts.append(np.asarray(c_part))
+        out_glitched.append(np.asarray(g_part))
+    counts = np.concatenate(out_counts).copy()
+    glitched = np.concatenate(out_glitched)
+    bad = np.argwhere(glitched)
+    if len(bad) > max_glitch_fix:
+        raise ValueError(
+            f"{len(bad)} glitched pixels (> {max_glitch_fix}); reference "
+            f"orbit unsuitable for this view")
+    if len(bad):
+        # Exact per-pixel recompute in fixed point.  Pixel coordinates are
+        # center + delta, formed in fixed point so no precision is lost.
+        step = spec.step
+        for r, c in bad:
+            d_re = float((c - (spec.width - 1) / 2) * step)
+            d_im = float((r - (spec.height - 1) / 2) * step)
+            pa = ca + _to_fixed(d_re, bits)
+            pb = cb + _to_fixed(d_im, bits)
+            counts[r, c] = _escape_count_fixed(pa, pb, max_iter, bits)
+    return counts, len(bad)
+
+
+def _escape_count_fixed(ca: int, cb: int, max_iter: int, bits: int) -> int:
+    """Reference convention exactly (DistributedMandelbrotWorkerCUDA.py:
+    44-68): z starts at c, each iteration updates THEN tests, counts
+    1..max_iter-1, 0 = never escaped."""
+    one = 1 << bits
+    four = 4 * one * one
+    a, b = ca, cb
+    a2, b2 = a * a, b * b
+    for it in range(1, max_iter):
+        a, b = (a2 - b2 >> bits) + ca, ((a * b) >> (bits - 1)) + cb
+        a2, b2 = a * a, b * b
+        if a2 + b2 >= four:
+            return it
+    return 0
+
+
+def compute_tile_perturb(spec: DeepTileSpec, max_iter: int, *,
+                         dtype=np.float32,
+                         prec_bits: int = DEFAULT_PREC_BITS,
+                         clamp: bool = False) -> np.ndarray:
+    """Deep-zoom tile -> flat uint8 pixels (canonical scaling/order)."""
+    from distributedmandelbrot_tpu.ops.escape_time import (
+        scale_counts_to_uint8)
+
+    counts, _ = compute_counts_perturb(spec, max_iter, dtype=dtype,
+                                       prec_bits=prec_bits)
+    pixels = scale_counts_to_uint8(jnp.asarray(counts), max_iter=max_iter,
+                                   clamp=clamp)
+    return np.asarray(pixels).ravel()

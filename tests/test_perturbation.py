@@ -1,0 +1,118 @@
+"""Perturbation deep-zoom tests.
+
+The capability this adds over the reference (whose only deep-zoom path is
+direct float64, ``DistributedMandelbrotWorkerCUDA.py:39``): TPU-speed
+f32 delta orbits against a host-side fixed-point bigint reference orbit,
+valid at zooms far below float64's ~1e-16 pixel-pitch floor.
+"""
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.ops import escape_time
+from distributedmandelbrot_tpu.ops import perturbation as P
+from distributedmandelbrot_tpu.ops import reference as ref
+
+# Misiurewicz-point neighborhood: boundary-rich at every depth (the
+# BASELINE config-4 view).
+M_RE, M_IM = "-0.77568377", "0.13646737"
+
+
+def exact_count(spec, r, c, max_iter):
+    bits = P.DEFAULT_PREC_BITS
+    ca = P._to_fixed(spec.center_re, bits)
+    cb = P._to_fixed(spec.center_im, bits)
+    d_re = float((c - (spec.width - 1) / 2) * spec.step)
+    d_im = float((r - (spec.height - 1) / 2) * spec.step)
+    return P._escape_count_fixed(ca + P._to_fixed(d_re, bits),
+                                 cb + P._to_fixed(d_im, bits),
+                                 max_iter, bits)
+
+
+def test_to_fixed_round_trip():
+    bits = 96
+    for s in ("0.5", "-1.75", "0.1", "-0.77568377", "1e-20", "-2.5e-3", "3"):
+        v = P._to_fixed(s, bits)
+        assert abs(P._fixed_to_float(v, bits) - float(s)) <= 2.0 ** -90
+    # floats convert exactly
+    for f in (0.5, -1.75, 0.1, 3.0 / 7.0):
+        assert P._fixed_to_float(P._to_fixed(f, bits), bits) == f
+
+
+def test_exact_counts_match_numpy_golden():
+    for c in (-0.5 + 0.1j, 0.3 + 0.5j, -1.8 + 0.05j, 2.5 + 0j, -0.1 + 0j):
+        want = int(ref.escape_counts(np.array([[c.real]]),
+                                     np.array([[c.imag]]), 200)[0, 0])
+        got = P.escape_counts_exact(repr(c.real), repr(c.imag), 200)
+        assert got == want, c
+
+
+def test_reference_orbit_matches_f64_iteration():
+    zr, zi, n = P.reference_orbit("-0.5", "0.1", 60)
+    assert n == 60  # -0.5+0.1i never escapes
+    z = c = -0.5 + 0.1j
+    for k in range(60):
+        assert abs(zr[k] - z.real) < 1e-15 and abs(zi[k] - z.imag) < 1e-15
+        z = z * z + c
+
+
+def test_perturb_matches_direct_f64_at_moderate_zoom():
+    spec = P.DeepTileSpec("-0.74529", "0.11307", 1e-5, width=96, height=96)
+    counts, n_fixed = P.compute_counts_perturb(spec, 1500)
+    step = spec.step
+    col = (np.arange(96) - 47.5) * step + float(spec.center_re)
+    row = (np.arange(96) - 47.5) * step + float(spec.center_im)
+    want = np.asarray(escape_time.escape_counts(
+        np.broadcast_to(col, (96, 96)).astype(np.float64),
+        np.broadcast_to(row[:, None], (96, 96)).astype(np.float64),
+        max_iter=1500))
+    mism = float((counts != want).mean())
+    # Both sides carry ulp-level noise at the chaotic boundary; parity is
+    # statistical (sampled-exact comparison below is the strong check).
+    assert mism <= 0.01, f"{mism:.2%} vs direct f64"
+    assert n_fixed < 96 * 96 * 0.05
+
+
+@pytest.mark.parametrize("span,max_iter", [(1e-10, 3000), (1e-18, 4000)])
+def test_perturb_sampled_exact(span, max_iter):
+    """Spot-check against exact fixed point — works beyond f64's floor."""
+    spec = P.DeepTileSpec(M_RE, M_IM, span, width=64, height=64)
+    counts, _ = P.compute_counts_perturb(spec, max_iter)
+    rng = np.random.default_rng(1)
+    bad = 0
+    for _ in range(12):
+        r = int(rng.integers(64))
+        c = int(rng.integers(64))
+        if counts[r, c] != exact_count(spec, r, c, max_iter):
+            bad += 1
+    assert bad <= 1, f"{bad}/12 sampled pixels disagree with exact"
+
+
+def test_perturb_escaping_center_auto_reference():
+    """A view whose center escapes early must still render correctly via
+    the auto-selected reference (round-1 failure mode of naive
+    perturbation)."""
+    # Center just outside the set: escapes fast, but the tile spans
+    # boundary structure.
+    spec = P.DeepTileSpec("-0.7453", "0.1127", 2e-4, width=64, height=64)
+    counts, n_fixed = P.compute_counts_perturb(spec, 800)
+    assert len(np.unique(counts)) > 10  # real structure, not garbage
+    for r, c in ((0, 0), (31, 31), (63, 63), (10, 50)):
+        assert counts[r, c] == exact_count(spec, r, c, 800), (r, c)
+
+
+def test_perturb_uint8_tile_and_scaling():
+    spec = P.DeepTileSpec("-0.74529", "0.11307", 1e-6, width=64, height=64)
+    pixels = P.compute_tile_perturb(spec, 300)
+    assert pixels.shape == (64 * 64,)
+    assert pixels.dtype == np.uint8
+    counts, _ = P.compute_counts_perturb(spec, 300)
+    want = np.asarray(escape_time.scale_counts_to_uint8(
+        counts.ravel(), max_iter=300))
+    np.testing.assert_array_equal(pixels, want)
+
+
+def test_perturb_trivial_budget():
+    spec = P.DeepTileSpec("0", "0", 1e-3, width=32, height=32)
+    counts, n_fixed = P.compute_counts_perturb(spec, 1)
+    assert (counts == 0).all() and n_fixed == 0
