@@ -374,20 +374,27 @@ def cmd_render(argv: Sequence[str]) -> int:
     np_dtype = _NP_DTYPES[args.dtype]
     julia_c = complex(*_pair(args.c)) if args.fractal == "julia" else None
 
-    if args.deep or (args.span < 1e-12 and args.fractal == "mandelbrot"
-                     and not args.smooth):
-        if args.fractal == "julia" or args.smooth:
-            raise SystemExit("--deep supports mandelbrot integer counts")
+    if args.deep or (args.span < 1e-12 and args.fractal == "mandelbrot"):
+        if args.fractal == "julia":
+            raise SystemExit("--deep supports the mandelbrot family")
         from distributedmandelbrot_tpu.ops import (DeepTileSpec,
+                                                   compute_smooth_perturb,
                                                    compute_tile_perturb)
         # Center strings pass through verbatim: their precision is NOT
         # bounded by float64 (that's the point of the deep path).
         c_re, c_im = center_str.split(",")
         dspec = DeepTileSpec(c_re.strip(), c_im.strip(), args.span,
                              width=args.definition, height=args.definition)
-        values = compute_tile_perturb(dspec, args.max_iter, dtype=np_dtype)
-        rgba = value_to_rgba(values.reshape(args.definition, args.definition),
-                             colormap=args.colormap)
+        if args.smooth:
+            nu, _ = compute_smooth_perturb(dspec, args.max_iter,
+                                           dtype=np_dtype)
+            rgba = smooth_to_rgba(nu, args.max_iter, colormap=args.colormap)
+        else:
+            values = compute_tile_perturb(dspec, args.max_iter,
+                                          dtype=np_dtype)
+            rgba = value_to_rgba(
+                values.reshape(args.definition, args.definition),
+                colormap=args.colormap)
         _save_png(args.out, rgba)
         return 0
 

@@ -13,10 +13,11 @@ from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
                                                        scale_counts_to_uint8)
 from distributedmandelbrot_tpu.ops.perturbation import (DeepTileSpec,
                                                         compute_counts_perturb,
+                                                        compute_smooth_perturb,
                                                         compute_tile_perturb)
 
 __all__ = ["reference", "DEFAULT_SEGMENT", "compute_tile",
            "compute_tile_julia", "compute_tile_smooth", "escape_counts",
            "escape_counts_julia", "escape_smooth", "escape_smooth_julia",
            "scale_counts_to_uint8", "DeepTileSpec", "compute_counts_perturb",
-           "compute_tile_perturb"]
+           "compute_smooth_perturb", "compute_tile_perturb"]

@@ -90,7 +90,21 @@ def _to_fixed(value: str | float, bits: int) -> int:
 
 
 def _fixed_to_float(v: int, bits: int) -> float:
-    return float(v) / (1 << bits)
+    """Fixed-point -> float64 without materializing float(v): the orbit
+    extension stores values up to ~1e100, whose bigints exceed float64
+    range once ``bits`` > ~690 (deep-zoom precision widening)."""
+    if v == 0:
+        return 0.0
+    import math
+
+    m = abs(v)
+    shift = m.bit_length() - 53
+    if shift > 0:
+        # Round to nearest, not truncate — keeps exact round trips.
+        out = math.ldexp((m + (1 << (shift - 1))) >> shift, shift - bits)
+    else:
+        out = math.ldexp(m, -bits)
+    return -out if v < 0 else out
 
 
 def reference_orbit(center_re: str | float, center_im: str | float,
@@ -103,9 +117,11 @@ def reference_orbit(center_re: str | float, center_im: str | float,
     Returns ``(Z_re, Z_im, valid_len)`` with ``Z[k] = z_{k+1}`` — the
     orbit runs ``z_1 = c`` through ``z_{max_iter}`` (the last value the
     reference convention ever tests), so a full in-set orbit has
-    ``valid_len == max_iter`` entries; an escaping center's orbit ends
-    with its first escaped value (stored, so pixels near the center can
-    still test against it).  Arithmetic is ``prec_bits``-bit fixed-point
+    ``valid_len == max_iter``.  The ARRAYS extend past ``valid_len`` by
+    up to 12 further true orbit values (post-escape they diverge) so
+    pixels escaping near the orbit's end can reach the smooth-coloring
+    radius; consumers needing only the tested orbit must slice
+    ``Z[:valid_len]``.  Arithmetic is ``prec_bits``-bit fixed-point
     bigint (stdlib): per-step rounding is 2^-prec_bits — for the default
     256 bits, ~190 orders of magnitude below float64's own truncation.
     """
@@ -114,25 +130,35 @@ def reference_orbit(center_re: str | float, center_im: str | float,
                         max_iter, prec_bits)
 
 
-def _orbit_fixed(ca: int, cb: int, max_iter: int, bits: int
-                 ) -> tuple[np.ndarray, np.ndarray, int]:
+def _orbit_fixed(ca: int, cb: int, max_iter: int, bits: int,
+                 extra: int = 12) -> tuple[np.ndarray, np.ndarray, int]:
+    """Orbit entries ``z_1..`` plus up to ``extra`` true diverging steps
+    past the first escape (or past the budget), so pixels escaping near
+    the orbit's end can still reach the smooth-coloring radius.  The
+    returned ``valid_len`` counts only the pre-extension entries; the
+    arrays may be longer.  Post-escape values square each step, so the
+    extension stops before float64 overflow (~1e100)."""
     one = 1 << bits
     four = 4 * one * one  # |z|^2 comparisons happen at 2*bits scale
+    huge = (10 ** 100) * one * one
     steps = max(1, max_iter)
-    z_re = np.empty(steps, np.float64)
-    z_im = np.empty(steps, np.float64)
+    z_re = np.empty(steps + extra, np.float64)
+    z_im = np.empty(steps + extra, np.float64)
     a, b = ca, cb
     n = 0
-    while n < steps:
+    valid = None
+    while n < steps + extra:
         z_re[n] = _fixed_to_float(a, bits)
         z_im[n] = _fixed_to_float(b, bits)
         n += 1
         a2 = a * a
         b2 = b * b
-        if a2 + b2 >= four:
+        if valid is None and (n >= steps or a2 + b2 >= four):
+            valid = n
+        if valid is not None and (n >= valid + extra or a2 + b2 >= huge):
             break
         a, b = (a2 - b2 >> bits) + ca, ((a * b) >> (bits - 1)) + cb
-    return z_re[:n], z_im[:n], n
+    return z_re[:n], z_im[:n], valid if valid is not None else n
 
 
 def escape_counts_exact(c_re: str | float, c_im: str | float, max_iter: int,
@@ -261,8 +287,11 @@ def _find_reference(ca: int, cb: int, span: float, max_iter: int,
             break
         pre = np.broadcast_to(lat, (probes, probes)).ravel() - off_re
         pim = np.repeat(lat, probes) - off_im
+        # Probe against the orbit's VALID prefix: the post-escape
+        # extension (there for smooth laggards) diverges and would
+        # corrupt the alive mask with cancellation noise.
         _, _, alive = _perturb_scan(
-            jnp.asarray(z_re), jnp.asarray(z_im),
+            jnp.asarray(z_re[:n]), jnp.asarray(z_im[:n]),
             jnp.asarray(pre.astype(np.float64)),
             jnp.asarray(pim.astype(np.float64)), max_iter=max_iter)
         # Hop targets are probes still bounded when the orbit ran out —
@@ -285,29 +314,19 @@ def _find_reference(ca: int, cb: int, span: float, max_iter: int,
     return z_re, z_im, n, off_re, off_im
 
 
-def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
-                           dtype=np.float32,
-                           prec_bits: int = DEFAULT_PREC_BITS,
-                           max_glitch_fix: int = 4096
-                           ) -> tuple[np.ndarray, int]:
-    """Escape counts for a deep-zoom tile via perturbation.
+def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
+                     dtype, prec_bits: int, max_glitch_fix: int
+                     ) -> tuple[np.ndarray, int]:
+    """Shared perturbation driver: validates the span/dtype combination,
+    widens orbit precision with depth, auto-selects the reference, runs
+    ``scan_fn(zr, zi, dre, dim)`` over row chunks (it returns a value
+    plane and a glitch mask), and patches glitched pixels with their
+    exact fixed-point escape count.
 
-    Returns ``(counts, n_glitched)``: int32 (height, width) counts in the
-    reference convention, and how many pixels needed the exact fixed-
-    point fallback.  Raises if more than ``max_glitch_fix`` pixels
-    glitch even with the auto-selected reference — exact recompute
-    would be quadratic; raise the probe density instead.
-
-    The delta dtype defaults to f32: deltas live at pixel scale, so the
-    precision of the *view location* comes from the bigint reference
-    orbit, not the device dtype.  The deltas themselves must still be
-    representable, which bounds f32 to spans above ~1e-30 (f64 reaches
-    ~1e-290); deeper spans are rejected rather than silently flushed to
-    a uniform tile.  ``prec_bits`` auto-widens so the orbit always
-    carries at least 64 bits below the pixel pitch.
+    Spans must keep deltas representable: ~1e-30 floor for f32 deltas,
+    ~1e-290 for f64 — deeper spans are rejected rather than silently
+    flushed to a uniform tile.
     """
-    if max_iter <= 1:
-        return np.zeros((spec.height, spec.width), np.int32), 0
     span_floor = 1e-30 if np.dtype(dtype) == np.float32 else 1e-290
     if spec.span < span_floor:
         raise ValueError(
@@ -328,38 +347,68 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
     dim -= off_im
     zr = jnp.asarray(z_re)
     zi = jnp.asarray(z_im)
-    # Row-chunked: the scan carries 5 arrays through every step, so big
+    # Row-chunked: the scan carries its state through every step, so big
     # tiles are walked in row bands to keep the carry VMEM-resident
     # instead of thrashing HBM each iteration.
     chunk = max(1, min(spec.height, (1 << 17) // max(1, spec.width)))
-    out_counts = []
-    out_glitched = []
+    vals, glitches = [], []
     for r0 in range(0, spec.height, chunk):
-        c_part, g_part, _ = _perturb_scan(
+        v_part, g_part = scan_fn(
             zr, zi,
             jnp.asarray(dre[r0:r0 + chunk].astype(dtype)),
-            jnp.asarray(dim[r0:r0 + chunk].astype(dtype)),
-            max_iter=max_iter)
-        out_counts.append(np.asarray(c_part))
-        out_glitched.append(np.asarray(g_part))
-    counts = np.concatenate(out_counts).copy()
-    glitched = np.concatenate(out_glitched)
+            jnp.asarray(dim[r0:r0 + chunk].astype(dtype)))
+        vals.append(np.asarray(v_part))
+        glitches.append(np.asarray(g_part))
+    out = np.concatenate(vals).copy()
+    glitched = np.concatenate(glitches)
     bad = np.argwhere(glitched)
     if len(bad) > max_glitch_fix:
         raise ValueError(
             f"{len(bad)} glitched pixels (> {max_glitch_fix}); reference "
             f"orbit unsuitable for this view")
-    if len(bad):
-        # Exact per-pixel recompute in fixed point.  Pixel coordinates are
-        # center + delta, formed in fixed point so no precision is lost.
-        step = spec.step
-        for r, c in bad:
-            d_re = float((c - (spec.width - 1) / 2) * step)
-            d_im = float((r - (spec.height - 1) / 2) * step)
-            pa = ca + _to_fixed(d_re, bits)
-            pb = cb + _to_fixed(d_im, bits)
-            counts[r, c] = _escape_count_fixed(pa, pb, max_iter, bits)
-    return counts, len(bad)
+    # Exact per-pixel recompute in fixed point.  Pixel coordinates are
+    # center + delta, formed in fixed point so no precision is lost.
+    # (On the smooth plane this patches an *integer* count — a one-level
+    # banding artifact on isolated pixels.)
+    step = spec.step
+    for r, c in bad:
+        d_re = float((c - (spec.width - 1) / 2) * step)
+        d_im = float((r - (spec.height - 1) / 2) * step)
+        pa = ca + _to_fixed(d_re, bits)
+        pb = cb + _to_fixed(d_im, bits)
+        out[r, c] = _escape_count_fixed(pa, pb, max_iter, bits)
+    return out, len(bad)
+
+
+def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
+                           dtype=np.float32,
+                           prec_bits: int = DEFAULT_PREC_BITS,
+                           max_glitch_fix: int = 4096
+                           ) -> tuple[np.ndarray, int]:
+    """Escape counts for a deep-zoom tile via perturbation.
+
+    Returns ``(counts, n_glitched)``: int32 (height, width) counts in the
+    reference convention, and how many pixels needed the exact fixed-
+    point fallback.  Raises if more than ``max_glitch_fix`` pixels
+    glitch even with the auto-selected reference — exact recompute
+    would be quadratic; raise the probe density instead.
+
+    The delta dtype defaults to f32: deltas live at pixel scale, so the
+    precision of the *view location* comes from the bigint reference
+    orbit, not the device dtype (see :func:`_compute_perturb` for the
+    span floors and precision widening).
+    """
+    if max_iter <= 1:
+        return np.zeros((spec.height, spec.width), np.int32), 0
+
+    def scan(zr, zi, dre, dim):
+        counts, glitched, _ = _perturb_scan(zr, zi, dre, dim,
+                                            max_iter=max_iter)
+        return counts, glitched
+
+    return _compute_perturb(spec, max_iter, scan, dtype=dtype,
+                            prec_bits=prec_bits,
+                            max_glitch_fix=max_glitch_fix)
 
 
 def _escape_count_fixed(ca: int, cb: int, max_iter: int, bits: int) -> int:
@@ -391,3 +440,93 @@ def compute_tile_perturb(spec: DeepTileSpec, max_iter: int, *,
     pixels = scale_counts_to_uint8(jnp.asarray(counts), max_iter=max_iter,
                                    clamp=clamp)
     return np.asarray(pixels).ravel()
+
+
+# -- smooth (band-free) coloring ------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("max_iter", "bailout"))
+def _perturb_scan_smooth(z_re, z_im, dc_re, dc_im, *, max_iter: int,
+                         bailout: float):
+    """Smooth twin of :func:`_perturb_scan`: additionally freezes the
+    full value at the first radius-``bailout`` crossing, from which the
+    renormalized iteration count is recovered (the delta keeps iterating
+    select-free; only the frozen full value is load-bearing).  Returns
+    ``(nu, glitched)`` with the same conventions as
+    :func:`~distributedmandelbrot_tpu.ops.escape_time.escape_smooth`:
+    0 = in-set (radius-2 budget exhausted), else the continuous count.
+    """
+    dtype = jnp.result_type(dc_re)
+    orbit_len = z_re.shape[0]
+    shape = dc_re.shape
+    four = jnp.asarray(4.0, dtype)
+    b2 = jnp.asarray(bailout * bailout, dtype)
+    tol = jnp.asarray(GLITCH_TOL, dtype)
+
+    def step(carry, zs):
+        dzr, dzi, act_b, n, act2, n2, fzr, fzi, glitched = carry
+        zr, zi = zs
+        fr = zr + dzr
+        fi = zi + dzi
+        mag2 = fr * fr + fi * fi
+        zmag2 = zr * zr + zi * zi
+        glitched = glitched | (act2 & (mag2 < tol * zmag2))
+        newly = act_b & (mag2 >= b2)
+        fzr = jnp.where(newly, fr, fzr)
+        fzi = jnp.where(newly, fi, fzi)
+        act_b = act_b & (mag2 < b2)
+        n = n + act_b.astype(jnp.int32)
+        # Radius-2 count runs alongside so in-set classification matches
+        # the integer path exactly (sticky, like escape_smooth's).
+        act2 = act2 & (mag2 < four)
+        n2 = n2 + act2.astype(jnp.int32)
+        ndzr = (zr + zr) * dzr - (zi + zi) * dzi \
+            + (dzr * dzr - dzi * dzi) + dc_re
+        ndzi = (zr + zr) * dzi + (zi + zi) * dzr + 2 * dzr * dzi + dc_im
+        return (ndzr, ndzi, act_b, n, act2, n2, fzr, fzi, glitched), None
+
+    ones = jnp.ones(shape, jnp.bool_)
+    zeros_i = jnp.zeros(shape, jnp.int32)
+    init = (dc_re.astype(dtype), dc_im.astype(dtype), ones, zeros_i,
+            ones, zeros_i, jnp.full(shape, bailout, dtype),
+            jnp.zeros(shape, dtype), jnp.zeros(shape, jnp.bool_))
+    (dzr, dzi, act_b, n, act2, n2, fzr, fzi, glitched), _ = lax.scan(
+        step, init, (z_re.astype(dtype), z_im.astype(dtype)))
+
+    if orbit_len < max_iter:
+        glitched = glitched | act2
+    # Scan-n counts passed radius-bailout tests over z_1..: one more than
+    # escape_smooth's update-counting n, hence the +1 (its formula adds
+    # +2).  Laggards that crossed radius 2 but not the smoothing radius
+    # within the orbit get the same log_ratio >= 1 clamp.
+    mag2 = jnp.maximum(fzr * fzr + fzi * fzi, b2)
+    log_ratio = jnp.log(mag2) / jnp.asarray(2.0 * np.log(bailout), dtype)
+    nu = (n + 1).astype(dtype) - jnp.log2(log_ratio)
+    nu = jnp.where(n2 >= max_iter, jnp.zeros((), dtype), nu)
+    return nu, glitched
+
+
+def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
+                           dtype=np.float32,
+                           prec_bits: int = DEFAULT_PREC_BITS,
+                           bailout: float = 256.0,
+                           max_glitch_fix: int = 4096
+                           ) -> tuple[np.ndarray, int]:
+    """Smooth (band-free) deep-zoom values via perturbation.
+
+    Returns ``(nu, n_glitched)``: float (height, width) renormalized
+    counts (0 = in-set), and the number of glitched pixels patched with
+    their *integer* count from the exact fixed-point fallback (a one-
+    level banding artifact on those isolated pixels — acceptable, since
+    the alternative is arbitrary-precision log arithmetic).
+    """
+    if max_iter <= 1:
+        return np.zeros((spec.height, spec.width), dtype), 0
+
+    def scan(zr, zi, dre, dim):
+        return _perturb_scan_smooth(zr, zi, dre, dim, max_iter=max_iter,
+                                    bailout=float(bailout))
+
+    return _compute_perturb(spec, max_iter, scan, dtype=dtype,
+                            prec_bits=prec_bits,
+                            max_glitch_fix=max_glitch_fix)

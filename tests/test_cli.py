@@ -1,0 +1,84 @@
+"""CLI surface tests: the render paths (integer / smooth / julia / deep)
+and argument plumbing that e2e farm tests don't touch."""
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu import cli
+
+
+def _png_size(path):
+    import struct
+    with open(path, "rb") as f:
+        data = f.read(24)
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
+    w, h = struct.unpack(">II", data[16:24])
+    return w, h
+
+
+def test_render_integer_counts(tmp_path):
+    out = tmp_path / "m.png"
+    rc = cli.main(["render", "--definition", "64", "--max-iter", "64",
+                   "--out", str(out)])
+    assert rc == 0
+    assert _png_size(out) == (64, 64)
+
+
+def test_render_julia_negative_constant(tmp_path):
+    out = tmp_path / "j.png"
+    rc = cli.main(["render", "--fractal", "julia", "--c", "-0.8,0.156",
+                   "--definition", "64", "--max-iter", "64",
+                   "--out", str(out)])
+    assert rc == 0
+    assert _png_size(out) == (64, 64)
+
+
+def test_render_smooth(tmp_path):
+    out = tmp_path / "s.png"
+    rc = cli.main(["render", "--smooth", "--definition", "64",
+                   "--max-iter", "64", "--span", "0.01",
+                   "--center", "-0.748,0.09", "--out", str(out)])
+    assert rc == 0
+    assert _png_size(out) == (64, 64)
+
+
+def test_render_deep_flag_and_auto_switch(tmp_path):
+    out = tmp_path / "d.png"
+    rc = cli.main(["render", "--deep", "--definition", "64",
+                   "--max-iter", "300", "--span", "1e-6",
+                   "--center", "-0.74529,0.11307", "--out", str(out)])
+    assert rc == 0
+    assert _png_size(out) == (64, 64)
+    # span below 1e-12 auto-selects the deep path (would be a blank or
+    # aliased tile on the direct f64 path at definition 64)
+    out2 = tmp_path / "d2.png"
+    rc = cli.main(["render", "--definition", "64", "--max-iter", "300",
+                   "--span", "1e-14",
+                   "--center", "-0.77568377,0.13646737", "--out", str(out2)])
+    assert rc == 0
+
+
+def test_render_deep_rejects_julia(tmp_path):
+    with pytest.raises(SystemExit):
+        cli.main(["render", "--deep", "--fractal", "julia",
+                  "--definition", "64", "--out", str(tmp_path / "x.png")])
+
+
+def test_worker_backend_validation():
+    with pytest.raises(SystemExit):
+        cli.main(["worker", "--backend", "pallas", "--dtype", "f64"])
+
+
+def test_parse_level_settings_roundtrip():
+    from distributedmandelbrot_tpu.core.workload import parse_level_settings
+    s = parse_level_settings("4:256,10:1024")
+    assert [(x.level, x.max_iter) for x in s] == [(4, 256), (10, 1024)]
+
+
+def test_render_deep_smooth(tmp_path):
+    out = tmp_path / "ds.png"
+    rc = cli.main(["render", "--deep", "--smooth", "--definition", "64",
+                   "--max-iter", "400", "--span", "1e-6",
+                   "--center", "-0.74529,0.11307", "--out", str(out)])
+    assert rc == 0
+    assert _png_size(out) == (64, 64)
