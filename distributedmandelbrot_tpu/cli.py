@@ -80,6 +80,51 @@ def _join_negative_values(argv: Sequence[str], flags: Sequence[str]) -> list:
     return out
 
 
+# Below this span, float64 pixel coordinates alias and the renderer
+# switches to the perturbation path (center at decimal-string precision).
+DEEP_SPAN_THRESHOLD = 1e-12
+
+
+def _render_view(c_re: str, c_im: str, span: float, definition: int,
+                 max_iter: int, *, smooth: bool, np_dtype, colormap: str,
+                 deep: bool | None = None):
+    """One Mandelbrot view -> RGBA, choosing direct vs perturbation
+    rendering.  Shared by the render and animate commands so their
+    behavior can never diverge; ``deep=None`` auto-selects below
+    :data:`DEEP_SPAN_THRESHOLD`."""
+    from distributedmandelbrot_tpu.core.geometry import TileSpec
+    from distributedmandelbrot_tpu.viewer import smooth_to_rgba, value_to_rgba
+
+    if deep is None:
+        deep = span < DEEP_SPAN_THRESHOLD
+    if deep:
+        from distributedmandelbrot_tpu.ops import (DeepTileSpec,
+                                                   compute_smooth_perturb,
+                                                   compute_tile_perturb)
+        # Center strings pass through verbatim: their precision is NOT
+        # bounded by float64 (that's the point of the deep path).
+        dspec = DeepTileSpec(c_re, c_im, span, width=definition,
+                             height=definition)
+        if smooth:
+            nu, _ = compute_smooth_perturb(dspec, max_iter, dtype=np_dtype)
+            return smooth_to_rgba(nu, max_iter, colormap=colormap)
+        values = compute_tile_perturb(dspec, max_iter, dtype=np_dtype)
+        return value_to_rgba(values.reshape(definition, definition),
+                             colormap=colormap)
+
+    cx, cy = float(c_re), float(c_im)
+    spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
+                    width=definition, height=definition)
+    if smooth:
+        from distributedmandelbrot_tpu.ops import compute_tile_smooth
+        nu = compute_tile_smooth(spec, max_iter, dtype=np.float64)
+        return smooth_to_rgba(nu, max_iter, colormap=colormap)
+    from distributedmandelbrot_tpu.ops import compute_tile
+    values = compute_tile(spec, max_iter, dtype=np_dtype)
+    return value_to_rgba(values.reshape(spec.height, spec.width),
+                         colormap=colormap)
+
+
 def _save_png(path: str, rgba) -> None:
     import matplotlib
     matplotlib.use("Agg")
@@ -374,27 +419,15 @@ def cmd_render(argv: Sequence[str]) -> int:
     np_dtype = _NP_DTYPES[args.dtype]
     julia_c = complex(*_pair(args.c)) if args.fractal == "julia" else None
 
-    if args.deep or (args.span < 1e-12 and args.fractal == "mandelbrot"):
+    if args.deep or (args.span < DEEP_SPAN_THRESHOLD
+                     and args.fractal == "mandelbrot"):
         if args.fractal == "julia":
             raise SystemExit("--deep supports the mandelbrot family")
-        from distributedmandelbrot_tpu.ops import (DeepTileSpec,
-                                                   compute_smooth_perturb,
-                                                   compute_tile_perturb)
-        # Center strings pass through verbatim: their precision is NOT
-        # bounded by float64 (that's the point of the deep path).
-        c_re, c_im = center_str.split(",")
-        dspec = DeepTileSpec(c_re.strip(), c_im.strip(), args.span,
-                             width=args.definition, height=args.definition)
-        if args.smooth:
-            nu, _ = compute_smooth_perturb(dspec, args.max_iter,
-                                           dtype=np_dtype)
-            rgba = smooth_to_rgba(nu, args.max_iter, colormap=args.colormap)
-        else:
-            values = compute_tile_perturb(dspec, args.max_iter,
-                                          dtype=np_dtype)
-            rgba = value_to_rgba(
-                values.reshape(args.definition, args.definition),
-                colormap=args.colormap)
+        c_re, c_im = (s.strip() for s in center_str.split(","))
+        rgba = _render_view(c_re, c_im, args.span, args.definition,
+                            args.max_iter, smooth=args.smooth,
+                            np_dtype=np_dtype, colormap=args.colormap,
+                            deep=True)
         _save_png(args.out, rgba)
         return 0
 
@@ -418,15 +451,76 @@ def cmd_render(argv: Sequence[str]) -> int:
     return 0
 
 
+def cmd_animate(argv: Sequence[str]) -> int:
+    """Zoom animation: a geometric span sweep rendered frame by frame
+    (the view-level shape of BASELINE config 5's 60-frame zoom).  Frames
+    switch automatically from the direct kernels to perturbation once
+    the span drops below float64's useful pixel pitch, so one animation
+    can run from the full set down to ~1e-30 without banding or
+    pixelation."""
+    parser = argparse.ArgumentParser(
+        prog="dmtpu animate",
+        description="Render a zoom animation as numbered PNG frames.")
+    parser.add_argument("--center", required=True,
+                        help="zoom target as RE,IM (decimal strings — "
+                             "precision beyond float64 is honored on "
+                             "deep frames)")
+    parser.add_argument("--span-start", type=float, default=4.0)
+    parser.add_argument("--span-end", type=float, default=1e-6)
+    parser.add_argument("--frames", type=int, default=60)
+    parser.add_argument("--definition", type=int, default=512)
+    parser.add_argument("--max-iter", type=int, default=1000)
+    parser.add_argument("--smooth", action="store_true",
+                        help="band-free coloring on every frame")
+    parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
+    parser.add_argument("--colormap", default="jet")
+    parser.add_argument("--out-dir", required=True,
+                        help="directory for frame_NNNN.png files")
+    _add_common(parser)
+    args = parser.parse_args(_join_negative_values(argv, ("--center",)))
+    _configure_logging(args)
+    if args.frames < 1:
+        raise SystemExit("--frames must be >= 1")
+    if args.span_end <= 0 or args.span_start <= 0:
+        raise SystemExit("spans must be positive")
+
+    import os
+    import time
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    c_re, c_im = (s.strip() for s in args.center.split(","))
+    np_dtype = _NP_DTYPES[args.dtype]
+    ratio = (args.span_end / args.span_start) ** (
+        1.0 / max(1, args.frames - 1))
+
+    t0 = time.monotonic()
+    for f in range(args.frames):
+        span = args.span_start * ratio ** f
+        deep = span < DEEP_SPAN_THRESHOLD
+        rgba = _render_view(c_re, c_im, span, args.definition,
+                            args.max_iter, smooth=args.smooth,
+                            np_dtype=np_dtype, colormap=args.colormap)
+        path = os.path.join(args.out_dir, f"frame_{f:04d}.png")
+        _save_png(path, rgba)
+        print(f"frame {f + 1}/{args.frames} span {span:.3g}"
+              f"{' (deep)' if deep else ''} -> {path}", flush=True)
+    dt = time.monotonic() - t0
+    pixels = args.frames * args.definition * args.definition
+    print(f"animation done: {args.frames} frames, "
+          f"{pixels / dt / 1e6:.1f} Mpix/s end-to-end", flush=True)
+    return 0
+
+
 COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
-            "viewer": cmd_viewer, "render": cmd_render}
+            "viewer": cmd_viewer, "render": cmd_render,
+            "animate": cmd_animate}
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
-              "{coordinator|worker|viewer|render} [options]\n"
+              "{coordinator|worker|viewer|render|animate} [options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

@@ -112,7 +112,8 @@ def reference_orbit(center_re: str | float, center_im: str | float,
                     prec_bits: int = DEFAULT_PREC_BITS
                     ) -> tuple[np.ndarray, np.ndarray, int]:
     """High-precision escape-time orbit of the center, truncated to
-    float64 arrays.
+    float64 arrays.  The arrays are shared with an LRU cache — treat
+    them as read-only.
 
     Returns ``(Z_re, Z_im, valid_len)`` with ``Z[k] = z_{k+1}`` — the
     orbit runs ``z_1 = c`` through ``z_{max_iter}`` (the last value the
@@ -130,6 +131,10 @@ def reference_orbit(center_re: str | float, center_im: str | float,
                         max_iter, prec_bits)
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=8)
 def _orbit_fixed(ca: int, cb: int, max_iter: int, bits: int,
                  extra: int = 12) -> tuple[np.ndarray, np.ndarray, int]:
     """Orbit entries ``z_1..`` plus up to ``extra`` true diverging steps
@@ -137,7 +142,12 @@ def _orbit_fixed(ca: int, cb: int, max_iter: int, bits: int,
     the orbit's end can still reach the smooth-coloring radius.  The
     returned ``valid_len`` counts only the pre-extension entries; the
     arrays may be longer.  Post-escape values square each step, so the
-    extension stops before float64 overflow (~1e100)."""
+    extension stops before float64 overflow (~1e100).
+
+    LRU-cached (treat the returned arrays as immutable): a zoom
+    animation re-renders the same center at every frame, and the orbit
+    depends only on (center, budget, precision) — with precision
+    quantized by the caller, frames share one bigint computation."""
     one = 1 << bits
     four = 4 * one * one  # |z|^2 comparisons happen at 2*bits scale
     huge = (10 ** 100) * one * one
@@ -335,8 +345,11 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     if np.dtype(dtype) == np.float64:
         from distributedmandelbrot_tpu.utils.precision import ensure_x64
         ensure_x64()  # without x64, f64 requests silently truncate to f32
-    # Orbit precision tracks depth: >= 64 bits below the pixel pitch.
-    bits = max(prec_bits, int(-np.log2(max(spec.step, 1e-300))) + 64)
+    # Orbit precision tracks depth (>= 64 bits below the pixel pitch),
+    # quantized to 128-bit steps so consecutive animation frames land on
+    # the same precision and hit the orbit cache.
+    need = int(-np.log2(max(spec.step, 1e-300))) + 64
+    bits = max(prec_bits, -(-need // 128) * 128)
     ca = _to_fixed(spec.center_re, bits)
     cb = _to_fixed(spec.center_im, bits)
     z_re, z_im, _, off_re, off_im = _find_reference(

@@ -82,3 +82,16 @@ def test_render_deep_smooth(tmp_path):
                    "--center", "-0.74529,0.11307", "--out", str(out)])
     assert rc == 0
     assert _png_size(out) == (64, 64)
+
+
+def test_animate_spans_shallow_and_deep(tmp_path):
+    """A 3-frame sweep crossing the deep threshold renders every frame
+    (direct kernel for shallow frames, perturbation below 1e-12)."""
+    rc = cli.main(["animate", "--center", "-0.77568377,0.13646737",
+                   "--span-start", "1e-4", "--span-end", "1e-13",
+                   "--frames", "3", "--definition", "48",
+                   "--max-iter", "200", "--out-dir", str(tmp_path)])
+    assert rc == 0
+    frames = sorted(p.name for p in tmp_path.iterdir())
+    assert frames == ["frame_0000.png", "frame_0001.png", "frame_0002.png"]
+    assert _png_size(tmp_path / "frame_0002.png") == (48, 48)
