@@ -255,8 +255,15 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
     step = spec.range_real / (spec.width - 1)
     params = jnp.asarray([[spec.start_real, spec.start_imag, step]],
                          jnp.float32)
-    return _pallas_escape(params, height=spec.height, width=spec.width,
-                          max_iter=max_iter, unroll=unroll, block_h=block_h,
+    # The static compile cap is the budget rounded up to a power of two;
+    # the tile's true budget rides in as a traced scalar, so a farm or
+    # animation mixing budgets (256, 1000, 1024, ...) shares executables
+    # instead of compiling one per distinct max_iter.  The while loop
+    # exits at the dynamic budget — the padded cap costs nothing.
+    cap = 1 << max(8, (max_iter - 1).bit_length()) if max_iter > 1 else 1
+    mrd = jnp.asarray([[max_iter]], jnp.int32)
+    return _pallas_escape(params, mrd, height=spec.height, width=spec.width,
+                          max_iter=cap, unroll=unroll, block_h=block_h,
                           block_w=block_w, clamp=clamp, interpret=interpret)
 
 
