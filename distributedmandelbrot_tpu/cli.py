@@ -87,11 +87,12 @@ DEEP_SPAN_THRESHOLD = 1e-12
 
 def _render_view(c_re: str, c_im: str, span: float, definition: int,
                  max_iter: int, *, smooth: bool, np_dtype, colormap: str,
-                 deep: bool | None = None):
-    """One Mandelbrot view -> RGBA, choosing direct vs perturbation
-    rendering.  Shared by the render and animate commands so their
-    behavior can never diverge; ``deep=None`` auto-selects below
-    :data:`DEEP_SPAN_THRESHOLD`."""
+                 deep: bool | None = None,
+                 julia_c: tuple[str, str] | None = None):
+    """One view -> RGBA (Mandelbrot, or Julia when ``julia_c`` is set),
+    choosing direct vs perturbation rendering.  Shared by the render and
+    animate commands so their behavior can never diverge; ``deep=None``
+    auto-selects below :data:`DEEP_SPAN_THRESHOLD`."""
     from distributedmandelbrot_tpu.core.geometry import TileSpec
     from distributedmandelbrot_tpu.viewer import smooth_to_rgba, value_to_rgba
 
@@ -106,21 +107,30 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
         dspec = DeepTileSpec(c_re, c_im, span, width=definition,
                              height=definition)
         if smooth:
-            nu, _ = compute_smooth_perturb(dspec, max_iter, dtype=np_dtype)
+            nu, _ = compute_smooth_perturb(dspec, max_iter, dtype=np_dtype,
+                                           julia_c=julia_c)
             return smooth_to_rgba(nu, max_iter, colormap=colormap)
-        values = compute_tile_perturb(dspec, max_iter, dtype=np_dtype)
+        values = compute_tile_perturb(dspec, max_iter, dtype=np_dtype,
+                                      julia_c=julia_c)
         return value_to_rgba(values.reshape(definition, definition),
                              colormap=colormap)
 
     cx, cy = float(c_re), float(c_im)
+    jc = (complex(float(julia_c[0]), float(julia_c[1]))
+          if julia_c is not None else None)
     spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
                     width=definition, height=definition)
     if smooth:
         from distributedmandelbrot_tpu.ops import compute_tile_smooth
-        nu = compute_tile_smooth(spec, max_iter, dtype=np.float64)
+        nu = compute_tile_smooth(spec, max_iter, dtype=np.float64,
+                                 julia_c=jc)
         return smooth_to_rgba(nu, max_iter, colormap=colormap)
-    from distributedmandelbrot_tpu.ops import compute_tile
-    values = compute_tile(spec, max_iter, dtype=np_dtype)
+    if jc is not None:
+        from distributedmandelbrot_tpu.ops import compute_tile_julia
+        values = compute_tile_julia(spec, jc, max_iter, dtype=np_dtype)
+    else:
+        from distributedmandelbrot_tpu.ops import compute_tile
+        values = compute_tile(spec, max_iter, dtype=np_dtype)
     return value_to_rgba(values.reshape(spec.height, spec.width),
                          colormap=colormap)
 
@@ -403,50 +413,17 @@ def cmd_render(argv: Sequence[str]) -> int:
     args = parser.parse_args(_join_negative_values(argv, ("--c", "--center")))
     _configure_logging(args)
 
-    from distributedmandelbrot_tpu.core.geometry import TileSpec
-    from distributedmandelbrot_tpu.viewer import smooth_to_rgba, value_to_rgba
-
-    def _pair(s: str) -> tuple:
-        a, b = s.split(",")
-        return float(a), float(b)
-
     default_center = "0,0" if args.fractal == "julia" else "-0.5,0.0"
     center_str = args.center or default_center
-    cx, cy = _pair(center_str)
-    spec = TileSpec(cx - args.span / 2, cy - args.span / 2,
-                    args.span, args.span,
-                    width=args.definition, height=args.definition)
-    np_dtype = _NP_DTYPES[args.dtype]
-    julia_c = complex(*_pair(args.c)) if args.fractal == "julia" else None
-
-    if args.deep or (args.span < DEEP_SPAN_THRESHOLD
-                     and args.fractal == "mandelbrot"):
-        if args.fractal == "julia":
-            raise SystemExit("--deep supports the mandelbrot family")
-        c_re, c_im = (s.strip() for s in center_str.split(","))
-        rgba = _render_view(c_re, c_im, args.span, args.definition,
-                            args.max_iter, smooth=args.smooth,
-                            np_dtype=np_dtype, colormap=args.colormap,
-                            deep=True)
-        _save_png(args.out, rgba)
-        return 0
-
-    if args.smooth:
-        from distributedmandelbrot_tpu.ops import compute_tile_smooth
-        nu = compute_tile_smooth(spec, args.max_iter, dtype=np.float64,
-                                 julia_c=julia_c)
-        rgba = smooth_to_rgba(nu, args.max_iter, colormap=args.colormap)
-    else:
-        if julia_c is not None:
-            from distributedmandelbrot_tpu.ops import compute_tile_julia
-            values = compute_tile_julia(spec, julia_c, args.max_iter,
-                                        dtype=np_dtype)
-        else:
-            from distributedmandelbrot_tpu.ops import compute_tile
-            values = compute_tile(spec, args.max_iter, dtype=np_dtype)
-        rgba = value_to_rgba(values.reshape(spec.height, spec.width),
-                             colormap=args.colormap)
-
+    c_re, c_im = (s.strip() for s in center_str.split(","))
+    julia_c = tuple(s.strip() for s in args.c.split(",")) \
+        if args.fractal == "julia" else None
+    rgba = _render_view(c_re, c_im, args.span, args.definition,
+                        args.max_iter, smooth=args.smooth,
+                        np_dtype=_NP_DTYPES[args.dtype],
+                        colormap=args.colormap,
+                        deep=True if args.deep else None,
+                        julia_c=julia_c)
     _save_png(args.out, rgba)
     return 0
 
@@ -465,6 +442,10 @@ def cmd_animate(argv: Sequence[str]) -> int:
                         help="zoom target as RE,IM (decimal strings — "
                              "precision beyond float64 is honored on "
                              "deep frames)")
+    parser.add_argument("--fractal", choices=["mandelbrot", "julia"],
+                        default="mandelbrot")
+    parser.add_argument("--c", default="-0.8,0.156",
+                        help="Julia constant as RE,IM")
     parser.add_argument("--span-start", type=float, default=4.0)
     parser.add_argument("--span-end", type=float, default=1e-6)
     parser.add_argument("--frames", type=int, default=60)
@@ -477,7 +458,8 @@ def cmd_animate(argv: Sequence[str]) -> int:
     parser.add_argument("--out-dir", required=True,
                         help="directory for frame_NNNN.png files")
     _add_common(parser)
-    args = parser.parse_args(_join_negative_values(argv, ("--center",)))
+    args = parser.parse_args(
+        _join_negative_values(argv, ("--center", "--c")))
     _configure_logging(args)
     if args.frames < 1:
         raise SystemExit("--frames must be >= 1")
@@ -489,6 +471,8 @@ def cmd_animate(argv: Sequence[str]) -> int:
 
     os.makedirs(args.out_dir, exist_ok=True)
     c_re, c_im = (s.strip() for s in args.center.split(","))
+    julia_c = tuple(s.strip() for s in args.c.split(",")) \
+        if args.fractal == "julia" else None
     np_dtype = _NP_DTYPES[args.dtype]
     ratio = (args.span_end / args.span_start) ** (
         1.0 / max(1, args.frames - 1))
@@ -496,10 +480,13 @@ def cmd_animate(argv: Sequence[str]) -> int:
     t0 = time.monotonic()
     for f in range(args.frames):
         span = args.span_start * ratio ** f
+        # The decision is made once and passed down, so the progress
+        # label can never disagree with the path actually rendered.
         deep = span < DEEP_SPAN_THRESHOLD
         rgba = _render_view(c_re, c_im, span, args.definition,
                             args.max_iter, smooth=args.smooth,
-                            np_dtype=np_dtype, colormap=args.colormap)
+                            np_dtype=np_dtype, colormap=args.colormap,
+                            deep=deep, julia_c=julia_c)
         path = os.path.join(args.out_dir, f"frame_{f:04d}.png")
         _save_png(path, rgba)
         print(f"frame {f + 1}/{args.frames} span {span:.3g}"

@@ -185,6 +185,17 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
     )(params, mrd)
 
 
+def bucket_cap(max_iter: int) -> int:
+    """The static compile cap for a budget: rounded up to a power of two
+    (floor 256), so farms and animations mixing budgets (256, 1000,
+    1024, ...) share executables instead of compiling one per distinct
+    max_iter.  The kernel's while loop exits at the traced per-tile
+    budget, so the padded cap costs nothing.  Used by both dispatch
+    paths (single-tile and shard_map batch) — the caps must agree or
+    they stop sharing executables."""
+    return 1 << max(8, (max_iter - 1).bit_length()) if max_iter > 1 else 1
+
+
 def pallas_available() -> bool:
     """True when pallas imports and a TPU backend is live (interpret mode
     covers functional testing elsewhere)."""
@@ -255,12 +266,7 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
     step = spec.range_real / (spec.width - 1)
     params = jnp.asarray([[spec.start_real, spec.start_imag, step]],
                          jnp.float32)
-    # The static compile cap is the budget rounded up to a power of two;
-    # the tile's true budget rides in as a traced scalar, so a farm or
-    # animation mixing budgets (256, 1000, 1024, ...) shares executables
-    # instead of compiling one per distinct max_iter.  The while loop
-    # exits at the dynamic budget — the padded cap costs nothing.
-    cap = 1 << max(8, (max_iter - 1).bit_length()) if max_iter > 1 else 1
+    cap = bucket_cap(max_iter)
     mrd = jnp.asarray([[max_iter]], jnp.int32)
     return _pallas_escape(params, mrd, height=spec.height, width=spec.width,
                           max_iter=cap, unroll=unroll, block_h=block_h,

@@ -126,17 +126,18 @@ def reference_orbit(center_re: str | float, center_im: str | float,
     bigint (stdlib): per-step rounding is 2^-prec_bits — for the default
     256 bits, ~190 orders of magnitude below float64's own truncation.
     """
-    return _orbit_fixed(_to_fixed(center_re, prec_bits),
-                        _to_fixed(center_im, prec_bits),
-                        max_iter, prec_bits)
+    v_re = _to_fixed(center_re, prec_bits)
+    v_im = _to_fixed(center_im, prec_bits)
+    return _orbit_fixed(v_re, v_im, v_re, v_im, max_iter, prec_bits)
 
 
 from functools import lru_cache
 
 
 @lru_cache(maxsize=8)
-def _orbit_fixed(ca: int, cb: int, max_iter: int, bits: int,
-                 extra: int = 12) -> tuple[np.ndarray, np.ndarray, int]:
+def _orbit_fixed(za: int, zb: int, ca: int, cb: int, max_iter: int,
+                 bits: int, extra: int = 12
+                 ) -> tuple[np.ndarray, np.ndarray, int]:
     """Orbit entries ``z_1..`` plus up to ``extra`` true diverging steps
     past the first escape (or past the budget), so pixels escaping near
     the orbit's end can still reach the smooth-coloring radius.  The
@@ -154,7 +155,7 @@ def _orbit_fixed(ca: int, cb: int, max_iter: int, bits: int,
     steps = max(1, max_iter)
     z_re = np.empty(steps + extra, np.float64)
     z_im = np.empty(steps + extra, np.float64)
-    a, b = ca, cb
+    a, b = za, zb
     n = 0
     valid = None
     while n < steps + extra:
@@ -216,8 +217,9 @@ class DeepTileSpec:
 # -- device kernel --------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
-def _perturb_scan(z_re, z_im, dc_re, dc_im, *, max_iter: int):
+@partial(jax.jit, static_argnames=("max_iter", "add_dc"))
+def _perturb_scan(z_re, z_im, dc_re, dc_im, *, max_iter: int,
+                  add_dc: bool = True):
     """Delta-orbit scan: returns (counts, glitched).
 
     Step ``k`` receives ``Z[k] = z_{k+1}`` of the center orbit and the
@@ -250,11 +252,16 @@ def _perturb_scan(z_re, z_im, dc_re, dc_im, *, max_iter: int):
         glitched = glitched | (active & (mag2 < tol * zmag2))
         active = active & (mag2 < four)
         n = n + active.astype(jnp.int32)
-        # dz_{k+2} = 2 Z_{k+1} dz + dz^2 + dc  (escaped lanes keep
+        # dz_{k+2} = 2 Z_{k+1} dz + dz^2 [+ dc]  (escaped lanes keep
         # iterating, select-free — the sticky mask freezes their count).
-        ndzr = (zr + zr) * dzr - (zi + zi) * dzi \
-            + (dzr * dzr - dzi * dzi) + dc_re
-        ndzi = (zr + zr) * dzi + (zi + zi) * dzr + 2 * dzr * dzi + dc_im
+        # The dc term re-adds the pixel's parameter offset — Mandelbrot
+        # only; for Julia every pixel shares c, so deltas carry no dc
+        # (dz_1 is the pixel's z0 offset instead).
+        ndzr = (zr + zr) * dzr - (zi + zi) * dzi + (dzr * dzr - dzi * dzi)
+        ndzi = (zr + zr) * dzi + (zi + zi) * dzr + 2 * dzr * dzi
+        if add_dc:
+            ndzr = ndzr + dc_re
+            ndzi = ndzi + dc_im
         return (ndzr, ndzi, active, n, glitched), None
 
     init = (dc_re.astype(dtype), dc_im.astype(dtype),
@@ -272,8 +279,9 @@ def _perturb_scan(z_re, z_im, dc_re, dc_im, *, max_iter: int):
     return counts, glitched, active
 
 
-def _find_reference(ca: int, cb: int, span: float, max_iter: int,
-                    bits: int, *, probes: int = 5, hops: int = 8
+def _find_reference(za: int, zb: int, ca: int, cb: int, span: float,
+                    max_iter: int, bits: int, *, add_dc: bool = True,
+                    probes: int = 5, hops: int = 8
                     ) -> tuple[np.ndarray, np.ndarray, int, float, float]:
     """Pick a reference point whose orbit survives as long as possible.
 
@@ -292,7 +300,7 @@ def _find_reference(ca: int, cb: int, span: float, max_iter: int,
     off_im = 0.0
     lat = np.linspace(-span / 2, span / 2, probes)
     for _ in range(hops):
-        z_re, z_im, n = _orbit_fixed(ca, cb, max_iter, bits)
+        z_re, z_im, n = _orbit_fixed(za, zb, ca, cb, max_iter, bits)
         if n >= max_iter:
             break
         pre = np.broadcast_to(lat, (probes, probes)).ravel() - off_re
@@ -303,7 +311,8 @@ def _find_reference(ca: int, cb: int, span: float, max_iter: int,
         _, _, alive = _perturb_scan(
             jnp.asarray(z_re[:n]), jnp.asarray(z_im[:n]),
             jnp.asarray(pre.astype(np.float64)),
-            jnp.asarray(pim.astype(np.float64)), max_iter=max_iter)
+            jnp.asarray(pim.astype(np.float64)), max_iter=max_iter,
+            add_dc=add_dc)
         # Hop targets are probes still bounded when the orbit ran out —
         # NOT the glitched mask, which also contains cancellation-flagged
         # probes that escaped earlier than the reference did.
@@ -315,23 +324,33 @@ def _find_reference(ca: int, cb: int, span: float, max_iter: int,
         best = idx[np.argmin(np.abs(pre[idx] + off_re)
                              + np.abs(pim[idx] + off_im))]
         d_re, d_im = float(pre[best]), float(pim[best])
-        ca += _to_fixed(d_re, bits)
-        cb += _to_fixed(d_im, bits)
+        za += _to_fixed(d_re, bits)
+        zb += _to_fixed(d_im, bits)
+        if add_dc:
+            # Mandelbrot: the start point IS the parameter; both move.
+            ca, cb = za, zb
         off_re += d_re
         off_im += d_im
     else:
-        z_re, z_im, n = _orbit_fixed(ca, cb, max_iter, bits)
+        z_re, z_im, n = _orbit_fixed(za, zb, ca, cb, max_iter, bits)
     return z_re, z_im, n, off_re, off_im
 
 
 def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
-                     dtype, prec_bits: int, max_glitch_fix: int
+                     dtype, prec_bits: int, max_glitch_fix: int,
+                     julia_c: tuple[str, str] | None = None
                      ) -> tuple[np.ndarray, int]:
     """Shared perturbation driver: validates the span/dtype combination,
     widens orbit precision with depth, auto-selects the reference, runs
     ``scan_fn(zr, zi, dre, dim)`` over row chunks (it returns a value
     plane and a glitch mask), and patches glitched pixels with their
     exact fixed-point escape count.
+
+    ``julia_c`` switches to the Julia family: the tile varies the START
+    point ``z_0`` (the spec's center names a z-plane location) under the
+    fixed parameter ``c`` — the delta recurrence simply loses its ``dc``
+    term, everything else (reference selection, glitch handling, exact
+    fallback) is family-agnostic.
 
     Spans must keep deltas representable: ~1e-30 floor for f32 deltas,
     ~1e-290 for f64 — deeper spans are rejected rather than silently
@@ -350,10 +369,17 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     # the same precision and hit the orbit cache.
     need = int(-np.log2(max(spec.step, 1e-300))) + 64
     bits = max(prec_bits, -(-need // 128) * 128)
-    ca = _to_fixed(spec.center_re, bits)
-    cb = _to_fixed(spec.center_im, bits)
+    za = _to_fixed(spec.center_re, bits)
+    zb = _to_fixed(spec.center_im, bits)
+    if julia_c is None:
+        ca, cb = za, zb
+        add_dc = True
+    else:
+        ca = _to_fixed(julia_c[0], bits)
+        cb = _to_fixed(julia_c[1], bits)
+        add_dc = False
     z_re, z_im, _, off_re, off_im = _find_reference(
-        ca, cb, spec.span, max_iter, bits)
+        za, zb, ca, cb, spec.span, max_iter, bits, add_dc=add_dc)
     dre, dim = spec.delta_grids(np.float64)
     # Deltas are relative to the chosen reference, not the view center.
     dre -= off_re
@@ -387,16 +413,20 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     for r, c in bad:
         d_re = float((c - (spec.width - 1) / 2) * step)
         d_im = float((r - (spec.height - 1) / 2) * step)
-        pa = ca + _to_fixed(d_re, bits)
-        pb = cb + _to_fixed(d_im, bits)
-        out[r, c] = _escape_count_fixed(pa, pb, max_iter, bits)
+        pa = za + _to_fixed(d_re, bits)
+        pb = zb + _to_fixed(d_im, bits)
+        out[r, c] = _escape_count_fixed(
+            pa, pb, max_iter, bits,
+            ca=None if julia_c is None else ca,
+            cb=None if julia_c is None else cb)
     return out, len(bad)
 
 
 def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
                            dtype=np.float32,
                            prec_bits: int = DEFAULT_PREC_BITS,
-                           max_glitch_fix: int = 4096
+                           max_glitch_fix: int = 4096,
+                           julia_c: tuple[str, str] | None = None
                            ) -> tuple[np.ndarray, int]:
     """Escape counts for a deep-zoom tile via perturbation.
 
@@ -406,6 +436,10 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
     glitch even with the auto-selected reference — exact recompute
     would be quadratic; raise the probe density instead.
 
+    ``julia_c=(re, im)`` (decimal strings) renders the Julia set for
+    that constant instead — the spec's center then names a z-plane
+    location.
+
     The delta dtype defaults to f32: deltas live at pixel scale, so the
     precision of the *view location* comes from the bigint reference
     orbit, not the device dtype (see :func:`_compute_perturb` for the
@@ -413,24 +447,33 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
     """
     if max_iter <= 1:
         return np.zeros((spec.height, spec.width), np.int32), 0
+    add_dc = julia_c is None
 
     def scan(zr, zi, dre, dim):
         counts, glitched, _ = _perturb_scan(zr, zi, dre, dim,
-                                            max_iter=max_iter)
+                                            max_iter=max_iter,
+                                            add_dc=add_dc)
         return counts, glitched
 
     return _compute_perturb(spec, max_iter, scan, dtype=dtype,
                             prec_bits=prec_bits,
-                            max_glitch_fix=max_glitch_fix)
+                            max_glitch_fix=max_glitch_fix,
+                            julia_c=julia_c)
 
 
-def _escape_count_fixed(ca: int, cb: int, max_iter: int, bits: int) -> int:
+def _escape_count_fixed(za: int, zb: int, max_iter: int, bits: int,
+                        ca: int | None = None,
+                        cb: int | None = None) -> int:
     """Reference convention exactly (DistributedMandelbrotWorkerCUDA.py:
-    44-68): z starts at c, each iteration updates THEN tests, counts
-    1..max_iter-1, 0 = never escaped."""
+    44-68): z starts at ``(za, zb)``, each iteration updates THEN tests,
+    counts 1..max_iter-1, 0 = never escaped.  ``(ca, cb)`` is the
+    additive constant — defaults to the start point (Mandelbrot); pass
+    it separately for the Julia family."""
+    if ca is None:
+        ca, cb = za, zb
     one = 1 << bits
     four = 4 * one * one
-    a, b = ca, cb
+    a, b = za, zb
     a2, b2 = a * a, b * b
     for it in range(1, max_iter):
         a, b = (a2 - b2 >> bits) + ca, ((a * b) >> (bits - 1)) + cb
@@ -443,13 +486,16 @@ def _escape_count_fixed(ca: int, cb: int, max_iter: int, bits: int) -> int:
 def compute_tile_perturb(spec: DeepTileSpec, max_iter: int, *,
                          dtype=np.float32,
                          prec_bits: int = DEFAULT_PREC_BITS,
-                         clamp: bool = False) -> np.ndarray:
+                         clamp: bool = False,
+                         julia_c: tuple[str, str] | None = None
+                         ) -> np.ndarray:
     """Deep-zoom tile -> flat uint8 pixels (canonical scaling/order)."""
     from distributedmandelbrot_tpu.ops.escape_time import (
         scale_counts_to_uint8)
 
     counts, _ = compute_counts_perturb(spec, max_iter, dtype=dtype,
-                                       prec_bits=prec_bits)
+                                       prec_bits=prec_bits,
+                                       julia_c=julia_c)
     pixels = scale_counts_to_uint8(jnp.asarray(counts), max_iter=max_iter,
                                    clamp=clamp)
     return np.asarray(pixels).ravel()
@@ -458,9 +504,9 @@ def compute_tile_perturb(spec: DeepTileSpec, max_iter: int, *,
 # -- smooth (band-free) coloring ------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("max_iter", "bailout"))
+@partial(jax.jit, static_argnames=("max_iter", "bailout", "add_dc"))
 def _perturb_scan_smooth(z_re, z_im, dc_re, dc_im, *, max_iter: int,
-                         bailout: float):
+                         bailout: float, add_dc: bool = True):
     """Smooth twin of :func:`_perturb_scan`: additionally freezes the
     full value at the first radius-``bailout`` crossing, from which the
     renormalized iteration count is recovered (the delta keeps iterating
@@ -493,9 +539,11 @@ def _perturb_scan_smooth(z_re, z_im, dc_re, dc_im, *, max_iter: int,
         # the integer path exactly (sticky, like escape_smooth's).
         act2 = act2 & (mag2 < four)
         n2 = n2 + act2.astype(jnp.int32)
-        ndzr = (zr + zr) * dzr - (zi + zi) * dzi \
-            + (dzr * dzr - dzi * dzi) + dc_re
-        ndzi = (zr + zr) * dzi + (zi + zi) * dzr + 2 * dzr * dzi + dc_im
+        ndzr = (zr + zr) * dzr - (zi + zi) * dzi + (dzr * dzr - dzi * dzi)
+        ndzi = (zr + zr) * dzi + (zi + zi) * dzr + 2 * dzr * dzi
+        if add_dc:
+            ndzr = ndzr + dc_re
+            ndzi = ndzi + dc_im
         return (ndzr, ndzi, act_b, n, act2, n2, fzr, fzi, glitched), None
 
     ones = jnp.ones(shape, jnp.bool_)
@@ -523,7 +571,8 @@ def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
                            dtype=np.float32,
                            prec_bits: int = DEFAULT_PREC_BITS,
                            bailout: float = 256.0,
-                           max_glitch_fix: int = 4096
+                           max_glitch_fix: int = 4096,
+                           julia_c: tuple[str, str] | None = None
                            ) -> tuple[np.ndarray, int]:
     """Smooth (band-free) deep-zoom values via perturbation.
 
@@ -535,11 +584,13 @@ def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
     """
     if max_iter <= 1:
         return np.zeros((spec.height, spec.width), dtype), 0
+    add_dc = julia_c is None
 
     def scan(zr, zi, dre, dim):
         return _perturb_scan_smooth(zr, zi, dre, dim, max_iter=max_iter,
-                                    bailout=float(bailout))
+                                    bailout=float(bailout), add_dc=add_dc)
 
     return _compute_perturb(spec, max_iter, scan, dtype=dtype,
                             prec_bits=prec_bits,
-                            max_glitch_fix=max_glitch_fix)
+                            max_glitch_fix=max_glitch_fix,
+                            julia_c=julia_c)

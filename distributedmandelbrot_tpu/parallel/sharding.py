@@ -214,10 +214,8 @@ def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
     cap = int(mrds.max())
     if cap - 1 >= INT32_SCALE_LIMIT:
         raise ValueError("pallas path is int32-only; cap needs the XLA path")
-    # Same power-of-two compile-cap bucketing as the single-tile path:
-    # batches whose max budget lands in the same bucket share an
-    # executable (per-tile budgets are traced; the loop exits at them).
-    cap = 1 << max(8, (cap - 1).bit_length()) if cap > 1 else 1
+    from distributedmandelbrot_tpu.ops.pallas_escape import bucket_cap
+    cap = bucket_cap(cap)
     block_h, block_w = fit_blocks(definition, definition)
     if interpret is None:
         interpret = not pallas_available()

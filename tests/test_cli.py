@@ -58,10 +58,17 @@ def test_render_deep_flag_and_auto_switch(tmp_path):
     assert rc == 0
 
 
-def test_render_deep_rejects_julia(tmp_path):
-    with pytest.raises(SystemExit):
-        cli.main(["render", "--deep", "--fractal", "julia",
-                  "--definition", "64", "--out", str(tmp_path / "x.png")])
+def test_render_deep_julia(tmp_path):
+    """Deep Julia zoom via perturbation (center = a z-plane location near
+    the Julia set of c; renders rather than erroring)."""
+    out = tmp_path / "dj.png"
+    rc = cli.main(["render", "--deep", "--fractal", "julia",
+                   "--c", "-0.8,0.156",
+                   "--center", "1.5275031186,-0.0759121783",
+                   "--span", "1e-6", "--definition", "64",
+                   "--max-iter", "300", "--out", str(out)])
+    assert rc == 0
+    assert _png_size(out) == (64, 64)
 
 
 def test_worker_backend_validation():

@@ -148,3 +148,47 @@ def test_smooth_perturb_deep_fractional():
     escaped = nu[nu > 0]
     assert len(escaped)
     assert not np.allclose(escaped, np.round(escaped))
+
+
+def test_julia_perturb_sampled_exact():
+    """Julia-family perturbation (no dc term, fixed c): sampled against
+    exact fixed point at a repelling fixed point of c (on the Julia set
+    at every depth), beyond f64's floor."""
+    C = ("-0.8", "0.156")
+    spec = P.DeepTileSpec("1.5275031186435346", "-0.07591217835228786",
+                          1e-16, width=48, height=48)
+    counts, _ = P.compute_counts_perturb(spec, 1500, julia_c=C)
+    bits = 256
+    za = P._to_fixed(spec.center_re, bits)
+    zb = P._to_fixed(spec.center_im, bits)
+    ca = P._to_fixed(C[0], bits)
+    cb = P._to_fixed(C[1], bits)
+    rng = np.random.default_rng(4)
+    bad = 0
+    for _ in range(10):
+        r = int(rng.integers(48))
+        c = int(rng.integers(48))
+        d_re = float((c - 23.5) * spec.step)
+        d_im = float((r - 23.5) * spec.step)
+        want = P._escape_count_fixed(za + P._to_fixed(d_re, bits),
+                                     zb + P._to_fixed(d_im, bits),
+                                     1500, bits, ca=ca, cb=cb)
+        if counts[r, c] != want:
+            bad += 1
+    assert bad <= 1, f"{bad}/10 disagree with exact"
+
+
+def test_julia_perturb_matches_direct_at_boundary():
+    C = ("-0.8", "0.156")
+    spec = P.DeepTileSpec("1.5275031186435346", "-0.07591217835228786",
+                          1e-5, width=64, height=64)
+    counts, n_fixed = P.compute_counts_perturb(spec, 800, julia_c=C)
+    step = spec.step
+    col = (np.arange(64) - 31.5) * step + float(spec.center_re)
+    row = (np.arange(64) - 31.5) * step + float(spec.center_im)
+    want = np.asarray(escape_time.escape_counts_julia(
+        np.broadcast_to(col, (64, 64)).astype(np.float64),
+        np.broadcast_to(row[:, None], (64, 64)).astype(np.float64),
+        complex(-0.8, 0.156), max_iter=800))
+    assert float((counts != want).mean()) <= 0.02
+    assert len(np.unique(counts)) > 10
