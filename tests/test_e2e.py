@@ -132,3 +132,27 @@ def test_worker_crash_lease_expiry_redistribution_over_the_wire(tmp_path):
         assert client_a.submit(wl_a, pixels) is False
         farm.wait_saves_settled(expected_accepted=1)
         assert farm.scheduler.is_complete()
+
+
+def test_coordinator_stats_reporting(tmp_path, caplog):
+    """The periodic stats loop (survey §5.1/§5.5) logs progress with
+    counter totals and deltas."""
+    import asyncio
+    import logging
+    import time
+
+    caplog.set_level(logging.INFO, logger="dmtpu.coordinator")
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, 16)]) as h:
+        h.coordinator.stats_period = 0.05
+        h._loop.call_soon_threadsafe(
+            lambda: setattr(h.coordinator, "_stats_task",
+                            asyncio.ensure_future(
+                                h.coordinator._stats_loop())))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any("stats:" in r.message for r in caplog.records):
+                break
+            time.sleep(0.05)
+    stats_lines = [r for r in caplog.records if "stats:" in r.message]
+    assert stats_lines, "no stats line logged within 5s"
+    assert "0/1 tiles complete" in stats_lines[0].message

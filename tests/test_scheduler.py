@@ -218,3 +218,27 @@ def test_stale_claim_token_cannot_consume_superseding_claim():
     assert sched.acquire() is None       # B's claim still blocks granting
     assert sched.finish_claim(w_b, tok_b) is True
     assert sched.is_complete()
+
+
+def test_grant_complete_cycle_scales_linearly():
+    """Frontier-cursor scheduling must stay O(1) amortized per grant —
+    the reference rescans the whole grid per request (O(total) each,
+    Distributer.cs:335-353); a regression to that shape turns this
+    10k-tile cycle quadratic and blows the time box."""
+    import time
+
+    sched = TileScheduler([LevelSetting(100, 16)])  # 10,000 tiles
+    t0 = time.perf_counter()
+    granted = 0
+    while True:
+        w = sched.acquire()
+        if w is None:
+            break
+        token = sched.claim(w)
+        assert token is not None
+        assert sched.finish_claim(w, token)
+        granted += 1
+    dt = time.perf_counter() - t0
+    assert granted == 10_000
+    assert sched.is_complete()
+    assert dt < 5.0, f"10k grant/complete cycles took {dt:.1f}s"
