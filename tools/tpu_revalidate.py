@@ -1,0 +1,135 @@
+"""Post-outage TPU revalidation: run once after the accelerator comes back.
+
+The dev rig's tunnel dies for hours at a time; several hardware-touching
+changes can land while it's down.  This script walks every TPU-sensitive
+surface in dependency order and stops at the first failure:
+
+    python tools/tpu_revalidate.py
+
+1. backend up + device visible
+2. Pallas single-tile kernel (bucketed compile cap, dynamic budget)
+3. mixed-budget executables share one compile bucket
+4. sharded Pallas batch path (shard_map + lax.map around pallas_call)
+5. perturbation scan on device (moderate zoom, parity vs XLA f64)
+6. farm e2e with the auto (Pallas) backend at production chunk size
+7. bench headline (prints the JSON line)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def step(name):
+    print(f"\n=== {name} ===", flush=True)
+
+
+def main() -> int:
+    from __graft_entry__ import backend_alive
+
+    step("1. backend probe")
+    if not backend_alive():
+        print("backend still unreachable; aborting")
+        return 1
+    import jax
+
+    print("devices:", jax.devices())
+    if jax.default_backend() != "tpu":
+        # A half-restored tunnel can leave jax silently on CPU: steps 2-5
+        # would then compare CPU against CPU (trivially passing) and step
+        # 6 would never touch the Pallas backend — a false "revalidated".
+        print("default backend is not tpu; aborting (nothing to revalidate)")
+        return 1
+
+    import numpy as np
+
+    from distributedmandelbrot_tpu.core.geometry import TileSpec
+    from distributedmandelbrot_tpu.ops import escape_time
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        _pallas_escape, compute_tile_pallas)
+
+    step("2. pallas single tile (bucketed cap)")
+    spec = TileSpec(-0.748, 0.09, 0.005, 0.005, width=256, height=256)
+    got = compute_tile_pallas(spec, 1000)  # cap 1024, budget 1000
+    # Same f32 start+index*step convention the kernel generates in-kernel
+    # (parametric in the spec — cf. tests/test_pallas.py:xla_f32_reference).
+    stepv = np.float32(spec.range_real / (spec.width - 1))
+    cr = (np.float32(spec.start_real)
+          + np.arange(spec.width, dtype=np.float32) * stepv
+          )[None, :].repeat(spec.height, 0)
+    ci = (np.float32(spec.start_imag)
+          + np.arange(spec.height, dtype=np.float32) * stepv
+          )[:, None].repeat(spec.width, 1)
+    want = np.asarray(escape_time.scale_counts_to_uint8(
+        escape_time.escape_counts(cr, ci, max_iter=1000),
+        max_iter=1000)).ravel()
+    mism = float((got != want).mean())
+    print(f"parity vs XLA f32: {mism:.4%} mismatch")
+    assert mism <= 0.02
+
+    step("3. compile-cap sharing")
+    before = _pallas_escape._cache_size()
+    compute_tile_pallas(spec, 900)   # same 1024 bucket as 1000
+    compute_tile_pallas(spec, 1024)  # same bucket
+    shared = _pallas_escape._cache_size() == before
+    print("bucket shared:", shared)
+    assert shared
+
+    step("4. sharded pallas batch (mixed budgets)")
+    from distributedmandelbrot_tpu.parallel import (
+        batched_escape_pixels, batched_escape_pixels_pallas, tile_mesh)
+    mesh = tile_mesh()
+    params = np.array([[-0.748 + 0.005 * i, 0.09, 0.005 / 1023]
+                       for i in range(3)])
+    mrds = np.array([200, 1000, 513])
+    a = batched_escape_pixels_pallas(mesh, params, mrds, definition=1024)
+    b = batched_escape_pixels(mesh, params, mrds, definition=1024,
+                              dtype=np.float32)
+    mism = float((a != b).mean())
+    print(f"sharded parity vs XLA: {mism:.4%}")
+    assert mism <= 0.02
+
+    step("5. perturbation scan on device")
+    from distributedmandelbrot_tpu.ops.perturbation import (
+        DeepTileSpec, compute_counts_perturb)
+    dspec = DeepTileSpec("-0.74529", "0.11307", 1e-5, width=256, height=256)
+    t0 = time.time()
+    counts, ng = compute_counts_perturb(dspec, 2000)
+    print(f"perturb 256^2 mi=2000: {time.time()-t0:.2f}s, "
+          f"{ng} glitch-fixed, {len(np.unique(counts))} levels")
+    assert len(np.unique(counts)) > 10
+
+    step("6. farm e2e (auto backend, 4096^2)")
+    from distributedmandelbrot_tpu.cli import parse_level_settings
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    from distributedmandelbrot_tpu.worker import (DistributerClient, Worker,
+                                                  auto_backend)
+    with tempfile.TemporaryDirectory() as tmp, \
+            EmbeddedCoordinator(tmp, parse_level_settings("2:500")) as co:
+        backend = auto_backend()
+        print("backend:", type(backend).__name__)
+        w = Worker(DistributerClient("127.0.0.1", co.distributer_port),
+                   backend, batch_size=4)
+        t0 = time.time()
+        w.run_until_drained()
+        co.wait_saves_settled(expected_accepted=4, timeout=300)
+        dt = time.time() - t0
+        print(f"4x4096^2 e2e in {dt:.1f}s = {4*16.78e6/dt/1e6:.1f} Mpix/s")
+
+    step("7. bench headline")
+    rc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                         "--repeats", "2"], cwd=REPO).returncode
+    assert rc == 0
+    print("\nALL REVALIDATION STEPS PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
