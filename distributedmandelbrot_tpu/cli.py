@@ -64,6 +64,26 @@ def _add_common(parser: argparse.ArgumentParser) -> None:
 _NP_DTYPES = {"f32": np.float32, "f64": np.float64}
 
 
+def _resolve_dtype(args: argparse.Namespace):
+    """--dtype default is mode-dependent: smooth rendering defaults to
+    the f64 quality path, everything else to f32 (an explicit --dtype
+    always wins — 'f32 --smooth' selects the fast smooth path).
+    Anything that renders deep — explicit --deep, a sub-threshold span,
+    or an animation sweeping past the threshold — defaults to f32 even
+    with --smooth: there the view's precision comes from the bigint
+    reference orbit and f32 deltas are the designed fast path (and a
+    sweep must not change dtype mid-animation)."""
+    if args.dtype is not None:
+        return _NP_DTYPES[args.dtype]
+    touches_deep = (
+        getattr(args, "deep", False)
+        or getattr(args, "span", 1.0) < DEEP_SPAN_THRESHOLD
+        or getattr(args, "span_end", 1.0) < DEEP_SPAN_THRESHOLD)
+    if touches_deep:
+        return np.float32
+    return np.float64 if getattr(args, "smooth", False) else np.float32
+
+
 def _join_negative_values(argv: Sequence[str], flags: Sequence[str]) -> list:
     """Merge ``--flag -0.8,0.156`` into ``--flag=-0.8,0.156`` so argparse
     doesn't mistake the negative value for an option."""
@@ -121,8 +141,22 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
     spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
                     width=definition, height=definition)
     if smooth:
+        if np_dtype == np.float32 and jc is None:
+            # f32 smooth throughput path: Pallas on TPU, XLA otherwise.
+            nu = None
+            try:
+                from distributedmandelbrot_tpu.ops.pallas_escape import (
+                    compute_tile_smooth_pallas, pallas_available)
+                if pallas_available():
+                    nu = compute_tile_smooth_pallas(spec, max_iter)
+            except ValueError:
+                nu = None  # shape/budget outside the kernel -> XLA below
+            if nu is not None:
+                # Rendering errors must surface, not trigger a fallback
+                # recompute — only the kernel call sits in the try.
+                return smooth_to_rgba(nu, max_iter, colormap=colormap)
         from distributedmandelbrot_tpu.ops import compute_tile_smooth
-        nu = compute_tile_smooth(spec, max_iter, dtype=np.float64,
+        nu = compute_tile_smooth(spec, max_iter, dtype=np_dtype,
                                  julia_c=jc)
         return smooth_to_rgba(nu, max_iter, colormap=colormap)
     if jc is not None:
@@ -399,12 +433,15 @@ def cmd_render(argv: Sequence[str]) -> int:
                         help="output pixels per side")
     parser.add_argument("--max-iter", type=int, default=256)
     parser.add_argument("--smooth", action="store_true",
-                        help="band-free continuous coloring (f64)")
+                        help="band-free continuous coloring (defaults to "
+                             "the f64 quality path; --dtype f32 selects "
+                             "the fast path)")
     parser.add_argument("--deep", action="store_true",
                         help="perturbation deep zoom: center taken at "
                              "arbitrary decimal precision, valid at any "
                              "span (auto-selected below 1e-12)")
-    parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
+    parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
+                        help="default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
     parser.add_argument("--out", required=True, help="output PNG path")
     _add_common(parser)
@@ -420,7 +457,7 @@ def cmd_render(argv: Sequence[str]) -> int:
         if args.fractal == "julia" else None
     rgba = _render_view(c_re, c_im, args.span, args.definition,
                         args.max_iter, smooth=args.smooth,
-                        np_dtype=_NP_DTYPES[args.dtype],
+                        np_dtype=_resolve_dtype(args),
                         colormap=args.colormap,
                         deep=True if args.deep else None,
                         julia_c=julia_c)
@@ -453,7 +490,8 @@ def cmd_animate(argv: Sequence[str]) -> int:
     parser.add_argument("--max-iter", type=int, default=1000)
     parser.add_argument("--smooth", action="store_true",
                         help="band-free coloring on every frame")
-    parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
+    parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
+                        help="default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
     parser.add_argument("--out-dir", required=True,
                         help="directory for frame_NNNN.png files")
@@ -473,7 +511,7 @@ def cmd_animate(argv: Sequence[str]) -> int:
     c_re, c_im = (s.strip() for s in args.center.split(","))
     julia_c = tuple(s.strip() for s in args.c.split(",")) \
         if args.fractal == "julia" else None
-    np_dtype = _NP_DTYPES[args.dtype]
+    np_dtype = _resolve_dtype(args)
     ratio = (args.span_end / args.span_start) ** (
         1.0 / max(1, args.frames - 1))
 

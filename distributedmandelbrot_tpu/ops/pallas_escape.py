@@ -185,6 +185,164 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
     )(params, mrd)
 
 
+def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
+                         actb_ref, n_ref, act2_ref, n2_ref,
+                         *, max_iter: int, unroll: int, block_h: int,
+                         block_w: int, bailout: float, extra: int):
+    """Smooth-coloring twin of :func:`_escape_block_kernel`: freezes the
+    full value at the first radius-``bailout`` crossing while a sticky
+    radius-2 count keeps in-set classification identical to the integer
+    kernel (semantics of ``ops.escape_time.escape_smooth``).  State lives
+    in VMEM scratch; the while carries scalars only (same Mosaic
+    constraint, same early exit — here on the radius-``bailout`` mask,
+    run ``extra`` steps past the budget so late escapees reach the
+    smoothing radius)."""
+    pl, _ = _pallas()
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    start_r = params_ref[0, 0]
+    start_i = params_ref[0, 1]
+    step = params_ref[0, 2]
+    mrd = mrd_ref[0, 0]
+    shape = out_ref.shape
+    dtype = params_ref.dtype
+
+    col = lax.broadcasted_iota(jnp.int32, shape, 1) + j * block_w
+    row = lax.broadcasted_iota(jnp.int32, shape, 0) + i * block_h
+    c_real = start_r + col.astype(dtype) * step
+    c_imag = start_i + row.astype(dtype) * step
+
+    if max_iter <= 1:
+        out_ref[:] = jnp.zeros(shape, dtype)
+        return
+    dyn_steps = mrd - 1
+    four = jnp.asarray(4.0, dtype)
+    b2 = jnp.asarray(bailout * bailout, dtype)
+
+    zr_ref[:] = c_real
+    zi_ref[:] = c_imag
+    actb_ref[:] = jnp.ones(shape, jnp.int32)
+    n_ref[:] = jnp.zeros(shape, jnp.int32)
+    act2_ref[:] = jnp.ones(shape, jnp.int32)
+    n2_ref[:] = jnp.zeros(shape, jnp.int32)
+
+    def seg_body(carry):
+        it, _ = carry
+        zr = zr_ref[:]
+        zi = zi_ref[:]
+        act_b = actb_ref[:]
+        n = n_ref[:]
+        act2 = act2_ref[:]
+        n2 = n2_ref[:]
+        for _ in range(unroll):
+            nzi = (zr + zr) * zi + c_imag
+            nzr = zr * zr - zi * zi + c_real
+            # Escaped-from-bailout lanes freeze — their z at the first
+            # crossing IS the smoothing payload, so no separate snapshot
+            # state is needed.
+            sel = act_b != 0
+            zr = jnp.where(sel, nzr, zr)
+            zi = jnp.where(sel, nzi, zi)
+            m2 = zr * zr + zi * zi
+            act_b = act_b & (m2 < b2).astype(jnp.int32)
+            n = n + act_b
+            act2 = act2 & (m2 < four).astype(jnp.int32)
+            n2 = n2 + act2
+        zr_ref[:] = zr
+        zi_ref[:] = zi
+        actb_ref[:] = act_b
+        n_ref[:] = n
+        act2_ref[:] = act2
+        n2_ref[:] = n2
+        return (it + unroll, jnp.sum(act_b, dtype=jnp.int32))
+
+    def seg_cond(carry):
+        it, live = carry
+        return (it <= dyn_steps + extra) & (live > 0)
+
+    lax.while_loop(seg_cond, seg_body,
+                   (jnp.asarray(1, jnp.int32),
+                    jnp.asarray(block_h * block_w, jnp.int32)))
+
+    n = n_ref[:]
+    n2 = n2_ref[:]
+    # Frozen z for escaped lanes; never-escaped lanes clamp to b2 (the
+    # same laggard handling as the XLA kernel).
+    fzr = zr_ref[:]
+    fzi = zi_ref[:]
+    mag2 = jnp.maximum(fzr * fzr + fzi * fzi, b2)
+    log_ratio = jnp.log(mag2) / jnp.asarray(2.0 * np.log(bailout), dtype)
+    nu = (n + 2).astype(dtype) - jnp.log2(log_ratio)
+    out_ref[:] = jnp.where(n2 >= dyn_steps, jnp.zeros((), dtype), nu)
+
+
+@partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
+                                   "block_h", "block_w", "bailout",
+                                   "interpret"))
+def _pallas_smooth(params, mrd=None, *, height: int, width: int,
+                   max_iter: int, unroll: int = DEFAULT_UNROLL,
+                   block_h: int = DEFAULT_BLOCK_H,
+                   block_w: int = DEFAULT_BLOCK_W, bailout: float = 256.0,
+                   interpret: bool = False):
+    pl, pltpu = _pallas()
+    if mrd is None:
+        mrd = jnp.asarray([[max_iter]], jnp.int32)
+    extra = 8 + int(np.ceil(np.log2(np.log2(max(bailout, 4.0)))))
+    kernel = partial(_smooth_block_kernel, max_iter=max_iter,
+                     unroll=max(1, min(unroll, max(1, max_iter - 1))),
+                     block_h=block_h, block_w=block_w,
+                     bailout=float(bailout), extra=extra)
+    return pl.pallas_call(
+        kernel,
+        grid=(height // block_h, width // block_w),
+        in_specs=[pl.BlockSpec((1, 3), lambda i, j: (0, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, 1), lambda i, j: (0, 0),
+                               memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((block_h, block_w), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((height, width), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_h, block_w), jnp.float32),
+                        pltpu.VMEM((block_h, block_w), jnp.float32),
+                        pltpu.VMEM((block_h, block_w), jnp.int32),
+                        pltpu.VMEM((block_h, block_w), jnp.int32),
+                        pltpu.VMEM((block_h, block_w), jnp.int32),
+                        pltpu.VMEM((block_h, block_w), jnp.int32)],
+        interpret=interpret,
+    )(params, mrd)
+
+
+def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
+                               unroll: int = DEFAULT_UNROLL,
+                               block_h: int = DEFAULT_BLOCK_H,
+                               block_w: int | None = None,
+                               bailout: float = 256.0,
+                               interpret: bool | None = None) -> np.ndarray:
+    """Smooth (band-free) tile via the Pallas kernel -> (h, w) float32 nu.
+
+    The f32 TPU throughput path for smooth rendering (animations, live
+    views); the f64 quality path stays on the XLA kernel.  Same
+    ValueError contract as :func:`compute_tile_pallas_device` for
+    unsupported shapes/budgets — callers fall back to XLA.
+    """
+    from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
+    if max_iter - 1 >= INT32_SCALE_LIMIT:
+        raise ValueError(f"max_iter {max_iter} too deep for the pallas path")
+    block_h, block_w = fit_blocks(spec.height, spec.width,
+                                  block_h=block_h, block_w=block_w)
+    if interpret is None:
+        interpret = not pallas_available()
+    step = spec.range_real / (spec.width - 1)
+    params = jnp.asarray([[spec.start_real, spec.start_imag, step]],
+                         jnp.float32)
+    cap = bucket_cap(max_iter)
+    mrd = jnp.asarray([[max_iter]], jnp.int32)
+    out = _pallas_smooth(params, mrd, height=spec.height, width=spec.width,
+                         max_iter=cap, unroll=unroll, block_h=block_h,
+                         block_w=block_w, bailout=bailout,
+                         interpret=interpret)
+    return np.asarray(out)
+
+
 def bucket_cap(max_iter: int) -> int:
     """The static compile cap for a budget: rounded up to a power of two
     (floor 256), so farms and animations mixing budgets (256, 1000,

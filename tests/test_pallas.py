@@ -161,3 +161,37 @@ def test_mesh_backend_pallas_kernel_selection():
     # auto falls back to XLA for the same unfittable shape instead.
     auto = MeshBackend(definition=64, kernel="auto")
     assert auto.compute_batch([w])[0].shape == (64 * 64,)
+
+
+def test_pallas_smooth_matches_escape_smooth_f32():
+    """The Pallas smooth kernel must agree with the XLA smooth path:
+    identical in-set mask, small relative error on escape values (both
+    f32; FMA placement differs)."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_smooth_pallas)
+
+    spec = TileSpec(-0.748, 0.09, 0.01, 0.01, width=128, height=128)
+    got = compute_tile_smooth_pallas(spec, 300, block_h=32, interpret=True)
+    step = np.float32(spec.range_real / 127)
+    cr = (np.float32(spec.start_real)
+          + np.arange(128, dtype=np.float32) * step)[None, :].repeat(128, 0)
+    ci = (np.float32(spec.start_imag)
+          + np.arange(128, dtype=np.float32) * step)[:, None].repeat(128, 1)
+    want = np.asarray(escape_time.escape_smooth(cr, ci, max_iter=300))
+    inset_agree = float(((got == 0) == (want == 0)).mean())
+    assert inset_agree >= 0.999, f"in-set mask agreement {inset_agree:.2%}"
+    both = (got > 0) & (want > 0)
+    relerr = np.abs(got[both] - want[both]) / np.maximum(want[both], 1.0)
+    assert float(np.median(relerr)) < 1e-5
+    assert float((relerr < 0.02).mean()) > 0.995
+
+
+def test_pallas_smooth_unsupported_budget_raises():
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_smooth_pallas)
+    from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
+
+    spec = TileSpec(-0.8, 0.1, 0.2, 0.2, width=128, height=128)
+    with pytest.raises(ValueError):
+        compute_tile_smooth_pallas(spec, INT32_SCALE_LIMIT + 2,
+                                   interpret=True)

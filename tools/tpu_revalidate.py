@@ -82,6 +82,15 @@ def main() -> int:
     print("bucket shared:", shared)
     assert shared
 
+    step("3b. pallas smooth kernel")
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_smooth_pallas)
+    nu = compute_tile_smooth_pallas(spec, 1000)
+    want_nu = np.asarray(escape_time.escape_smooth(cr, ci, max_iter=1000))
+    agree = float(((nu == 0) == (want_nu == 0)).mean())
+    print(f"smooth in-set mask agreement: {agree:.4%}")
+    assert agree >= 0.999
+
     step("4. sharded pallas batch (mixed budgets)")
     from distributedmandelbrot_tpu.parallel import (
         batched_escape_pixels, batched_escape_pixels_pallas, tile_mesh)
