@@ -67,6 +67,16 @@ INT32_SCALE_LIMIT = (2**31 - 1) // 257 + 1  # 8,355,968
 # (seg=299 f64: >9 min flat vs 0.9 s capped) and Mosaic blows up similarly.
 MAX_UNROLL = 64
 
+# Safety margins for the closed-form interior test (:func:`mandelbrot_interior`),
+# per dtype.  The test polynomials are evaluated in at most ~4 rounding steps
+# on operands of magnitude <= ~1 near the curves, so the evaluation error is
+# a few ulps (~5e-7 f32 / ~1e-15 f64); the margin is ~20x that, guaranteeing
+# a pixel that passes the strict-by-margin test is *mathematically* interior.
+# Pixels inside the true curve but within the margin strip simply iterate
+# normally — the margin costs coverage (a boundary strip of width ~1e-5 in
+# test-value terms, negligible area), never correctness.
+INTERIOR_MARGIN = {np.dtype(np.float32): 1e-5, np.dtype(np.float64): 1e-12}
+
 
 def unrolled_steps(step_fn, state, segment: int, max_unroll: int = MAX_UNROLL):
     """Apply ``step_fn`` ``segment`` times: fori_loop over full
@@ -82,6 +92,38 @@ def unrolled_steps(step_fn, state, segment: int, max_unroll: int = MAX_UNROLL):
     for _ in range(rem):
         state = step_fn(state)
     return state
+
+
+def mandelbrot_interior(c_real, c_imag, margin: float | None = None):
+    """Pixels *provably* inside the Mandelbrot set, by closed form.
+
+    Main cardioid: with ``q = (x - 1/4)^2 + y^2``, interior iff
+    ``q (q + x - 1/4) < y^2 / 4``.  Period-2 bulb: ``(x+1)^2 + y^2 < 1/16``.
+    Both tests are strict-by-``margin`` (see :data:`INTERIOR_MARGIN`), so
+    floating-point evaluation can never classify an exterior point as
+    interior — a True here means the exact orbit never escapes, hence the
+    escape kernels may skip such pixels and report "never escaped" (0)
+    with *identical* output to full iteration.  This is the SIMD-friendly
+    recovery of the work the reference's CUDA kernel burns: interior
+    pixels run to the full budget there
+    (``DistributedMandelbrotWorkerCUDA.py:49-68`` has no interior test)
+    and dominate total iteration count on set-crossing views (measured
+    94% of all iteration work on the seahorse bench window).
+
+    O(1) per pixel, ~10 elementwise ops — amortized against budgets of
+    hundreds to tens of thousands of iterations saved per covered pixel.
+    """
+    dtype = jnp.result_type(c_real)
+    if margin is None:
+        margin = INTERIOR_MARGIN.get(np.dtype(dtype), 1e-5)
+    m = jnp.asarray(margin, dtype)
+    y2 = c_imag * c_imag
+    xm = c_real - jnp.asarray(0.25, dtype)
+    q = xm * xm + y2
+    cardioid = q * (q + xm) < jnp.asarray(0.25, dtype) * y2 - m
+    xp = c_real + jnp.asarray(1.0, dtype)
+    bulb = xp * xp + y2 < jnp.asarray(0.0625, dtype) - m
+    return cardioid | bulb
 
 
 def segmented_while(one_step, state, *, total_steps: int, segment: int,
@@ -109,7 +151,8 @@ def segmented_while(one_step, state, *, total_steps: int, segment: int,
     return state
 
 
-def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int):
+def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
+                interior=None):
     """The shared segmented escape recurrence (single source of truth for
     the XLA, sharded, and Pallas kernels).
 
@@ -135,6 +178,15 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int):
     ``zr0``/``zi0`` are the initial ``z`` (normally equal to ``c``; passed
     explicitly so shard_map callers can derive them with the union of both
     inputs' varying manual axes).  Returns int32 escape counts.
+
+    ``interior`` (optional bool mask): pixels *proven* in-set by closed
+    form (:func:`mandelbrot_interior`) start inactive with their count
+    pre-saturated at ``total_steps``, so they come out 0 ("never
+    escaped") without iterating — and a tile of only interior + escaped
+    pixels takes the tile-granular early exit.  Output is identical to
+    full iteration; only the work changes.  Callers must pass it only
+    when ``z0 == c`` (the Mandelbrot family — the test is meaningless
+    for Julia orbits).
     """
     four = jnp.asarray(4.0, jnp.result_type(zr0))
 
@@ -149,7 +201,12 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int):
         return (zr, zi, zr2, zi2, active, n)
 
     mix = zr0 * 0 + zi0 * 0  # union of varying axes under shard_map
-    init = (zr0, zi0, zr0 * zr0, zi0 * zi0, mix == 0, mix.astype(jnp.int32))
+    active0 = mix == 0
+    n0 = mix.astype(jnp.int32)
+    if interior is not None:
+        active0 = active0 & ~interior
+        n0 = n0 + interior.astype(jnp.int32) * total_steps
+    init = (zr0, zi0, zr0 * zr0, zi0 * zi0, active0, n0)
     zr, zi, zr2, zi2, active, n = segmented_while(
         one_step, init, total_steps=total_steps, segment=segment,
         active_of=lambda s: s[4])
@@ -157,11 +214,15 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int):
 
 
 def escape_counts(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
-                  segment: int = DEFAULT_SEGMENT) -> jax.Array:
+                  segment: int = DEFAULT_SEGMENT,
+                  interior_check: bool = True) -> jax.Array:
     """Escape iteration (int32) per element; 0 if never escaped.
 
     Semantics pinned to the golden reference: z starts at c, iterations
     count 1..max_iter-1, bailout test |z|^2 >= 4 after the update.
+    ``interior_check`` applies the closed-form interior shortcut
+    (:func:`mandelbrot_interior`; output-identical, work-saving) — on by
+    default, disable to time the raw loop.
 
     Thin dispatch wrapper: float64 inputs enable x64 first — otherwise JAX
     would silently truncate them to float32 and run the fast path while the
@@ -171,12 +232,13 @@ def escape_counts(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
     if dt is not None and np.dtype(dt) == np.float64:
         ensure_x64()
     return _escape_counts_jit(c_real, c_imag, max_iter=max_iter,
-                              segment=segment)
+                              segment=segment, interior_check=interior_check)
 
 
-@partial(jax.jit, static_argnames=("max_iter", "segment"))
+@partial(jax.jit, static_argnames=("max_iter", "segment", "interior_check"))
 def _escape_counts_jit(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
-                       segment: int = DEFAULT_SEGMENT) -> jax.Array:
+                       segment: int = DEFAULT_SEGMENT,
+                       interior_check: bool = True) -> jax.Array:
     dtype = jnp.result_type(c_real)
     c_real = c_real.astype(dtype)
     c_imag = c_imag.astype(dtype)
@@ -184,8 +246,10 @@ def _escape_counts_jit(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
     total_steps = max_iter - 1  # iterations 1 .. max_iter-1
     if total_steps <= 0:
         return jnp.zeros(c_real.shape, jnp.int32)
+    interior = mandelbrot_interior(c_real, c_imag) if interior_check else None
     return escape_loop(c_real, c_imag, c_real, c_imag,
-                       total_steps=total_steps, segment=segment)
+                       total_steps=total_steps, segment=segment,
+                       interior=interior)
 
 
 def escape_counts_julia(z_real: jax.Array, z_imag: jax.Array,
@@ -274,8 +338,8 @@ def _scale_counts_jit(counts: jax.Array, *, max_iter: int,
 
 
 def escape_smooth(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
-                  segment: int = DEFAULT_SEGMENT,
-                  bailout: float = 256.0) -> jax.Array:
+                  segment: int = DEFAULT_SEGMENT, bailout: float = 256.0,
+                  interior_check: bool = True) -> jax.Array:
     """Continuous (smooth-colored) escape value per element; 0 if never
     escaped.
 
@@ -305,7 +369,8 @@ def escape_smooth(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
         ensure_x64()
     return _escape_smooth_jit(c_real, c_imag, c_real, c_imag,
                               max_iter=max_iter, segment=segment,
-                              bailout=float(bailout))
+                              bailout=float(bailout),
+                              interior_check=interior_check)
 
 
 def escape_smooth_julia(z_real: jax.Array, z_imag: jax.Array, c: complex, *,
@@ -323,14 +388,15 @@ def escape_smooth_julia(z_real: jax.Array, z_imag: jax.Array, c: complex, *,
                               jnp.asarray(c.real, dtype),
                               jnp.asarray(c.imag, dtype),
                               max_iter=max_iter, segment=segment,
-                              bailout=float(bailout))
+                              bailout=float(bailout), interior_check=False)
 
 
-@partial(jax.jit, static_argnames=("max_iter", "segment", "bailout"))
+@partial(jax.jit, static_argnames=("max_iter", "segment", "bailout",
+                                   "interior_check"))
 def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
                        c_real: jax.Array, c_imag: jax.Array, *,
-                       max_iter: int, segment: int,
-                       bailout: float) -> jax.Array:
+                       max_iter: int, segment: int, bailout: float,
+                       interior_check: bool = False) -> jax.Array:
     dtype = jnp.result_type(zr0)
     zr0 = zr0.astype(dtype)
     zi0 = zi0.astype(dtype)
@@ -365,8 +431,18 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
     # for orbits hovering at 2+eps (which get nu = n+2 via the clamp).
     extra = 8 + int(np.ceil(np.log2(np.log2(max(bailout, 4.0)))))
     mix = zr0 * 0 + zi0 * 0
-    init = (zr0 + mix, zi0 + mix, mix == 0, mix.astype(jnp.int32),
-            mix == 0, mix.astype(jnp.int32))
+    active0 = mix == 0
+    n2_0 = mix.astype(jnp.int32)
+    if interior_check:  # valid only for z0 == c (Mandelbrot callers)
+        interior = mandelbrot_interior(c_real + mix, c_imag + mix)
+        # Proven-interior pixels: inactive from the start (their z stays
+        # frozen at c — harmless, the output branch discards it), radius-2
+        # count pre-saturated so they classify in-set (nu = 0) exactly as
+        # if they had iterated the full budget.
+        active0 = active0 & ~interior
+        n2_0 = n2_0 + interior.astype(jnp.int32) * total_steps
+    init = (zr0 + mix, zi0 + mix, active0, mix.astype(jnp.int32),
+            active0, n2_0)
     zr, zi, active, n, bounded2, n2 = segmented_while(
         one_step, init, total_steps=total_steps + extra, segment=segment,
         active_of=lambda s: s[2])

@@ -41,6 +41,7 @@ import numpy as np
 from jax import lax
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
+from distributedmandelbrot_tpu.ops.escape_time import mandelbrot_interior
 
 def _pallas():
     """Import pallas lazily: on some builds the import itself fails unless
@@ -65,9 +66,26 @@ DEFAULT_BLOCK_W = 128
 DEFAULT_UNROLL = 32
 
 
+def _interior_init(c_real, c_imag, dyn_steps, shape, interior_check: bool):
+    """Shared scratch-state seed for both block kernels: ``(act0, n_sat,
+    live0)`` where proven-interior pixels (closed-form cardioid/bulb test,
+    ops.escape_time.mandelbrot_interior) start inactive with their bounded
+    count pre-saturated at ``dyn_steps`` — so they classify "never escaped"
+    (0 / nu=0) with zero iterations — and ``live0`` seeds the while-loop's
+    live count so a block of only interior + sky pixels exits before a
+    single escape segment runs."""
+    if interior_check:
+        interior = mandelbrot_interior(c_real, c_imag).astype(jnp.int32)
+        act0 = 1 - interior
+        return act0, interior * dyn_steps, jnp.sum(act0, dtype=jnp.int32)
+    return (jnp.ones(shape, jnp.int32), jnp.zeros(shape, jnp.int32),
+            jnp.asarray(shape[0] * shape[1], jnp.int32))
+
+
 def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                          act_ref, n_ref, *, max_iter: int, unroll: int,
-                         block_h: int, block_w: int, clamp: bool):
+                         block_h: int, block_w: int, clamp: bool,
+                         interior_check: bool):
     """One (block_h, block_w) block: in-kernel grid -> escape loop -> uint8.
 
     Semantics pinned to the reference kernel
@@ -105,8 +123,12 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
     zr_ref[:] = c_real
     zi_ref[:] = c_imag
-    act_ref[:] = jnp.ones(shape, jnp.int32)
-    n_ref[:] = jnp.zeros(shape, jnp.int32)
+    # Interior pixels otherwise dominate iteration work on set-crossing
+    # views — this shortcut is where the block-granular exit really pays.
+    act0, n_sat, live0 = _interior_init(c_real, c_imag, dyn_steps, shape,
+                                        interior_check)
+    act_ref[:] = act0
+    n_ref[:] = n_sat
 
     # Select-free escape recurrence with a sticky active mask; see
     # ops/escape_time.py:escape_loop for why stickiness matters and how
@@ -141,9 +163,7 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
         it, live = carry
         return (it <= dyn_steps) & (live > 0)
 
-    lax.while_loop(seg_cond, seg_body,
-                   (jnp.asarray(1, jnp.int32),
-                    jnp.asarray(block_h * block_w, jnp.int32)))
+    lax.while_loop(seg_cond, seg_body, (jnp.asarray(1, jnp.int32), live0))
 
     n = n_ref[:]
     counts = jnp.where(n >= dyn_steps, 0, n + 1)
@@ -154,12 +174,13 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
 
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
-                                   "block_h", "block_w", "clamp", "interpret"))
+                                   "block_h", "block_w", "clamp", "interpret",
+                                   "interior_check"))
 def _pallas_escape(params, mrd=None, *, height: int, width: int,
                    max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, clamp: bool = False,
-                   interpret: bool = False):
+                   interpret: bool = False, interior_check: bool = True):
     """``max_iter`` is the static compile cap; ``mrd`` (defaults to the
     cap) is this tile's traced budget — see ``_escape_block_kernel``."""
     pl, pltpu = _pallas()
@@ -167,7 +188,8 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
         mrd = jnp.asarray([[max_iter]], jnp.int32)
     kernel = partial(_escape_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
-                     block_h=block_h, block_w=block_w, clamp=clamp)
+                     block_h=block_h, block_w=block_w, clamp=clamp,
+                     interior_check=interior_check)
     return pl.pallas_call(
         kernel,
         grid=(height // block_h, width // block_w),
@@ -188,7 +210,8 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
 def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                          actb_ref, n_ref, act2_ref, n2_ref,
                          *, max_iter: int, unroll: int, block_h: int,
-                         block_w: int, bailout: float, extra: int):
+                         block_w: int, bailout: float, extra: int,
+                         interior_check: bool):
     """Smooth-coloring twin of :func:`_escape_block_kernel`: freezes the
     full value at the first radius-``bailout`` crossing while a sticky
     radius-2 count keeps in-set classification identical to the integer
@@ -221,10 +244,14 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
     zr_ref[:] = c_real
     zi_ref[:] = c_imag
-    actb_ref[:] = jnp.ones(shape, jnp.int32)
+    # Same interior shortcut as the integer kernel (radius-2 count is the
+    # one pre-saturated: it owns in-set classification, nu = 0).
+    act0, n2_sat, live0 = _interior_init(c_real, c_imag, dyn_steps, shape,
+                                         interior_check)
+    actb_ref[:] = act0
     n_ref[:] = jnp.zeros(shape, jnp.int32)
-    act2_ref[:] = jnp.ones(shape, jnp.int32)
-    n2_ref[:] = jnp.zeros(shape, jnp.int32)
+    act2_ref[:] = act0
+    n2_ref[:] = n2_sat
 
     def seg_body(carry):
         it, _ = carry
@@ -260,9 +287,7 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
         it, live = carry
         return (it <= dyn_steps + extra) & (live > 0)
 
-    lax.while_loop(seg_cond, seg_body,
-                   (jnp.asarray(1, jnp.int32),
-                    jnp.asarray(block_h * block_w, jnp.int32)))
+    lax.while_loop(seg_cond, seg_body, (jnp.asarray(1, jnp.int32), live0))
 
     n = n_ref[:]
     n2 = n2_ref[:]
@@ -278,12 +303,12 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
                                    "block_h", "block_w", "bailout",
-                                   "interpret"))
+                                   "interpret", "interior_check"))
 def _pallas_smooth(params, mrd=None, *, height: int, width: int,
                    max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, bailout: float = 256.0,
-                   interpret: bool = False):
+                   interpret: bool = False, interior_check: bool = True):
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
@@ -291,7 +316,8 @@ def _pallas_smooth(params, mrd=None, *, height: int, width: int,
     kernel = partial(_smooth_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
                      block_h=block_h, block_w=block_w,
-                     bailout=float(bailout), extra=extra)
+                     bailout=float(bailout), extra=extra,
+                     interior_check=interior_check)
     return pl.pallas_call(
         kernel,
         grid=(height // block_h, width // block_w),
@@ -316,7 +342,8 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
                                block_h: int = DEFAULT_BLOCK_H,
                                block_w: int | None = None,
                                bailout: float = 256.0,
-                               interpret: bool | None = None) -> np.ndarray:
+                               interpret: bool | None = None,
+                               interior_check: bool = True) -> np.ndarray:
     """Smooth (band-free) tile via the Pallas kernel -> (h, w) float32 nu.
 
     The f32 TPU throughput path for smooth rendering (animations, live
@@ -339,7 +366,7 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
     out = _pallas_smooth(params, mrd, height=spec.height, width=spec.width,
                          max_iter=cap, unroll=unroll, block_h=block_h,
                          block_w=block_w, bailout=bailout,
-                         interpret=interpret)
+                         interpret=interpret, interior_check=interior_check)
     return np.asarray(out)
 
 
@@ -408,7 +435,8 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
                                block_h: int = DEFAULT_BLOCK_H,
                                block_w: int | None = None,
                                clamp: bool = False,
-                               interpret: bool | None = None) -> jax.Array:
+                               interpret: bool | None = None,
+                               interior_check: bool = True) -> jax.Array:
     """Dispatch one tile's kernel; returns the (height, width) uint8 tile
     still on device.  Callers that pipeline (dispatch batch, then
     materialize) overlap compute with device->host transfers."""
@@ -428,7 +456,8 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
     mrd = jnp.asarray([[max_iter]], jnp.int32)
     return _pallas_escape(params, mrd, height=spec.height, width=spec.width,
                           max_iter=cap, unroll=unroll, block_h=block_h,
-                          block_w=block_w, clamp=clamp, interpret=interpret)
+                          block_w=block_w, clamp=clamp, interpret=interpret,
+                          interior_check=interior_check)
 
 
 def compute_tile_pallas(spec: TileSpec, max_iter: int, *,
@@ -436,13 +465,16 @@ def compute_tile_pallas(spec: TileSpec, max_iter: int, *,
                         block_h: int = DEFAULT_BLOCK_H,
                         block_w: int | None = None,
                         clamp: bool = False,
-                        interpret: bool | None = None) -> np.ndarray:
+                        interpret: bool | None = None,
+                        interior_check: bool = True) -> np.ndarray:
     """Compute one tile with the Pallas kernel; flat uint8, real-fastest.
 
     ``interpret=None`` auto-selects interpreter mode off-TPU (slow; for
-    functional testing only).
+    functional testing only).  ``interior_check`` toggles the closed-form
+    interior shortcut (output-identical; off only for timing the raw loop).
     """
     out = compute_tile_pallas_device(spec, max_iter, unroll=unroll,
                                      block_h=block_h, block_w=block_w,
-                                     clamp=clamp, interpret=interpret)
+                                     clamp=clamp, interpret=interpret,
+                                     interior_check=interior_check)
     return np.asarray(out).ravel()

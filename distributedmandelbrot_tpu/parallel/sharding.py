@@ -38,7 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributedmandelbrot_tpu.core.geometry import TileSpec
 from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
                                                        INT32_SCALE_LIMIT,
-                                                       escape_loop)
+                                                       escape_loop,
+                                                       mandelbrot_interior)
 from distributedmandelbrot_tpu.parallel.mesh import ROW_AXIS, TILE_AXIS
 
 try:
@@ -71,8 +72,12 @@ def _masked_escape(c_real, c_imag, max_iter_cap: int, segment: int):
     # body mixes in the other.
     zr0 = c_real + 0.0 * c_imag
     zi0 = c_imag + 0.0 * c_real
+    # Both sharded paths render the Mandelbrot family (z0 == c), so the
+    # closed-form interior shortcut always applies (output-identical;
+    # see ops.escape_time.mandelbrot_interior).
+    interior = mandelbrot_interior(zr0, zi0)
     return escape_loop(zr0, zi0, c_real, c_imag, total_steps=total_steps,
-                       segment=segment)
+                       segment=segment, interior=interior)
 
 
 def _scale_pixels(counts, mrd, clamp: bool):

@@ -114,6 +114,63 @@ def test_compute_tile_f32_close_to_golden():
 
 
 # ---------------------------------------------------------------------------
+# Closed-form interior shortcut (main cardioid + period-2 bulb).
+
+# Views chosen to exercise the shortcut's three regimes: deep inside the
+# curves, straddling their boundaries, and not touching them at all.
+INTERIOR_VIEWS = [
+    TileSpec(-0.6, -0.4, 0.8, 0.8, width=96, height=96),    # cardioid bulk
+    TileSpec(-1.2, -0.2, 0.4, 0.4, width=96, height=96),    # period-2 bulb
+    TileSpec(-0.748, 0.09, 0.02, 0.02, width=96, height=96),  # seahorse straddle
+    TileSpec(-2.0, -2.0, 4.0, 4.0, width=96, height=96),    # full view
+]
+
+
+@pytest.mark.parametrize("spec", INTERIOR_VIEWS)
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_interior_check_is_output_identical(spec, dtype):
+    """The cardioid/bulb shortcut is a pure work optimization: counts with
+    the check on must equal counts with it off, bit for bit."""
+    cr, ci = grids(spec)
+    import jax.numpy as jnp
+    cr = jnp.asarray(cr, dtype)
+    ci = jnp.asarray(ci, dtype)
+    on = np.asarray(escape_counts(cr, ci, max_iter=600, interior_check=True))
+    off = np.asarray(escape_counts(cr, ci, max_iter=600,
+                                   interior_check=False))
+    np.testing.assert_array_equal(on, off)
+
+
+def test_interior_mask_pixels_never_escape_in_golden():
+    """Every pixel the closed-form test claims is interior must be a pixel
+    the golden reference finds never escapes (the converse need not hold:
+    higher-period components are not covered by the test)."""
+    from distributedmandelbrot_tpu.ops.escape_time import mandelbrot_interior
+    spec = TileSpec(-2.0, -1.25, 2.5, 2.5, width=160, height=160)
+    cr, ci = grids(spec)
+    golden = ref.escape_counts(cr, ci, 2000)
+    mask = np.asarray(mandelbrot_interior(cr.astype(np.float32),
+                                          ci.astype(np.float32)))
+    assert mask.any()  # the view crosses both curves
+    assert (golden[mask] == 0).all(), (
+        f"{(golden[mask] != 0).sum()} shortcut pixels escaped in the golden")
+
+
+def test_interior_smooth_is_output_identical():
+    from distributedmandelbrot_tpu.ops.escape_time import escape_smooth
+    import jax.numpy as jnp
+    spec = INTERIOR_VIEWS[2]
+    cr, ci = grids(spec)
+    cr = jnp.asarray(cr, jnp.float32)
+    ci = jnp.asarray(ci, jnp.float32)
+    on = np.asarray(escape_smooth(cr, ci, max_iter=600,
+                                  interior_check=True))
+    off = np.asarray(escape_smooth(cr, ci, max_iter=600,
+                                   interior_check=False))
+    np.testing.assert_array_equal(on, off)
+
+
+# ---------------------------------------------------------------------------
 # Smooth (continuous) coloring — the quality/deep-zoom extension.
 
 @pytest.mark.parametrize("spec", VIEWS)

@@ -69,6 +69,29 @@ def test_pallas_block_granular_exit_consistency():
     assert float((a != c).mean()) <= 0.001
 
 
+def test_pallas_interior_check_is_output_identical():
+    """The closed-form interior shortcut must not change a single pixel —
+    it only changes how much work the block loop does."""
+    for view in ("seahorse", "full"):
+        spec = VIEWS[view]
+        on = compute_tile_pallas(spec, 300, block_h=32, interpret=True,
+                                 interior_check=True)
+        off = compute_tile_pallas(spec, 300, block_h=32, interpret=True,
+                                  interior_check=False)
+        np.testing.assert_array_equal(on, off)
+
+
+def test_pallas_smooth_interior_check_is_output_identical():
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_smooth_pallas)
+    spec = VIEWS["seahorse"]
+    on = compute_tile_smooth_pallas(spec, 300, block_h=32, interpret=True,
+                                    interior_check=True)
+    off = compute_tile_smooth_pallas(spec, 300, block_h=32, interpret=True,
+                                     interior_check=False)
+    np.testing.assert_array_equal(on, off)
+
+
 def test_pallas_non_multiple_height():
     """Heights that aren't a multiple of the default block fall back to a
     fitting power-of-two divisor (160 = 32*5 -> block_h 32)."""
