@@ -77,6 +77,18 @@ MAX_UNROLL = 64
 # test-value terms, negligible area), never correctness.
 INTERIOR_MARGIN = {np.dtype(np.float32): 1e-5, np.dtype(np.float64): 1e-12}
 
+# Budgets at/above this enable the Brent cycle probe by default (see
+# escape_loop): deep budgets are where in-set pixels missed by the closed
+# forms dominate; shallow budgets lose more to the probe's per-step
+# compares than they save.  The Pallas kernel applies the same policy to
+# its static cap via the same resolve_cycle_check.
+CYCLE_CHECK_MIN_ITER = 4096
+
+
+def resolve_cycle_check(cycle_check: bool | None, max_iter: int) -> bool:
+    return (max_iter >= CYCLE_CHECK_MIN_ITER if cycle_check is None
+            else cycle_check)
+
 
 def unrolled_steps(step_fn, state, segment: int, max_unroll: int = MAX_UNROLL):
     """Apply ``step_fn`` ``segment`` times: fori_loop over full
@@ -127,17 +139,23 @@ def mandelbrot_interior(c_real, c_imag, margin: float | None = None):
 
 
 def segmented_while(one_step, state, *, total_steps: int, segment: int,
-                    active_of):
+                    active_of, seg_hook=None):
     """Run ``one_step`` in fixed-trip unrolled segments under a
     ``lax.while_loop`` until the iteration budget is spent or
     ``active_of(state)`` is all-False (tile-granular early exit).  The last
     segment may overrun past ``total_steps``; callers cancel overrun
     effects arithmetically (see :func:`escape_loop`).  Shared scaffolding
-    for the parity and smooth kernels."""
+    for the parity and smooth kernels.
+
+    ``seg_hook(state, it) -> state``, if given, runs once at the top of
+    each segment (used for the cycle-probe snapshot refresh — per-segment
+    cost instead of per-step)."""
     segment = max(1, min(segment, total_steps))
 
     def segment_body(carry):
         s, it = carry
+        if seg_hook is not None:
+            s = seg_hook(s, it)
         # Fixed-trip segment; unroll capped so compile time stays bounded.
         return (unrolled_steps(one_step, s, segment), it + segment)
 
@@ -152,7 +170,7 @@ def segmented_while(one_step, state, *, total_steps: int, segment: int,
 
 
 def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
-                interior=None):
+                interior=None, cycle_check: bool = False):
     """The shared segmented escape recurrence (single source of truth for
     the XLA, sharded, and Pallas kernels).
 
@@ -187,18 +205,46 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
     full iteration; only the work changes.  Callers must pass it only
     when ``z0 == c`` (the Mandelbrot family — the test is meaningless
     for Julia orbits).
+
+    ``cycle_check``: Brent-style periodicity probe.  A ``z`` bitwise
+    equal to a snapshot from an earlier iteration means the orbit
+    repeats forever under the deterministic map and can never escape, so
+    the count saturates and the lane retires — again output-identical,
+    valid for any ``z0``/``c`` (Julia included).  Snapshots refresh at
+    doubling iteration gaps (per segment, via ``seg_hook``), so any
+    eventual exact-float cycle is caught once the gap exceeds its
+    period.  Worth its ~4 extra ops/step only at deep budgets where
+    closed forms leave in-set pixels running (higher-period bulbs,
+    minibrots, Julia interiors) — see CYCLE_CHECK_MIN_ITER.
     """
     four = jnp.asarray(4.0, jnp.result_type(zr0))
 
     def one_step(state):
-        zr, zi, zr2, zi2, active, n = state
+        if cycle_check:
+            zr, zi, zr2, zi2, active, n, szr, szi, next_snap = state
+        else:
+            zr, zi, zr2, zi2, active, n = state
         zi = (zr + zr) * zi + c_imag
         zr = zr2 - zi2 + c_real
         zr2 = zr * zr
         zi2 = zi * zi
         active = active & (zr2 + zi2 < four)
+        if cycle_check:
+            cyc = active & (zr == szr) & (zi == szi)
+            active = active & ~cyc
+            n = n + cyc.astype(jnp.int32) * total_steps
+            n = n + active.astype(jnp.int32)
+            return (zr, zi, zr2, zi2, active, n, szr, szi, next_snap)
         n = n + active.astype(jnp.int32)
         return (zr, zi, zr2, zi2, active, n)
+
+    def snap_hook(state, it):
+        zr, zi, zr2, zi2, active, n, szr, szi, next_snap = state
+        do = it >= next_snap
+        szr = jnp.where(do, zr, szr)
+        szi = jnp.where(do, zi, szi)
+        next_snap = jnp.where(do, it + it, next_snap)
+        return (zr, zi, zr2, zi2, active, n, szr, szi, next_snap)
 
     mix = zr0 * 0 + zi0 * 0  # union of varying axes under shard_map
     active0 = mix == 0
@@ -207,22 +253,28 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
         active0 = active0 & ~interior
         n0 = n0 + interior.astype(jnp.int32) * total_steps
     init = (zr0, zi0, zr0 * zr0, zi0 * zi0, active0, n0)
-    zr, zi, zr2, zi2, active, n = segmented_while(
+    if cycle_check:
+        init = init + (zr0, zi0, jnp.asarray(2, jnp.int32))
+    state = segmented_while(
         one_step, init, total_steps=total_steps, segment=segment,
-        active_of=lambda s: s[4])
+        active_of=lambda s: s[4],
+        seg_hook=snap_hook if cycle_check else None)
+    n = state[5]
     return jnp.where(n >= total_steps, 0, n + 1)
 
 
 def escape_counts(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
                   segment: int = DEFAULT_SEGMENT,
-                  interior_check: bool = True) -> jax.Array:
+                  interior_check: bool = True,
+                  cycle_check: bool | None = None) -> jax.Array:
     """Escape iteration (int32) per element; 0 if never escaped.
 
     Semantics pinned to the golden reference: z starts at c, iterations
     count 1..max_iter-1, bailout test |z|^2 >= 4 after the update.
     ``interior_check`` applies the closed-form interior shortcut
-    (:func:`mandelbrot_interior`; output-identical, work-saving) — on by
-    default, disable to time the raw loop.
+    (:func:`mandelbrot_interior`) and ``cycle_check`` the Brent
+    periodicity probe (None = on for deep budgets) — both
+    output-identical, work-saving; disable to time the raw loop.
 
     Thin dispatch wrapper: float64 inputs enable x64 first — otherwise JAX
     would silently truncate them to float32 and run the fast path while the
@@ -232,13 +284,17 @@ def escape_counts(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
     if dt is not None and np.dtype(dt) == np.float64:
         ensure_x64()
     return _escape_counts_jit(c_real, c_imag, max_iter=max_iter,
-                              segment=segment, interior_check=interior_check)
+                              segment=segment, interior_check=interior_check,
+                              cycle_check=resolve_cycle_check(cycle_check,
+                                                              max_iter))
 
 
-@partial(jax.jit, static_argnames=("max_iter", "segment", "interior_check"))
+@partial(jax.jit, static_argnames=("max_iter", "segment", "interior_check",
+                                   "cycle_check"))
 def _escape_counts_jit(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
                        segment: int = DEFAULT_SEGMENT,
-                       interior_check: bool = True) -> jax.Array:
+                       interior_check: bool = True,
+                       cycle_check: bool = False) -> jax.Array:
     dtype = jnp.result_type(c_real)
     c_real = c_real.astype(dtype)
     c_imag = c_imag.astype(dtype)
@@ -249,12 +305,13 @@ def _escape_counts_jit(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
     interior = mandelbrot_interior(c_real, c_imag) if interior_check else None
     return escape_loop(c_real, c_imag, c_real, c_imag,
                        total_steps=total_steps, segment=segment,
-                       interior=interior)
+                       interior=interior, cycle_check=cycle_check)
 
 
 def escape_counts_julia(z_real: jax.Array, z_imag: jax.Array,
                         c: complex, *, max_iter: int,
-                        segment: int = DEFAULT_SEGMENT) -> jax.Array:
+                        segment: int = DEFAULT_SEGMENT,
+                        cycle_check: bool | None = None) -> jax.Array:
     """Julia-set escape counts: z starts at the pixel, ``c`` is a constant.
 
     A capability extension past the reference (which renders only the
@@ -264,6 +321,11 @@ def escape_counts_julia(z_real: jax.Array, z_imag: jax.Array,
     segmented select-free loop, uint8 scaling, and tile plumbing.  Same
     count semantics as :func:`escape_counts` (iterations 1..max_iter-1,
     first test after the first update, 0 = never escaped).
+
+    No closed-form interior exists for arbitrary Julia sets, but the
+    Brent cycle probe (``cycle_check``, None = on for deep budgets) is
+    z0-agnostic, so connected Julia interiors — attracting-orbit basins
+    — still get an in-set shortcut.
     """
     dt = getattr(z_real, "dtype", None)
     if dt is not None and np.dtype(dt) == np.float64:
@@ -275,19 +337,23 @@ def escape_counts_julia(z_real: jax.Array, z_imag: jax.Array,
     return _escape_counts_julia_jit(z_real, z_imag,
                                     jnp.asarray(c.real, dtype),
                                     jnp.asarray(c.imag, dtype),
-                                    max_iter=max_iter, segment=segment)
+                                    max_iter=max_iter, segment=segment,
+                                    cycle_check=resolve_cycle_check(
+                                        cycle_check, max_iter))
 
 
-@partial(jax.jit, static_argnames=("max_iter", "segment"))
+@partial(jax.jit, static_argnames=("max_iter", "segment", "cycle_check"))
 def _escape_counts_julia_jit(z_real: jax.Array, z_imag: jax.Array,
                              cr: jax.Array, ci: jax.Array,
-                             *, max_iter: int, segment: int) -> jax.Array:
+                             *, max_iter: int, segment: int,
+                             cycle_check: bool = False) -> jax.Array:
     dtype = jnp.result_type(z_real)
     total_steps = max_iter - 1
     if total_steps <= 0:
         return jnp.zeros(z_real.shape, jnp.int32)
     return escape_loop(z_real.astype(dtype), z_imag.astype(dtype), cr, ci,
-                       total_steps=total_steps, segment=segment)
+                       total_steps=total_steps, segment=segment,
+                       cycle_check=cycle_check)
 
 
 def compute_tile_julia(spec: TileSpec, c: complex, max_iter: int, *,

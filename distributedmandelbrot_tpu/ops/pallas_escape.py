@@ -41,7 +41,8 @@ import numpy as np
 from jax import lax
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
-from distributedmandelbrot_tpu.ops.escape_time import mandelbrot_interior
+from distributedmandelbrot_tpu.ops.escape_time import (mandelbrot_interior,
+                                                       resolve_cycle_check)
 
 def _pallas():
     """Import pallas lazily: on some builds the import itself fails unless
@@ -83,9 +84,10 @@ def _interior_init(c_real, c_imag, dyn_steps, shape, interior_check: bool):
 
 
 def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
-                         act_ref, n_ref, *, max_iter: int, unroll: int,
-                         block_h: int, block_w: int, clamp: bool,
-                         interior_check: bool):
+                         act_ref, n_ref, *snap_refs, max_iter: int,
+                         unroll: int, block_h: int, block_w: int,
+                         clamp: bool, interior_check: bool,
+                         cycle_check: bool):
     """One (block_h, block_w) block: in-kernel grid -> escape loop -> uint8.
 
     Semantics pinned to the reference kernel
@@ -129,6 +131,10 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                                         interior_check)
     act_ref[:] = act0
     n_ref[:] = n_sat
+    if cycle_check:
+        szr_ref, szi_ref = snap_refs  # allocated only in cycle mode
+        szr_ref[:] = c_real  # snapshot of z_0 (z starts at c)
+        szi_ref[:] = c_imag
 
     # Select-free escape recurrence with a sticky active mask; see
     # ops/escape_time.py:escape_loop for why stickiness matters and how
@@ -137,11 +143,21 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     # The mask stays int32 end-to-end — i1 vectors can appear only as
     # transient compare results, never in carries or stores.
     def seg_body(carry):
-        it, _ = carry
+        it, _, next_snap = carry
         zr = zr_ref[:]
         zi = zi_ref[:]
         act = act_ref[:]
         n = n_ref[:]
+        if cycle_check:
+            # Brent-style snapshot refresh at doubling iteration gaps:
+            # once the gap exceeds the orbit's (eventual, exact-f32)
+            # period, the per-step equality below fires within one
+            # period.  Scalar predicate -> vector select; refresh cost is
+            # per-segment, not per-step.
+            do_snap = it >= next_snap
+            szr = jnp.where(do_snap, zr, szr_ref[:])
+            szi = jnp.where(do_snap, zi, szi_ref[:])
+            next_snap = jnp.where(do_snap, it + it, next_snap)
         zr2 = zr * zr
         zi2 = zi * zi
         for _ in range(unroll):
@@ -150,20 +166,35 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
             zr2 = zr * zr
             zi2 = zi * zi
             act = act & (zr2 + zi2 < four).astype(jnp.int32)
+            if cycle_check:
+                # Exact periodicity: z identical (bitwise) to the
+                # snapshot means the orbit repeats forever and can never
+                # escape — saturate its count so it classifies in-set,
+                # the same value full iteration would produce, and
+                # retire the lane from the live count.  (inf/NaN lanes
+                # are already inactive; NaN != NaN keeps them inert.)
+                cyc = act & ((zr == szr) & (zi == szi)).astype(jnp.int32)
+                act = act - cyc
+                n = n + cyc * dyn_steps
             n = n + act
         zr_ref[:] = zr
         zi_ref[:] = zi
         act_ref[:] = act
         n_ref[:] = n
+        if cycle_check:
+            szr_ref[:] = szr
+            szi_ref[:] = szi
         # dtype pinned: under x64 a bare sum would widen to int64 and
         # break the while carry's type invariance.
-        return (it + unroll, jnp.sum(act, dtype=jnp.int32))
+        return (it + unroll, jnp.sum(act, dtype=jnp.int32), next_snap)
 
     def seg_cond(carry):
-        it, live = carry
+        it, live, _ = carry
         return (it <= dyn_steps) & (live > 0)
 
-    lax.while_loop(seg_cond, seg_body, (jnp.asarray(1, jnp.int32), live0))
+    lax.while_loop(seg_cond, seg_body,
+                   (jnp.asarray(1, jnp.int32), live0,
+                    jnp.asarray(2, jnp.int32)))
 
     n = n_ref[:]
     counts = jnp.where(n >= dyn_steps, 0, n + 1)
@@ -175,21 +206,27 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
                                    "block_h", "block_w", "clamp", "interpret",
-                                   "interior_check"))
+                                   "interior_check", "cycle_check"))
 def _pallas_escape(params, mrd=None, *, height: int, width: int,
                    max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, clamp: bool = False,
-                   interpret: bool = False, interior_check: bool = True):
+                   interpret: bool = False, interior_check: bool = True,
+                   cycle_check: bool | None = None):
     """``max_iter`` is the static compile cap; ``mrd`` (defaults to the
     cap) is this tile's traced budget — see ``_escape_block_kernel``."""
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
+    # Deep static caps default the Brent probe on: the blocks still live
+    # at depth are exactly the ones held open by in-set pixels the closed
+    # forms miss (higher-period bulbs, minibrots), whose eventual exact-
+    # f32 limit cycles the probe retires (ops.escape_time.escape_loop).
+    cycle_check = resolve_cycle_check(cycle_check, max_iter)
     kernel = partial(_escape_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
                      block_h=block_h, block_w=block_w, clamp=clamp,
-                     interior_check=interior_check)
+                     interior_check=interior_check, cycle_check=cycle_check)
     return pl.pallas_call(
         kernel,
         grid=(height // block_h, width // block_w),
@@ -202,7 +239,11 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
         scratch_shapes=[pltpu.VMEM((block_h, block_w), jnp.float32),
                         pltpu.VMEM((block_h, block_w), jnp.float32),
                         pltpu.VMEM((block_h, block_w), jnp.int32),
-                        pltpu.VMEM((block_h, block_w), jnp.int32)],
+                        pltpu.VMEM((block_h, block_w), jnp.int32)]
+        # Snapshot buffers exist only in cycle mode — shallow budgets
+        # don't pay the extra VMEM.
+        + ([pltpu.VMEM((block_h, block_w), jnp.float32)] * 2
+           if cycle_check else []),
         interpret=interpret,
     )(params, mrd)
 
@@ -436,7 +477,8 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
                                block_w: int | None = None,
                                clamp: bool = False,
                                interpret: bool | None = None,
-                               interior_check: bool = True) -> jax.Array:
+                               interior_check: bool = True,
+                               cycle_check: bool | None = None) -> jax.Array:
     """Dispatch one tile's kernel; returns the (height, width) uint8 tile
     still on device.  Callers that pipeline (dispatch batch, then
     materialize) overlap compute with device->host transfers."""
@@ -457,7 +499,8 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
     return _pallas_escape(params, mrd, height=spec.height, width=spec.width,
                           max_iter=cap, unroll=unroll, block_h=block_h,
                           block_w=block_w, clamp=clamp, interpret=interpret,
-                          interior_check=interior_check)
+                          interior_check=interior_check,
+                          cycle_check=cycle_check)
 
 
 def compute_tile_pallas(spec: TileSpec, max_iter: int, *,
@@ -466,15 +509,19 @@ def compute_tile_pallas(spec: TileSpec, max_iter: int, *,
                         block_w: int | None = None,
                         clamp: bool = False,
                         interpret: bool | None = None,
-                        interior_check: bool = True) -> np.ndarray:
+                        interior_check: bool = True,
+                        cycle_check: bool | None = None) -> np.ndarray:
     """Compute one tile with the Pallas kernel; flat uint8, real-fastest.
 
     ``interpret=None`` auto-selects interpreter mode off-TPU (slow; for
     functional testing only).  ``interior_check`` toggles the closed-form
-    interior shortcut (output-identical; off only for timing the raw loop).
+    interior shortcut (output-identical; off only for timing the raw loop);
+    ``cycle_check`` the Brent periodicity probe (output-identical; None =
+    on for deep budgets, see escape_time.CYCLE_CHECK_MIN_ITER).
     """
     out = compute_tile_pallas_device(spec, max_iter, unroll=unroll,
                                      block_h=block_h, block_w=block_w,
                                      clamp=clamp, interpret=interpret,
-                                     interior_check=interior_check)
+                                     interior_check=interior_check,
+                                     cycle_check=cycle_check)
     return np.asarray(out).ravel()

@@ -39,7 +39,8 @@ from distributedmandelbrot_tpu.core.geometry import TileSpec
 from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
                                                        INT32_SCALE_LIMIT,
                                                        escape_loop,
-                                                       mandelbrot_interior)
+                                                       mandelbrot_interior,
+                                                       resolve_cycle_check)
 from distributedmandelbrot_tpu.parallel.mesh import ROW_AXIS, TILE_AXIS
 
 try:
@@ -57,7 +58,8 @@ def _device_grid(start_r, start_i, step, shape, dtype, row_offset=0):
     return c_real, c_imag
 
 
-def _masked_escape(c_real, c_imag, max_iter_cap: int, segment: int):
+def _masked_escape(c_real, c_imag, max_iter_cap: int, segment: int,
+                   cycle_check: bool | None = None):
     """The segmented escape loop (ops.escape_time.escape_loop; see there
     for the recurrence and count recovery)."""
     total_steps = max_iter_cap - 1
@@ -74,10 +76,13 @@ def _masked_escape(c_real, c_imag, max_iter_cap: int, segment: int):
     zi0 = c_imag + 0.0 * c_real
     # Both sharded paths render the Mandelbrot family (z0 == c), so the
     # closed-form interior shortcut always applies (output-identical;
-    # see ops.escape_time.mandelbrot_interior).
+    # see ops.escape_time.mandelbrot_interior); deep budgets also get the
+    # Brent cycle probe (same policy as escape_counts).
     interior = mandelbrot_interior(zr0, zi0)
     return escape_loop(zr0, zi0, c_real, c_imag, total_steps=total_steps,
-                       segment=segment, interior=interior)
+                       segment=segment, interior=interior,
+                       cycle_check=resolve_cycle_check(cycle_check,
+                                                       max_iter_cap))
 
 
 def _scale_pixels(counts, mrd, clamp: bool):
@@ -92,12 +97,14 @@ def _scale_pixels(counts, mrd, clamp: bool):
 
 
 def _one_tile_pixels(params, mrd, *, definition: int, max_iter_cap: int,
-                     segment: int, clamp: bool):
+                     segment: int, clamp: bool,
+                     cycle_check: bool | None = None):
     """params = (start_r, start_i, step) scalars; mrd = per-tile budget."""
     start_r, start_i, step = params[0], params[1], params[2]
     c_real, c_imag = _device_grid(start_r, start_i, step,
                                   (definition, definition), params.dtype)
-    counts = _masked_escape(c_real, c_imag, max_iter_cap, segment)
+    counts = _masked_escape(c_real, c_imag, max_iter_cap, segment,
+                            cycle_check=cycle_check)
     counts = jnp.where(counts <= mrd - 1, counts, 0)
     if max_iter_cap - 1 >= INT32_SCALE_LIMIT:
         counts = counts.astype(jnp.int64)
@@ -119,11 +126,13 @@ def pad_to_mesh(starts_steps: np.ndarray, mrds: np.ndarray,
 
 @partial(jax.jit,
          static_argnames=("mesh", "definition", "max_iter_cap", "segment",
-                          "clamp"))
+                          "clamp", "cycle_check"))
 def _batched_escape_sharded(params, mrds, *, mesh: Mesh, definition: int,
-                            max_iter_cap: int, segment: int, clamp: bool):
+                            max_iter_cap: int, segment: int, clamp: bool,
+                            cycle_check: bool | None = None):
     tile_fn = partial(_one_tile_pixels, definition=definition,
-                      max_iter_cap=max_iter_cap, segment=segment, clamp=clamp)
+                      max_iter_cap=max_iter_cap, segment=segment, clamp=clamp,
+                      cycle_check=cycle_check)
 
     def shard_fn(p_shard, m_shard):
         # Sequential walk of this device's tiles: each keeps its own
@@ -138,7 +147,8 @@ def _batched_escape_sharded(params, mrds, *, mesh: Mesh, definition: int,
 def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
                           mrds: np.ndarray, *, definition: int,
                           dtype=np.float32, segment: int = DEFAULT_SEGMENT,
-                          clamp: bool = False) -> np.ndarray:
+                          clamp: bool = False,
+                          cycle_check: bool | None = None) -> np.ndarray:
     """Compute a batch of tiles sharded over ``mesh``'s ``tiles`` axis.
 
     ``starts_steps``: float (k, 3) of ``(start_real, start_imag, step)``;
@@ -164,7 +174,8 @@ def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
     mrd_arr = jax.device_put(mrd_arr, sharding)
     out = _batched_escape_sharded(params, mrd_arr, mesh=mesh,
                                   definition=definition, max_iter_cap=cap,
-                                  segment=segment, clamp=clamp)
+                                  segment=segment, clamp=clamp,
+                                  cycle_check=cycle_check)
     return np.asarray(out)[:k]
 
 
@@ -237,9 +248,10 @@ def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
 
 
 @partial(jax.jit, static_argnames=("mesh", "definition", "max_iter", "segment",
-                                   "clamp"))
+                                   "clamp", "cycle_check"))
 def _row_sharded_tile(start_r, start_i, step, *, mesh: Mesh, definition: int,
-                      max_iter: int, segment: int, clamp: bool):
+                      max_iter: int, segment: int, clamp: bool,
+                      cycle_check: bool | None = None):
     n_rows = mesh.shape[ROW_AXIS]
     rows_per = definition // n_rows
 
@@ -247,7 +259,8 @@ def _row_sharded_tile(start_r, start_i, step, *, mesh: Mesh, definition: int,
         offset = lax.axis_index(ROW_AXIS) * rows_per
         c_real, c_imag = _device_grid(sr, si, st, (rows_per, definition),
                                       sr.dtype, row_offset=offset)
-        counts = _masked_escape(c_real, c_imag, max_iter, segment)
+        counts = _masked_escape(c_real, c_imag, max_iter, segment,
+                                cycle_check=cycle_check)
         if max_iter - 1 >= INT32_SCALE_LIMIT:
             counts = counts.astype(jnp.int64)
         return _scale_pixels(counts, jnp.asarray(max_iter, counts.dtype),
@@ -259,7 +272,8 @@ def _row_sharded_tile(start_r, start_i, step, *, mesh: Mesh, definition: int,
 
 def compute_tile_row_sharded(mesh: Mesh, spec: TileSpec, max_iter: int, *,
                              dtype=np.float32, segment: int = DEFAULT_SEGMENT,
-                             clamp: bool = False) -> np.ndarray:
+                             clamp: bool = False,
+                             cycle_check: bool | None = None) -> np.ndarray:
     """One tile's rows sharded across the mesh's ``rows`` axis (latency path)."""
     n_rows = mesh.shape[ROW_AXIS]
     if spec.height % n_rows:
@@ -275,5 +289,6 @@ def compute_tile_row_sharded(mesh: Mesh, spec: TileSpec, max_iter: int, *,
                             jnp.asarray(spec.start_imag, dtype),
                             jnp.asarray(step, dtype), mesh=mesh,
                             definition=spec.width, max_iter=max_iter,
-                            segment=segment, clamp=clamp)
+                            segment=segment, clamp=clamp,
+                            cycle_check=cycle_check)
     return np.asarray(out)

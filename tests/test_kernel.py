@@ -156,6 +156,74 @@ def test_interior_mask_pixels_never_escape_in_golden():
         f"{(golden[mask] != 0).sum()} shortcut pixels escaped in the golden")
 
 
+def test_cycle_check_is_output_identical():
+    """The Brent periodicity probe is a pure work optimization: an orbit
+    bitwise-equal to its snapshot repeats forever, so saturating it must
+    not change a single count."""
+    import jax.numpy as jnp
+    for spec in (TileSpec(-0.2, 0.7, 0.15, 0.15, width=96, height=96),
+                 INTERIOR_VIEWS[2]):
+        cr, ci = grids(spec)
+        cr = jnp.asarray(cr, jnp.float32)
+        ci = jnp.asarray(ci, jnp.float32)
+        base = np.asarray(escape_counts(cr, ci, max_iter=500,
+                                        interior_check=False,
+                                        cycle_check=False))
+        cyc = np.asarray(escape_counts(cr, ci, max_iter=500,
+                                       interior_check=False,
+                                       cycle_check=True))
+        np.testing.assert_array_equal(base, cyc)
+
+
+def test_cycle_check_julia_is_output_identical():
+    from distributedmandelbrot_tpu.ops.escape_time import escape_counts_julia
+    import jax.numpy as jnp
+    spec = TileSpec(-1.5, -1.5, 3.0, 3.0, width=96, height=96)
+    zr, zi = grids(spec)
+    zr = jnp.asarray(zr, jnp.float32)
+    zi = jnp.asarray(zi, jnp.float32)
+    c = -0.4 + 0.1j  # attracting fixed point: connected Julia interior
+    base = np.asarray(escape_counts_julia(zr, zi, c, max_iter=500,
+                                          cycle_check=False))
+    cyc = np.asarray(escape_counts_julia(zr, zi, c, max_iter=500,
+                                         cycle_check=True))
+    np.testing.assert_array_equal(base, cyc)
+    assert (cyc == 0).sum() > 0  # the view does contain in-set pixels
+
+
+def test_cycle_check_actually_retires_lanes():
+    """Effectiveness, observed through work: on a tile deep inside the
+    period-3 bulb (every orbit collapses to an exact f32 3-cycle within a
+    few hundred iterations; the cardioid/bulb closed forms do NOT cover
+    it), the probe must early-exit the segmented loop instead of burning
+    the full budget.  Wall-clock with a generous margin — probe-on skips
+    >97% of the iterations, so even noisy CI clears 2x."""
+    import time
+    import jax.numpy as jnp
+    spec = TileSpec(-0.13, 0.74, 0.01, 0.01, width=64, height=64)
+    cr, ci = grids(spec)
+    cr = jnp.asarray(cr, jnp.float32)
+    ci = jnp.asarray(ci, jnp.float32)
+    golden = ref.escape_counts(np.asarray(cr, np.float64),
+                               np.asarray(ci, np.float64), 2000)
+    assert (golden == 0).all(), "view must be entirely in-set"
+
+    def timed(**kw):
+        out = np.asarray(escape_counts(cr, ci, max_iter=30000,
+                                       interior_check=False, **kw))
+        t0 = time.perf_counter()  # second call: compiled
+        out = np.asarray(escape_counts(cr, ci, max_iter=30000,
+                                       interior_check=False, **kw))
+        assert (out == 0).all()
+        return time.perf_counter() - t0
+
+    t_off = timed(cycle_check=False)
+    t_on = timed(cycle_check=True)
+    assert t_on < t_off / 2, (
+        f"probe-on {t_on:.3f}s not clearly faster than probe-off "
+        f"{t_off:.3f}s — cycle detection is not retiring lanes")
+
+
 def test_interior_smooth_is_output_identical():
     from distributedmandelbrot_tpu.ops.escape_time import escape_smooth
     import jax.numpy as jnp

@@ -81,6 +81,18 @@ def test_pallas_interior_check_is_output_identical():
         np.testing.assert_array_equal(on, off)
 
 
+def test_pallas_cycle_check_is_output_identical():
+    """Brent periodicity probe in the block kernel: work-only, no output
+    change (period-3 bulb view — in-set pixels the closed forms miss)."""
+    spec = TileSpec(-0.2, 0.7, 0.15, 0.15, width=128, height=64)
+    base = compute_tile_pallas(spec, 200, block_h=32, interpret=True,
+                               interior_check=False, cycle_check=False)
+    cyc = compute_tile_pallas(spec, 200, block_h=32, interpret=True,
+                              interior_check=False, cycle_check=True)
+    np.testing.assert_array_equal(base, cyc)
+    assert (cyc == 0).sum() > 0  # the view does contain in-set pixels
+
+
 def test_pallas_smooth_interior_check_is_output_identical():
     from distributedmandelbrot_tpu.ops.pallas_escape import (
         compute_tile_smooth_pallas)
