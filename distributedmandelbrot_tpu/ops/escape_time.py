@@ -138,6 +138,18 @@ def mandelbrot_interior(c_real, c_imag, margin: float | None = None):
     return cardioid | bulb
 
 
+def brent_snap_hook(state, it):
+    """Shared cycle-probe snapshot refresh (see :func:`escape_loop`): the
+    trailing three state fields are, by convention, ``(szr, szi,
+    next_snap)``; snapshots refresh at doubling iteration gaps."""
+    *rest, szr, szi, next_snap = state
+    do = it >= next_snap
+    szr = jnp.where(do, state[0], szr)
+    szi = jnp.where(do, state[1], szi)
+    next_snap = jnp.where(do, it + it, next_snap)
+    return (*rest, szr, szi, next_snap)
+
+
 def segmented_while(one_step, state, *, total_steps: int, segment: int,
                     active_of, seg_hook=None):
     """Run ``one_step`` in fixed-trip unrolled segments under a
@@ -238,14 +250,6 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
         n = n + active.astype(jnp.int32)
         return (zr, zi, zr2, zi2, active, n)
 
-    def snap_hook(state, it):
-        zr, zi, zr2, zi2, active, n, szr, szi, next_snap = state
-        do = it >= next_snap
-        szr = jnp.where(do, zr, szr)
-        szi = jnp.where(do, zi, szi)
-        next_snap = jnp.where(do, it + it, next_snap)
-        return (zr, zi, zr2, zi2, active, n, szr, szi, next_snap)
-
     mix = zr0 * 0 + zi0 * 0  # union of varying axes under shard_map
     active0 = mix == 0
     n0 = mix.astype(jnp.int32)
@@ -258,7 +262,7 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
     state = segmented_while(
         one_step, init, total_steps=total_steps, segment=segment,
         active_of=lambda s: s[4],
-        seg_hook=snap_hook if cycle_check else None)
+        seg_hook=brent_snap_hook if cycle_check else None)
     n = state[5]
     return jnp.where(n >= total_steps, 0, n + 1)
 
@@ -405,7 +409,8 @@ def _scale_counts_jit(counts: jax.Array, *, max_iter: int,
 
 def escape_smooth(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
                   segment: int = DEFAULT_SEGMENT, bailout: float = 256.0,
-                  interior_check: bool = True) -> jax.Array:
+                  interior_check: bool = True,
+                  cycle_check: bool | None = None) -> jax.Array:
     """Continuous (smooth-colored) escape value per element; 0 if never
     escaped.
 
@@ -436,12 +441,15 @@ def escape_smooth(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
     return _escape_smooth_jit(c_real, c_imag, c_real, c_imag,
                               max_iter=max_iter, segment=segment,
                               bailout=float(bailout),
-                              interior_check=interior_check)
+                              interior_check=interior_check,
+                              cycle_check=resolve_cycle_check(cycle_check,
+                                                              max_iter))
 
 
 def escape_smooth_julia(z_real: jax.Array, z_imag: jax.Array, c: complex, *,
                         max_iter: int, segment: int = DEFAULT_SEGMENT,
-                        bailout: float = 256.0) -> jax.Array:
+                        bailout: float = 256.0,
+                        cycle_check: bool | None = None) -> jax.Array:
     """Smooth coloring for the Julia family (z starts at the pixel, ``c``
     constant and traced — constant sweeps reuse one executable).  Same
     semantics as :func:`escape_smooth`."""
@@ -454,15 +462,18 @@ def escape_smooth_julia(z_real: jax.Array, z_imag: jax.Array, c: complex, *,
                               jnp.asarray(c.real, dtype),
                               jnp.asarray(c.imag, dtype),
                               max_iter=max_iter, segment=segment,
-                              bailout=float(bailout), interior_check=False)
+                              bailout=float(bailout), interior_check=False,
+                              cycle_check=resolve_cycle_check(cycle_check,
+                                                              max_iter))
 
 
 @partial(jax.jit, static_argnames=("max_iter", "segment", "bailout",
-                                   "interior_check"))
+                                   "interior_check", "cycle_check"))
 def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
                        c_real: jax.Array, c_imag: jax.Array, *,
                        max_iter: int, segment: int, bailout: float,
-                       interior_check: bool = False) -> jax.Array:
+                       interior_check: bool = False,
+                       cycle_check: bool = False) -> jax.Array:
     dtype = jnp.result_type(zr0)
     zr0 = zr0.astype(dtype)
     zi0 = zi0.astype(dtype)
@@ -475,7 +486,10 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
     b2 = jnp.asarray(bailout * bailout, dtype)
 
     def one_step(state):
-        zr, zi, active, n, bounded2, n2 = state
+        if cycle_check:
+            zr, zi, active, n, bounded2, n2, szr, szi, next_snap = state
+        else:
+            zr, zi, active, n, bounded2, n2 = state
         nzi = (zr + zr) * zi + c_imag
         nzr = zr * zr - zi * zi + c_real
         zr = jnp.where(active, nzr, zr)
@@ -486,6 +500,18 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
         # Radius-2 count runs alongside (sticky, like the parity loop) so
         # in-set classification matches escape_counts exactly.
         bounded2 = bounded2 & (m2 < four)
+        if cycle_check:
+            # bounded2 implies still-active (radius 2 clears before the
+            # bailout radius), so the probe only ever fires on live,
+            # still-iterating orbits; see escape_loop for the exactness
+            # argument.  Saturating n2 classifies the lane in-set; the
+            # frozen z it leaves behind is discarded by the output branch.
+            cyc = bounded2 & (zr == szr) & (zi == szi)
+            bounded2 = bounded2 & ~cyc
+            active = active & ~cyc
+            n2 = n2 + cyc.astype(jnp.int32) * total_steps
+            n2 = n2 + bounded2.astype(jnp.int32)
+            return (zr, zi, active, n, bounded2, n2, szr, szi, next_snap)
         n2 = n2 + bounded2.astype(jnp.int32)
         return (zr, zi, active, n, bounded2, n2)
 
@@ -509,9 +535,13 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
         n2_0 = n2_0 + interior.astype(jnp.int32) * total_steps
     init = (zr0 + mix, zi0 + mix, active0, mix.astype(jnp.int32),
             active0, n2_0)
-    zr, zi, active, n, bounded2, n2 = segmented_while(
+    if cycle_check:
+        init = init + (zr0 + mix, zi0 + mix, jnp.asarray(2, jnp.int32))
+    state = segmented_while(
         one_step, init, total_steps=total_steps + extra, segment=segment,
-        active_of=lambda s: s[2])
+        active_of=lambda s: s[2],
+        seg_hook=brent_snap_hook if cycle_check else None)
+    zr, zi, active, n, bounded2, n2 = state[:6]
 
     # Frozen |z_e| is in [bailout, ~bailout^2 + |c|) — one squaring past
     # the test — so mag2 is in [bailout^2, ~bailout^4) and log_ratio in
@@ -533,7 +563,8 @@ def compute_tile_smooth(spec: TileSpec, max_iter: int, *,
                         dtype: np.dtype = np.float64,
                         segment: int = DEFAULT_SEGMENT,
                         bailout: float = 256.0,
-                        julia_c: complex | None = None) -> np.ndarray:
+                        julia_c: complex | None = None,
+                        cycle_check: bool | None = None) -> np.ndarray:
     """One tile through the smooth-coloring path -> 2-D float array.
 
     With ``julia_c`` set, renders the Julia set for that constant instead
@@ -546,10 +577,12 @@ def compute_tile_smooth(spec: TileSpec, max_iter: int, *,
     g_imag = jnp.asarray(g_imag, dtype=dtype)
     if julia_c is None:
         nu = escape_smooth(g_real, g_imag, max_iter=max_iter,
-                           segment=segment, bailout=bailout)
+                           segment=segment, bailout=bailout,
+                           cycle_check=cycle_check)
     else:
         nu = escape_smooth_julia(g_real, g_imag, julia_c, max_iter=max_iter,
-                                 segment=segment, bailout=bailout)
+                                 segment=segment, bailout=bailout,
+                                 cycle_check=cycle_check)
     return np.asarray(nu)
 
 

@@ -249,10 +249,10 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
 
 
 def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
-                         actb_ref, n_ref, act2_ref, n2_ref,
-                         *, max_iter: int, unroll: int, block_h: int,
+                         actb_ref, n_ref, act2_ref, n2_ref, *snap_refs,
+                         max_iter: int, unroll: int, block_h: int,
                          block_w: int, bailout: float, extra: int,
-                         interior_check: bool):
+                         interior_check: bool, cycle_check: bool):
     """Smooth-coloring twin of :func:`_escape_block_kernel`: freezes the
     full value at the first radius-``bailout`` crossing while a sticky
     radius-2 count keeps in-set classification identical to the integer
@@ -293,15 +293,24 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     n_ref[:] = jnp.zeros(shape, jnp.int32)
     act2_ref[:] = act0
     n2_ref[:] = n2_sat
+    if cycle_check:
+        szr_ref, szi_ref = snap_refs  # allocated only in cycle mode
+        szr_ref[:] = c_real
+        szi_ref[:] = c_imag
 
     def seg_body(carry):
-        it, _ = carry
+        it, _, next_snap = carry
         zr = zr_ref[:]
         zi = zi_ref[:]
         act_b = actb_ref[:]
         n = n_ref[:]
         act2 = act2_ref[:]
         n2 = n2_ref[:]
+        if cycle_check:
+            do_snap = it >= next_snap
+            szr = jnp.where(do_snap, zr, szr_ref[:])
+            szi = jnp.where(do_snap, zi, szi_ref[:])
+            next_snap = jnp.where(do_snap, it + it, next_snap)
         for _ in range(unroll):
             nzi = (zr + zr) * zi + c_imag
             nzr = zr * zr - zi * zi + c_real
@@ -315,6 +324,15 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
             act_b = act_b & (m2 < b2).astype(jnp.int32)
             n = n + act_b
             act2 = act2 & (m2 < four).astype(jnp.int32)
+            if cycle_check:
+                # act2 implies act_b (radius 2 clears before bailout), so
+                # the probe fires only on live orbits; saturating the
+                # radius-2 count classifies the lane in-set and retires
+                # it (see escape_loop for the exactness argument).
+                cyc = act2 & ((zr == szr) & (zi == szi)).astype(jnp.int32)
+                act2 = act2 - cyc
+                act_b = act_b - cyc
+                n2 = n2 + cyc * dyn_steps
             n2 = n2 + act2
         zr_ref[:] = zr
         zi_ref[:] = zi
@@ -322,13 +340,18 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
         n_ref[:] = n
         act2_ref[:] = act2
         n2_ref[:] = n2
-        return (it + unroll, jnp.sum(act_b, dtype=jnp.int32))
+        if cycle_check:
+            szr_ref[:] = szr
+            szi_ref[:] = szi
+        return (it + unroll, jnp.sum(act_b, dtype=jnp.int32), next_snap)
 
     def seg_cond(carry):
-        it, live = carry
+        it, live, _ = carry
         return (it <= dyn_steps + extra) & (live > 0)
 
-    lax.while_loop(seg_cond, seg_body, (jnp.asarray(1, jnp.int32), live0))
+    lax.while_loop(seg_cond, seg_body,
+                   (jnp.asarray(1, jnp.int32), live0,
+                    jnp.asarray(2, jnp.int32)))
 
     n = n_ref[:]
     n2 = n2_ref[:]
@@ -344,21 +367,25 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
                                    "block_h", "block_w", "bailout",
-                                   "interpret", "interior_check"))
+                                   "interpret", "interior_check",
+                                   "cycle_check"))
 def _pallas_smooth(params, mrd=None, *, height: int, width: int,
                    max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, bailout: float = 256.0,
-                   interpret: bool = False, interior_check: bool = True):
+                   interpret: bool = False, interior_check: bool = True,
+                   cycle_check: bool | None = None):
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
+    cycle_check = resolve_cycle_check(cycle_check, max_iter)
     extra = 8 + int(np.ceil(np.log2(np.log2(max(bailout, 4.0)))))
     kernel = partial(_smooth_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
                      block_h=block_h, block_w=block_w,
                      bailout=float(bailout), extra=extra,
-                     interior_check=interior_check)
+                     interior_check=interior_check,
+                     cycle_check=cycle_check)
     return pl.pallas_call(
         kernel,
         grid=(height // block_h, width // block_w),
@@ -373,7 +400,9 @@ def _pallas_smooth(params, mrd=None, *, height: int, width: int,
                         pltpu.VMEM((block_h, block_w), jnp.int32),
                         pltpu.VMEM((block_h, block_w), jnp.int32),
                         pltpu.VMEM((block_h, block_w), jnp.int32),
-                        pltpu.VMEM((block_h, block_w), jnp.int32)],
+                        pltpu.VMEM((block_h, block_w), jnp.int32)]
+        + ([pltpu.VMEM((block_h, block_w), jnp.float32)] * 2
+           if cycle_check else []),
         interpret=interpret,
     )(params, mrd)
 
@@ -384,7 +413,8 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
                                block_w: int | None = None,
                                bailout: float = 256.0,
                                interpret: bool | None = None,
-                               interior_check: bool = True) -> np.ndarray:
+                               interior_check: bool = True,
+                               cycle_check: bool | None = None) -> np.ndarray:
     """Smooth (band-free) tile via the Pallas kernel -> (h, w) float32 nu.
 
     The f32 TPU throughput path for smooth rendering (animations, live
@@ -407,7 +437,8 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
     out = _pallas_smooth(params, mrd, height=spec.height, width=spec.width,
                          max_iter=cap, unroll=unroll, block_h=block_h,
                          block_w=block_w, bailout=bailout,
-                         interpret=interpret, interior_check=interior_check)
+                         interpret=interpret, interior_check=interior_check,
+                         cycle_check=cycle_check)
     return np.asarray(out)
 
 

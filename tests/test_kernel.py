@@ -211,17 +211,34 @@ def test_cycle_check_actually_retires_lanes():
     def timed(**kw):
         out = np.asarray(escape_counts(cr, ci, max_iter=30000,
                                        interior_check=False, **kw))
-        t0 = time.perf_counter()  # second call: compiled
-        out = np.asarray(escape_counts(cr, ci, max_iter=30000,
-                                       interior_check=False, **kw))
         assert (out == 0).all()
-        return time.perf_counter() - t0
+        best = float("inf")  # min-of-3 compiled runs: noise-robust
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(escape_counts(cr, ci, max_iter=30000,
+                                     interior_check=False, **kw))
+            best = min(best, time.perf_counter() - t0)
+        return best
 
     t_off = timed(cycle_check=False)
     t_on = timed(cycle_check=True)
     assert t_on < t_off / 2, (
         f"probe-on {t_on:.3f}s not clearly faster than probe-off "
         f"{t_off:.3f}s — cycle detection is not retiring lanes")
+
+
+def test_cycle_check_smooth_is_output_identical():
+    from distributedmandelbrot_tpu.ops.escape_time import escape_smooth
+    import jax.numpy as jnp
+    spec = TileSpec(-0.2, 0.7, 0.15, 0.15, width=96, height=96)
+    cr, ci = grids(spec)
+    cr = jnp.asarray(cr, jnp.float32)
+    ci = jnp.asarray(ci, jnp.float32)
+    base = np.asarray(escape_smooth(cr, ci, max_iter=500,
+                                    interior_check=False, cycle_check=False))
+    cyc = np.asarray(escape_smooth(cr, ci, max_iter=500,
+                                   interior_check=False, cycle_check=True))
+    np.testing.assert_array_equal(base, cyc)
 
 
 def test_interior_smooth_is_output_identical():

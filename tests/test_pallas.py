@@ -104,6 +104,18 @@ def test_pallas_smooth_interior_check_is_output_identical():
     np.testing.assert_array_equal(on, off)
 
 
+def test_pallas_smooth_cycle_check_is_output_identical():
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_smooth_pallas)
+    spec = TileSpec(-0.2, 0.7, 0.15, 0.15, width=128, height=64)
+    base = compute_tile_smooth_pallas(spec, 200, block_h=32, interpret=True,
+                                      interior_check=False,
+                                      cycle_check=False)
+    cyc = compute_tile_smooth_pallas(spec, 200, block_h=32, interpret=True,
+                                     interior_check=False, cycle_check=True)
+    np.testing.assert_array_equal(base, cyc)
+
+
 def test_pallas_non_multiple_height():
     """Heights that aren't a multiple of the default block fall back to a
     fitting power-of-two divisor (160 = 32*5 -> block_h 32)."""
