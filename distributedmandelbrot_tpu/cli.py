@@ -108,13 +108,28 @@ DEEP_SPAN_THRESHOLD = 1e-12
 def _render_view(c_re: str, c_im: str, span: float, definition: int,
                  max_iter: int, *, smooth: bool, np_dtype, colormap: str,
                  deep: bool | None = None,
-                 julia_c: tuple[str, str] | None = None):
-    """One view -> RGBA (Mandelbrot, or Julia when ``julia_c`` is set),
+                 julia_c: tuple[str, str] | None = None,
+                 family: tuple[int, bool] | None = None):
+    """One view -> RGBA (Mandelbrot, or Julia when ``julia_c`` is set, or
+    a Multibrot/Burning-Ship view when ``family=(power, burning)``),
     choosing direct vs perturbation rendering.  Shared by the render and
     animate commands so their behavior can never diverge; ``deep=None``
     auto-selects below :data:`DEEP_SPAN_THRESHOLD`."""
     from distributedmandelbrot_tpu.core.geometry import TileSpec
     from distributedmandelbrot_tpu.viewer import smooth_to_rgba, value_to_rgba
+
+    if family is not None:
+        # Extended families: direct integer rendering only (no smooth /
+        # perturbation variants — command parsers reject those combos).
+        power, burning = family
+        from distributedmandelbrot_tpu.ops import compute_tile_family
+        cx, cy = float(c_re), float(c_im)
+        spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
+                        width=definition, height=definition)
+        values = compute_tile_family(spec, max_iter, power=power,
+                                     burning=burning, dtype=np_dtype)
+        return value_to_rgba(values.reshape(spec.height, spec.width),
+                             colormap=colormap)
 
     if deep is None:
         deep = span < DEEP_SPAN_THRESHOLD
@@ -420,8 +435,12 @@ def cmd_render(argv: Sequence[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="dmtpu render",
         description="Render a view locally on the default JAX backend.")
-    parser.add_argument("--fractal", choices=["mandelbrot", "julia"],
+    parser.add_argument("--fractal",
+                        choices=["mandelbrot", "julia", "multibrot", "ship"],
                         default="mandelbrot")
+    parser.add_argument("--power", type=int, default=None,
+                        help="multibrot degree d in z^d + c (>= 2; "
+                             "default 3; multibrot only)")
     parser.add_argument("--c", default="-0.8,0.156",
                         help="Julia constant as RE,IM")
     parser.add_argument("--center", default=None,
@@ -450,6 +469,27 @@ def cmd_render(argv: Sequence[str]) -> int:
     args = parser.parse_args(_join_negative_values(argv, ("--c", "--center")))
     _configure_logging(args)
 
+    family = None
+    if args.fractal in ("multibrot", "ship"):
+        if args.smooth or args.deep:
+            raise SystemExit(f"--fractal {args.fractal} supports direct "
+                             "integer rendering only (no --smooth/--deep)")
+        if args.span < DEEP_SPAN_THRESHOLD:
+            raise SystemExit(f"--fractal {args.fractal} has no perturbation "
+                             f"path; spans below {DEEP_SPAN_THRESHOLD} alias "
+                             "float64 pixel coordinates")
+        if args.fractal == "ship":
+            if args.power is not None:
+                raise SystemExit("--power applies to multibrot only "
+                                 "(the burning ship is degree 2)")
+            family = (2, True)
+        else:
+            power = 3 if args.power is None else args.power
+            if power < 2:
+                raise SystemExit("--power must be >= 2")
+            family = (power, False)
+    elif args.power is not None:
+        raise SystemExit("--power applies to --fractal multibrot only")
     default_center = "0,0" if args.fractal == "julia" else "-0.5,0.0"
     center_str = args.center or default_center
     c_re, c_im = (s.strip() for s in center_str.split(","))
@@ -460,7 +500,7 @@ def cmd_render(argv: Sequence[str]) -> int:
                         np_dtype=_resolve_dtype(args),
                         colormap=args.colormap,
                         deep=True if args.deep else None,
-                        julia_c=julia_c)
+                        julia_c=julia_c, family=family)
     _save_png(args.out, rgba)
     return 0
 

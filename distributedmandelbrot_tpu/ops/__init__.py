@@ -11,6 +11,8 @@ from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
                                                        escape_smooth,
                                                        escape_smooth_julia,
                                                        scale_counts_to_uint8)
+from distributedmandelbrot_tpu.ops.families import (compute_tile_family,
+                                                    escape_counts_family)
 from distributedmandelbrot_tpu.ops.perturbation import (DeepTileSpec,
                                                         compute_counts_perturb,
                                                         compute_smooth_perturb,
@@ -19,5 +21,6 @@ from distributedmandelbrot_tpu.ops.perturbation import (DeepTileSpec,
 __all__ = ["reference", "DEFAULT_SEGMENT", "compute_tile",
            "compute_tile_julia", "compute_tile_smooth", "escape_counts",
            "escape_counts_julia", "escape_smooth", "escape_smooth_julia",
-           "scale_counts_to_uint8", "DeepTileSpec", "compute_counts_perturb",
+           "scale_counts_to_uint8", "compute_tile_family",
+           "escape_counts_family", "DeepTileSpec", "compute_counts_perturb",
            "compute_smooth_perturb", "compute_tile_perturb"]

@@ -138,6 +138,28 @@ def mandelbrot_interior(c_real, c_imag, margin: float | None = None):
     return cardioid | bulb
 
 
+def cycle_probe_update(zr, zi, szr, szi, live, n, total_steps: int):
+    """Shared per-step Brent probe bookkeeping: retire exactly-repeating
+    live orbits and saturate their count so they classify never-escaped
+    (see :func:`escape_loop` for the exactness argument).  Returns the
+    updated ``(live, n)`` plus the fired mask ``cyc`` for callers that
+    maintain additional masks (the smooth kernels also clear their
+    bailout mask)."""
+    cyc = live & (zr == szr) & (zi == szi)
+    live = live & ~cyc
+    n = n + cyc.astype(jnp.int32) * total_steps
+    return live, n, cyc
+
+
+def counts_from_survival(n, total_steps: int):
+    """Escape counts from the survived-iterations count ``n``: a pixel
+    escaping at ``e`` survived ``e - 1`` updates, and ``n >= total_steps``
+    means never escaped within budget -> 0 (which also cancels escapes
+    recorded during the last segment's overrun and absorbs the interior/
+    cycle saturation)."""
+    return jnp.where(n >= total_steps, 0, n + 1)
+
+
 def brent_snap_hook(state, it):
     """Shared cycle-probe snapshot refresh (see :func:`escape_loop`): the
     trailing three state fields are, by convention, ``(szr, szi,
@@ -242,9 +264,8 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
         zi2 = zi * zi
         active = active & (zr2 + zi2 < four)
         if cycle_check:
-            cyc = active & (zr == szr) & (zi == szi)
-            active = active & ~cyc
-            n = n + cyc.astype(jnp.int32) * total_steps
+            active, n, _ = cycle_probe_update(zr, zi, szr, szi, active, n,
+                                              total_steps)
             n = n + active.astype(jnp.int32)
             return (zr, zi, zr2, zi2, active, n, szr, szi, next_snap)
         n = n + active.astype(jnp.int32)
@@ -263,8 +284,7 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
         one_step, init, total_steps=total_steps, segment=segment,
         active_of=lambda s: s[4],
         seg_hook=brent_snap_hook if cycle_check else None)
-    n = state[5]
-    return jnp.where(n >= total_steps, 0, n + 1)
+    return counts_from_survival(state[5], total_steps)
 
 
 def escape_counts(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
@@ -503,13 +523,12 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
         if cycle_check:
             # bounded2 implies still-active (radius 2 clears before the
             # bailout radius), so the probe only ever fires on live,
-            # still-iterating orbits; see escape_loop for the exactness
-            # argument.  Saturating n2 classifies the lane in-set; the
-            # frozen z it leaves behind is discarded by the output branch.
-            cyc = bounded2 & (zr == szr) & (zi == szi)
-            bounded2 = bounded2 & ~cyc
+            # still-iterating orbits.  Saturating n2 classifies the lane
+            # in-set; the frozen z it leaves behind is discarded by the
+            # output branch.
+            bounded2, n2, cyc = cycle_probe_update(zr, zi, szr, szi,
+                                                   bounded2, n2, total_steps)
             active = active & ~cyc
-            n2 = n2 + cyc.astype(jnp.int32) * total_steps
             n2 = n2 + bounded2.astype(jnp.int32)
             return (zr, zi, active, n, bounded2, n2, szr, szi, next_snap)
         n2 = n2 + bounded2.astype(jnp.int32)

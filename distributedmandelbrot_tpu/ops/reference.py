@@ -70,6 +70,36 @@ def escape_counts_julia(z_real: np.ndarray, z_imag: np.ndarray, c: complex,
     return counts
 
 
+def escape_counts_family(c_real: np.ndarray, c_imag: np.ndarray,
+                         max_iter: int, *, power: int = 2,
+                         burning: bool = False) -> np.ndarray:
+    """Multibrot / Burning Ship golden (capability extension; pins
+    ops.families).  Same loop protocol as :func:`escape_counts`; the
+    recurrence mirrors ``families.family_step``'s formula and operation
+    order exactly (complex power by repeated multiplication; Burning Ship
+    takes |Re z|, |Im z| before squaring)."""
+    zr = np.asarray(c_real, dtype=np.float64).copy()
+    zi = np.asarray(c_imag, dtype=np.float64).copy()
+    c_real = np.asarray(c_real, dtype=np.float64)
+    c_imag = np.asarray(c_imag, dtype=np.float64)
+    counts = np.zeros(zr.shape, dtype=np.int32)
+    active = np.ones(zr.shape, dtype=bool)
+    for it in range(1, max_iter):
+        azr = np.abs(zr) if burning else zr
+        azi = np.abs(zi) if burning else zi
+        wr, wi = azr, azi
+        for _ in range(power - 1):
+            wr, wi = wr * azr - wi * azi, wr * azi + wi * azr
+        zr = np.where(active, wr + c_real, zr)
+        zi = np.where(active, wi + c_imag, zi)
+        escaped = active & (zr * zr + zi * zi >= 4.0)
+        counts = np.where(escaped, np.int32(it), counts)
+        active &= ~escaped
+        if not active.any():
+            break
+    return counts
+
+
 def scale_counts_to_uint8(counts: np.ndarray, max_iter: int,
                           clamp: bool = False) -> np.ndarray:
     """Scale escape counts to the uint8 pixel encoding.

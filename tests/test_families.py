@@ -1,0 +1,120 @@
+"""Multibrot / Burning Ship family tests: golden parity, shortcut
+output-identity, tile plumbing, CLI rendering."""
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core import TileSpec
+from distributedmandelbrot_tpu.ops import (compute_tile_family,
+                                           escape_counts_family)
+from distributedmandelbrot_tpu.ops import reference as ref
+
+# Views straddling each family's set: multibrot-3 is symmetric about the
+# origin; the burning ship's main body sits near the negative real axis.
+MULTIBROT_VIEW = TileSpec(-1.2, -1.2, 2.4, 2.4, width=96, height=96)
+SHIP_VIEW = TileSpec(-2.2, -1.2, 2.4, 2.4, width=96, height=96)
+
+
+@pytest.mark.parametrize("power,burning,spec,tol", [
+    # Multibrot: same FMA-only tolerance as the core f64 kernel.
+    (3, False, MULTIBROT_VIEW, 5e-4),
+    (5, False, MULTIBROT_VIEW, 5e-4),
+    # Burning Ship: |.| folds the plane, so a last-ulp FMA difference can
+    # land an orbit on the other side of a fold and diverge the
+    # trajectory outright — a wider statistical band.  The select-free
+    # protocol itself is EXACT: a pure-numpy mirror of the JAX loop
+    # matches the frozen golden bit-for-bit (verified; the divergence is
+    # entirely XLA FMA contraction).
+    (2, True, SHIP_VIEW, 3e-2),
+])
+def test_family_f64_near_identical_to_golden(power, burning, spec, tol):
+    cr, ci = spec.grid_2d()
+    golden = ref.escape_counts_family(cr, ci, 300, power=power,
+                                      burning=burning)
+    got = np.asarray(escape_counts_family(cr, ci, max_iter=300, power=power,
+                                          burning=burning))
+    mismatched = got != golden
+    assert mismatched.mean() <= tol, (
+        f"{mismatched.mean():.2%} of pixels diverge (FMA tolerance {tol})")
+    if mismatched.any():
+        # Both paths must agree through a substantial prefix before any
+        # chaotic divergence: the smaller (nonzero) escape count on a
+        # mismatched pixel is the depth the trajectories tracked to.
+        g = np.where(golden > 0, golden, np.iinfo(np.int32).max)
+        w = np.where(got > 0, got, np.iinfo(np.int32).max)
+        assert np.minimum(g, w)[mismatched].min() >= 50
+
+
+def test_family_power2_matches_mandelbrot_golden():
+    """Degree-2 non-burning multibrot IS the Mandelbrot set; pin against
+    the core golden."""
+    spec = TileSpec(-2.0, -2.0, 4.0, 4.0, width=64, height=64)
+    cr, ci = spec.grid_2d()
+    golden = ref.escape_counts(cr, ci, 200)
+    got = np.asarray(escape_counts_family(cr, ci, max_iter=200, power=2))
+    mism = (got != golden).mean()
+    assert mism <= 5e-4
+
+
+def test_family_cycle_check_is_output_identical():
+    import jax.numpy as jnp
+    for power, burning, spec in [(3, False, MULTIBROT_VIEW),
+                                 (2, True, SHIP_VIEW)]:
+        cr, ci = spec.grid_2d()
+        cr = jnp.asarray(cr, jnp.float32)
+        ci = jnp.asarray(ci, jnp.float32)
+        base = np.asarray(escape_counts_family(
+            cr, ci, max_iter=400, power=power, burning=burning,
+            cycle_check=False))
+        cyc = np.asarray(escape_counts_family(
+            cr, ci, max_iter=400, power=power, burning=burning,
+            cycle_check=True))
+        np.testing.assert_array_equal(base, cyc)
+        assert (cyc == 0).sum() > 0  # the view does contain in-set pixels
+
+
+def test_family_tile_end_to_end_uint8():
+    pixels = compute_tile_family(MULTIBROT_VIEW, 200, power=3,
+                                 dtype=np.float64)
+    assert pixels.shape == (96 * 96,) and pixels.dtype == np.uint8
+    cr, ci = MULTIBROT_VIEW.grid_2d()
+    golden = ref.scale_counts_to_uint8(
+        ref.escape_counts_family(cr, ci, 200, power=3), 200).ravel()
+    assert (pixels != golden).mean() <= 5e-4
+
+
+def test_family_validation():
+    cr = np.zeros((4, 4))
+    with pytest.raises(ValueError, match="degree"):
+        escape_counts_family(cr, cr, max_iter=10, power=1)
+    with pytest.raises(ValueError, match="degree 2"):
+        escape_counts_family(cr, cr, max_iter=10, power=3, burning=True)
+
+
+def test_render_multibrot_and_ship(tmp_path):
+    from distributedmandelbrot_tpu import cli
+    for extra, name in ([["--fractal", "multibrot", "--power", "4",
+                          "--center", "0,0"], "m4.png"],
+                        [["--fractal", "ship", "--center", "-0.5,-0.5"],
+                         "ship.png"]):
+        out = str(tmp_path / name)
+        rc = cli.main(["render", *extra, "--definition", "64",
+                       "--max-iter", "64", "--span", "3", "--out", out])
+        assert rc == 0
+        import os
+        assert os.path.getsize(out) > 0
+
+
+def test_render_family_rejects_unsupported_combos(tmp_path):
+    from distributedmandelbrot_tpu import cli
+    out = str(tmp_path / "x.png")
+    for argv in (
+        ["render", "--fractal", "ship", "--smooth", "--out", out],
+        # no perturbation path: sub-threshold spans would alias float64
+        ["render", "--fractal", "ship", "--span", "1e-14", "--out", out],
+        ["render", "--fractal", "multibrot", "--power", "1", "--out", out],
+        ["render", "--fractal", "ship", "--power", "4", "--out", out],
+        ["render", "--power", "3", "--out", out],  # mandelbrot + --power
+    ):
+        with pytest.raises(SystemExit):
+            cli.main(argv)
