@@ -156,14 +156,16 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
     spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
                     width=definition, height=definition)
     if smooth:
-        if np_dtype == np.float32 and jc is None:
-            # f32 smooth throughput path: Pallas on TPU, XLA otherwise.
+        if np_dtype == np.float32:
+            # f32 smooth throughput path: Pallas on TPU, XLA otherwise
+            # (Mandelbrot and Julia both ride the same kernel).
             nu = None
             try:
                 from distributedmandelbrot_tpu.ops.pallas_escape import (
                     compute_tile_smooth_pallas, pallas_available)
                 if pallas_available():
-                    nu = compute_tile_smooth_pallas(spec, max_iter)
+                    nu = compute_tile_smooth_pallas(spec, max_iter,
+                                                    julia_c=jc)
             except ValueError:
                 nu = None  # shape/budget outside the kernel -> XLA below
             if nu is not None:
@@ -174,6 +176,23 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
         nu = compute_tile_smooth(spec, max_iter, dtype=np_dtype,
                                  julia_c=jc)
         return smooth_to_rgba(nu, max_iter, colormap=colormap)
+    if np_dtype == np.float32:
+        # Integer f32 fast path, same Pallas-first policy.  Only the
+        # kernel call sits in the try: rendering errors must surface,
+        # not trigger a fallback recompute.
+        values = None
+        try:
+            from distributedmandelbrot_tpu.ops.pallas_escape import (
+                compute_tile_julia_pallas, compute_tile_pallas,
+                pallas_available)
+            if pallas_available():
+                values = (compute_tile_pallas(spec, max_iter) if jc is None
+                          else compute_tile_julia_pallas(spec, jc, max_iter))
+        except ValueError:
+            values = None  # shape/budget outside the kernel -> XLA below
+        if values is not None:
+            return value_to_rgba(values.reshape(spec.height, spec.width),
+                                 colormap=colormap)
     if jc is not None:
         from distributedmandelbrot_tpu.ops import compute_tile_julia
         values = compute_tile_julia(spec, jc, max_iter, dtype=np_dtype)

@@ -287,6 +287,47 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
     return counts_from_survival(state[5], total_steps)
 
 
+def escape_loop_generic(step_fn, zr0, zi0, *, total_steps: int, segment: int,
+                        cycle_check: bool = False):
+    """Segmented select-free escape loop for an arbitrary one-step map
+    ``step_fn(zr, zi) -> (zr, zi)`` (the Multibrot / Burning Ship
+    families, ops.families).
+
+    Same protocol as :func:`escape_loop` — sticky mask, survived-count
+    recovery, Brent probe, overrun cancellation — sharing its helpers
+    (:func:`cycle_probe_update`, :func:`brent_snap_hook`,
+    :func:`counts_from_survival`); any protocol change must land in both
+    (the z^2+c loop stays specialized so it can reuse its cached squares
+    for the next update; this variant recomputes ``|z|^2``).
+    """
+    four = jnp.asarray(4.0, jnp.result_type(zr0))
+
+    def one_step(state):
+        if cycle_check:
+            zr, zi, active, n, szr, szi, next_snap = state
+        else:
+            zr, zi, active, n = state
+        zr, zi = step_fn(zr, zi)
+        active = active & (zr * zr + zi * zi < four)
+        if cycle_check:
+            active, n, _ = cycle_probe_update(zr, zi, szr, szi, active, n,
+                                              total_steps)
+            n = n + active.astype(jnp.int32)
+            return (zr, zi, active, n, szr, szi, next_snap)
+        n = n + active.astype(jnp.int32)
+        return (zr, zi, active, n)
+
+    active0 = zr0 * 0 == 0
+    init = (zr0, zi0, active0, jnp.zeros(zr0.shape, jnp.int32))
+    if cycle_check:
+        init = init + (zr0, zi0, jnp.asarray(2, jnp.int32))
+    state = segmented_while(
+        one_step, init, total_steps=total_steps, segment=segment,
+        active_of=lambda s: s[2],
+        seg_hook=brent_snap_hook if cycle_check else None)
+    return counts_from_survival(state[3], total_steps)
+
+
 def escape_counts(c_real: jax.Array, c_imag: jax.Array, *, max_iter: int,
                   segment: int = DEFAULT_SEGMENT,
                   interior_check: bool = True,

@@ -40,9 +40,8 @@ import numpy as np
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
 from distributedmandelbrot_tpu.ops.escape_time import (
-    DEFAULT_SEGMENT, brent_snap_hook, counts_from_survival,
-    cycle_probe_update, resolve_cycle_check, scale_counts_to_uint8,
-    segmented_while)
+    DEFAULT_SEGMENT, escape_loop_generic, resolve_cycle_check,
+    scale_counts_to_uint8)
 from distributedmandelbrot_tpu.utils.precision import ensure_x64
 
 
@@ -78,33 +77,11 @@ def _family_counts_jit(c_real, c_imag, *, max_iter: int, segment: int,
     total_steps = max_iter - 1
     if total_steps <= 0:
         return jnp.zeros(c_real.shape, jnp.int32)
-    four = jnp.asarray(4.0, dtype)
-
-    def one_step(state):
-        if cycle_check:
-            zr, zi, active, n, szr, szi, next_snap = state
-        else:
-            zr, zi, active, n = state
-        zr, zi = family_step(zr, zi, c_real, c_imag, power=power,
-                             burning=burning)
-        active = active & (zr * zr + zi * zi < four)
-        if cycle_check:
-            active, n, _ = cycle_probe_update(zr, zi, szr, szi, active, n,
-                                              total_steps)
-            n = n + active.astype(jnp.int32)
-            return (zr, zi, active, n, szr, szi, next_snap)
-        n = n + active.astype(jnp.int32)
-        return (zr, zi, active, n)
-
-    active0 = c_real * 0 == 0
-    init = (c_real, c_imag, active0, jnp.zeros(c_real.shape, jnp.int32))
-    if cycle_check:
-        init = init + (c_real, c_imag, jnp.asarray(2, jnp.int32))
-    state = segmented_while(
-        one_step, init, total_steps=total_steps, segment=segment,
-        active_of=lambda s: s[2],
-        seg_hook=brent_snap_hook if cycle_check else None)
-    return counts_from_survival(state[3], total_steps)
+    step = partial(family_step, c_real=c_real, c_imag=c_imag, power=power,
+                   burning=burning)
+    return escape_loop_generic(step, c_real, c_imag,
+                               total_steps=total_steps, segment=segment,
+                               cycle_check=cycle_check)
 
 
 def escape_counts_family(c_real: jax.Array, c_imag: jax.Array, *,

@@ -87,7 +87,7 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                          act_ref, n_ref, *snap_refs, max_iter: int,
                          unroll: int, block_h: int, block_w: int,
                          clamp: bool, interior_check: bool,
-                         cycle_check: bool):
+                         cycle_check: bool, julia: bool = False):
     """One (block_h, block_w) block: in-kernel grid -> escape loop -> uint8.
 
     Semantics pinned to the reference kernel
@@ -99,6 +99,11 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     budget ``mrd <= max_iter`` arrives as an SMEM scalar, so one compiled
     executable serves a mixed-budget batch (the sharded dispatch path)
     and the loop still exits at the tile's own budget.
+
+    ``julia`` mode: params carries two extra SMEM scalars ``(c_re,
+    c_im)``; z starts at the pixel grid and ``c`` is the constant.  Same
+    count semantics; the closed-form interior shortcut does not apply
+    (no closed form exists), the cycle probe does.
     """
     pl, _ = _pallas()
     i = pl.program_id(0)
@@ -112,8 +117,14 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
     col = lax.broadcasted_iota(jnp.int32, shape, 1) + j * block_w
     row = lax.broadcasted_iota(jnp.int32, shape, 0) + i * block_h
-    c_real = start_r + col.astype(dtype) * step
-    c_imag = start_i + row.astype(dtype) * step
+    g_real = start_r + col.astype(dtype) * step
+    g_imag = start_i + row.astype(dtype) * step
+    if julia:
+        c_real = jnp.full(shape, params_ref[0, 3], dtype)
+        c_imag = jnp.full(shape, params_ref[0, 4], dtype)
+    else:
+        c_real = g_real
+        c_imag = g_imag
 
     total_steps = max_iter - 1
     if total_steps <= 0:
@@ -123,18 +134,18 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
     four = jnp.asarray(4.0, dtype)
 
-    zr_ref[:] = c_real
-    zi_ref[:] = c_imag
+    zr_ref[:] = g_real  # z0: the pixel grid (Mandelbrot: equals c)
+    zi_ref[:] = g_imag
     # Interior pixels otherwise dominate iteration work on set-crossing
     # views — this shortcut is where the block-granular exit really pays.
     act0, n_sat, live0 = _interior_init(c_real, c_imag, dyn_steps, shape,
-                                        interior_check)
+                                        interior_check and not julia)
     act_ref[:] = act0
     n_ref[:] = n_sat
     if cycle_check:
         szr_ref, szi_ref = snap_refs  # allocated only in cycle mode
-        szr_ref[:] = c_real  # snapshot of z_0 (z starts at c)
-        szi_ref[:] = c_imag
+        szr_ref[:] = g_real  # snapshot of z_0
+        szi_ref[:] = g_imag
 
     # Select-free escape recurrence with a sticky active mask; see
     # ops/escape_time.py:escape_loop for why stickiness matters and how
@@ -206,15 +217,17 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
                                    "block_h", "block_w", "clamp", "interpret",
-                                   "interior_check", "cycle_check"))
+                                   "interior_check", "cycle_check", "julia"))
 def _pallas_escape(params, mrd=None, *, height: int, width: int,
                    max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, clamp: bool = False,
                    interpret: bool = False, interior_check: bool = True,
-                   cycle_check: bool | None = None):
+                   cycle_check: bool | None = None, julia: bool = False):
     """``max_iter`` is the static compile cap; ``mrd`` (defaults to the
-    cap) is this tile's traced budget — see ``_escape_block_kernel``."""
+    cap) is this tile's traced budget — see ``_escape_block_kernel``.
+    ``julia`` expects params of shape (1, 5): the grid scalars plus the
+    Julia constant."""
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
@@ -226,11 +239,13 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
     kernel = partial(_escape_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
                      block_h=block_h, block_w=block_w, clamp=clamp,
-                     interior_check=interior_check, cycle_check=cycle_check)
+                     interior_check=interior_check, cycle_check=cycle_check,
+                     julia=julia)
+    n_params = 5 if julia else 3
     return pl.pallas_call(
         kernel,
         grid=(height // block_h, width // block_w),
-        in_specs=[pl.BlockSpec((1, 3), lambda i, j: (0, 0),
+        in_specs=[pl.BlockSpec((1, n_params), lambda i, j: (0, 0),
                                memory_space=pltpu.SMEM),
                   pl.BlockSpec((1, 1), lambda i, j: (0, 0),
                                memory_space=pltpu.SMEM)],
@@ -252,7 +267,8 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                          actb_ref, n_ref, act2_ref, n2_ref, *snap_refs,
                          max_iter: int, unroll: int, block_h: int,
                          block_w: int, bailout: float, extra: int,
-                         interior_check: bool, cycle_check: bool):
+                         interior_check: bool, cycle_check: bool,
+                         julia: bool = False):
     """Smooth-coloring twin of :func:`_escape_block_kernel`: freezes the
     full value at the first radius-``bailout`` crossing while a sticky
     radius-2 count keeps in-set classification identical to the integer
@@ -260,7 +276,8 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     in VMEM scratch; the while carries scalars only (same Mosaic
     constraint, same early exit — here on the radius-``bailout`` mask,
     run ``extra`` steps past the budget so late escapees reach the
-    smoothing radius)."""
+    smoothing radius).  ``julia`` as in the integer kernel: params (1, 5),
+    z starts at the grid, constant ``c`` from SMEM."""
     pl, _ = _pallas()
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -273,8 +290,14 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
     col = lax.broadcasted_iota(jnp.int32, shape, 1) + j * block_w
     row = lax.broadcasted_iota(jnp.int32, shape, 0) + i * block_h
-    c_real = start_r + col.astype(dtype) * step
-    c_imag = start_i + row.astype(dtype) * step
+    g_real = start_r + col.astype(dtype) * step
+    g_imag = start_i + row.astype(dtype) * step
+    if julia:
+        c_real = jnp.full(shape, params_ref[0, 3], dtype)
+        c_imag = jnp.full(shape, params_ref[0, 4], dtype)
+    else:
+        c_real = g_real
+        c_imag = g_imag
 
     if max_iter <= 1:
         out_ref[:] = jnp.zeros(shape, dtype)
@@ -283,20 +306,20 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     four = jnp.asarray(4.0, dtype)
     b2 = jnp.asarray(bailout * bailout, dtype)
 
-    zr_ref[:] = c_real
-    zi_ref[:] = c_imag
+    zr_ref[:] = g_real  # z0: the pixel grid (Mandelbrot: equals c)
+    zi_ref[:] = g_imag
     # Same interior shortcut as the integer kernel (radius-2 count is the
     # one pre-saturated: it owns in-set classification, nu = 0).
     act0, n2_sat, live0 = _interior_init(c_real, c_imag, dyn_steps, shape,
-                                         interior_check)
+                                         interior_check and not julia)
     actb_ref[:] = act0
     n_ref[:] = jnp.zeros(shape, jnp.int32)
     act2_ref[:] = act0
     n2_ref[:] = n2_sat
     if cycle_check:
         szr_ref, szi_ref = snap_refs  # allocated only in cycle mode
-        szr_ref[:] = c_real
-        szi_ref[:] = c_imag
+        szr_ref[:] = g_real  # snapshot of z_0
+        szi_ref[:] = g_imag
 
     def seg_body(carry):
         it, _, next_snap = carry
@@ -368,13 +391,13 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
                                    "block_h", "block_w", "bailout",
                                    "interpret", "interior_check",
-                                   "cycle_check"))
+                                   "cycle_check", "julia"))
 def _pallas_smooth(params, mrd=None, *, height: int, width: int,
                    max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, bailout: float = 256.0,
                    interpret: bool = False, interior_check: bool = True,
-                   cycle_check: bool | None = None):
+                   cycle_check: bool | None = None, julia: bool = False):
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
@@ -385,11 +408,12 @@ def _pallas_smooth(params, mrd=None, *, height: int, width: int,
                      block_h=block_h, block_w=block_w,
                      bailout=float(bailout), extra=extra,
                      interior_check=interior_check,
-                     cycle_check=cycle_check)
+                     cycle_check=cycle_check, julia=julia)
+    n_params = 5 if julia else 3
     return pl.pallas_call(
         kernel,
         grid=(height // block_h, width // block_w),
-        in_specs=[pl.BlockSpec((1, 3), lambda i, j: (0, 0),
+        in_specs=[pl.BlockSpec((1, n_params), lambda i, j: (0, 0),
                                memory_space=pltpu.SMEM),
                   pl.BlockSpec((1, 1), lambda i, j: (0, 0),
                                memory_space=pltpu.SMEM)],
@@ -414,13 +438,16 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
                                bailout: float = 256.0,
                                interpret: bool | None = None,
                                interior_check: bool = True,
-                               cycle_check: bool | None = None) -> np.ndarray:
+                               cycle_check: bool | None = None,
+                               julia_c: complex | None = None) -> np.ndarray:
     """Smooth (band-free) tile via the Pallas kernel -> (h, w) float32 nu.
 
     The f32 TPU throughput path for smooth rendering (animations, live
-    views); the f64 quality path stays on the XLA kernel.  Same
-    ValueError contract as :func:`compute_tile_pallas_device` for
-    unsupported shapes/budgets — callers fall back to XLA.
+    views); the f64 quality path stays on the XLA kernel.  ``julia_c``
+    renders the Julia set for that constant (rides SMEM — sweeping it
+    reuses one executable).  Same ValueError contract as
+    :func:`compute_tile_pallas_device` for unsupported shapes/budgets —
+    callers fall back to XLA.
     """
     from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
     if max_iter - 1 >= INT32_SCALE_LIMIT:
@@ -430,15 +457,18 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
     if interpret is None:
         interpret = not pallas_available()
     step = spec.range_real / (spec.width - 1)
-    params = jnp.asarray([[spec.start_real, spec.start_imag, step]],
-                         jnp.float32)
+    row = [spec.start_real, spec.start_imag, step]
+    if julia_c is not None:
+        jc = complex(julia_c)
+        row += [jc.real, jc.imag]
+    params = jnp.asarray([row], jnp.float32)
     cap = bucket_cap(max_iter)
     mrd = jnp.asarray([[max_iter]], jnp.int32)
     out = _pallas_smooth(params, mrd, height=spec.height, width=spec.width,
                          max_iter=cap, unroll=unroll, block_h=block_h,
                          block_w=block_w, bailout=bailout,
                          interpret=interpret, interior_check=interior_check,
-                         cycle_check=cycle_check)
+                         cycle_check=cycle_check, julia=julia_c is not None)
     return np.asarray(out)
 
 
@@ -532,6 +562,41 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
                           block_w=block_w, clamp=clamp, interpret=interpret,
                           interior_check=interior_check,
                           cycle_check=cycle_check)
+
+
+def compute_tile_julia_pallas(spec: TileSpec, c: complex, max_iter: int, *,
+                              unroll: int = DEFAULT_UNROLL,
+                              block_h: int = DEFAULT_BLOCK_H,
+                              block_w: int | None = None,
+                              clamp: bool = False,
+                              interpret: bool | None = None,
+                              cycle_check: bool | None = None) -> np.ndarray:
+    """Julia tile via the Pallas kernel -> flat uint8 (f32 TPU fast path).
+
+    The constant rides SMEM as traced scalars, so sweeping ``c`` — a
+    Julia animation — reuses one compiled executable, matching the XLA
+    path's behavior (escape_time.escape_counts_julia).  Same ValueError
+    contract for unsupported shapes/budgets as the Mandelbrot wrapper.
+    """
+    from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
+    if max_iter - 1 >= INT32_SCALE_LIMIT:
+        raise ValueError(f"max_iter {max_iter} too deep for the pallas path")
+    c = complex(c)
+    block_h, block_w = fit_blocks(spec.height, spec.width,
+                                  block_h=block_h, block_w=block_w)
+    if interpret is None:
+        interpret = not pallas_available()
+    step = spec.range_real / (spec.width - 1)
+    params = jnp.asarray([[spec.start_real, spec.start_imag, step,
+                           c.real, c.imag]], jnp.float32)
+    cap = bucket_cap(max_iter)
+    mrd = jnp.asarray([[max_iter]], jnp.int32)
+    out = _pallas_escape(params, mrd, height=spec.height, width=spec.width,
+                         max_iter=cap, unroll=unroll, block_h=block_h,
+                         block_w=block_w, clamp=clamp, interpret=interpret,
+                         interior_check=False, cycle_check=cycle_check,
+                         julia=True)
+    return np.asarray(out).ravel()
 
 
 def compute_tile_pallas(spec: TileSpec, max_iter: int, *,

@@ -104,6 +104,52 @@ def test_pallas_smooth_interior_check_is_output_identical():
     np.testing.assert_array_equal(on, off)
 
 
+def test_pallas_julia_matches_xla_f32_path():
+    """Julia mode: z0 = grid, c from SMEM — parity vs the XLA Julia
+    kernel fed the same in-kernel coordinate convention."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_julia_pallas)
+    spec = TileSpec(-1.5, -1.5, 3.0, 3.0, width=128, height=128)
+    c = -0.8 + 0.156j
+    got = compute_tile_julia_pallas(spec, c, 100, block_h=32, interpret=True)
+    step = np.float32(spec.range_real / (spec.width - 1))
+    zr = (np.float32(spec.start_real)
+          + np.arange(spec.width, dtype=np.float32) * step)[None, :].repeat(
+              spec.height, 0)
+    zi = (np.float32(spec.start_imag)
+          + np.arange(spec.height, dtype=np.float32) * step)[:, None].repeat(
+              spec.width, 1)
+    counts = np.asarray(escape_time.escape_counts_julia(
+        zr, zi, c, max_iter=100))
+    want = np.asarray(escape_time.scale_counts_to_uint8(
+        counts, max_iter=100)).ravel()
+    mism = float((got != want).mean())
+    assert mism <= 0.02, f"julia pallas: {mism:.2%} mismatch vs XLA"
+
+
+def test_pallas_smooth_julia_matches_escape_smooth():
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_smooth_pallas)
+    import jax.numpy as jnp
+    spec = TileSpec(-1.5, -1.5, 3.0, 3.0, width=128, height=64)
+    c = -0.4 + 0.1j
+    got = compute_tile_smooth_pallas(spec, 100, block_h=32, interpret=True,
+                                     julia_c=c)
+    step = np.float32(spec.range_real / (spec.width - 1))
+    zr = (np.float32(spec.start_real)
+          + np.arange(spec.width, dtype=np.float32) * step)[None, :].repeat(
+              spec.height, 0)
+    zi = (np.float32(spec.start_imag)
+          + np.arange(spec.height, dtype=np.float32) * step)[:, None].repeat(
+              spec.width, 1)
+    want = np.asarray(escape_time.escape_smooth_julia(
+        jnp.asarray(zr), jnp.asarray(zi), c, max_iter=100))
+    inset_agree = float(((got == 0) == (want == 0)).mean())
+    assert inset_agree >= 0.995
+    both = (got != 0) & (want != 0)
+    assert float(np.abs(got[both] - want[both]).max()) <= 0.05
+
+
 def test_pallas_smooth_cycle_check_is_output_identical():
     from distributedmandelbrot_tpu.ops.pallas_escape import (
         compute_tile_smooth_pallas)
