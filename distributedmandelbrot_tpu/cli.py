@@ -119,13 +119,19 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
     from distributedmandelbrot_tpu.viewer import smooth_to_rgba, value_to_rgba
 
     if family is not None:
-        # Extended families: direct integer rendering only (no smooth /
-        # perturbation variants — command parsers reject those combos).
+        # Extended families: direct rendering only (no perturbation
+        # path — the command parsers reject sub-threshold spans).
         power, burning = family
-        from distributedmandelbrot_tpu.ops import compute_tile_family
         cx, cy = float(c_re), float(c_im)
         spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
                         width=definition, height=definition)
+        if smooth:
+            from distributedmandelbrot_tpu.ops.families import (
+                compute_tile_smooth_family)
+            nu = compute_tile_smooth_family(spec, max_iter, power=power,
+                                            burning=burning, dtype=np_dtype)
+            return smooth_to_rgba(nu, max_iter, colormap=colormap)
+        from distributedmandelbrot_tpu.ops import compute_tile_family
         values = compute_tile_family(spec, max_iter, power=power,
                                      burning=burning, dtype=np_dtype)
         return value_to_rgba(values.reshape(spec.height, spec.width),
@@ -490,9 +496,9 @@ def cmd_render(argv: Sequence[str]) -> int:
 
     family = None
     if args.fractal in ("multibrot", "ship"):
-        if args.smooth or args.deep:
-            raise SystemExit(f"--fractal {args.fractal} supports direct "
-                             "integer rendering only (no --smooth/--deep)")
+        if args.deep:
+            raise SystemExit(f"--fractal {args.fractal} has no perturbation "
+                             "path (no --deep)")
         if args.span < DEEP_SPAN_THRESHOLD:
             raise SystemExit(f"--fractal {args.fractal} has no perturbation "
                              f"path; spans below {DEEP_SPAN_THRESHOLD} alias "

@@ -11,8 +11,9 @@ from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
                                                        escape_smooth,
                                                        escape_smooth_julia,
                                                        scale_counts_to_uint8)
-from distributedmandelbrot_tpu.ops.families import (compute_tile_family,
-                                                    escape_counts_family)
+from distributedmandelbrot_tpu.ops.families import (
+    compute_tile_family, compute_tile_smooth_family, escape_counts_family,
+    escape_smooth_family)
 from distributedmandelbrot_tpu.ops.perturbation import (DeepTileSpec,
                                                         compute_counts_perturb,
                                                         compute_smooth_perturb,
@@ -22,5 +23,6 @@ __all__ = ["reference", "DEFAULT_SEGMENT", "compute_tile",
            "compute_tile_julia", "compute_tile_smooth", "escape_counts",
            "escape_counts_julia", "escape_smooth", "escape_smooth_julia",
            "scale_counts_to_uint8", "compute_tile_family",
-           "escape_counts_family", "DeepTileSpec", "compute_counts_perturb",
+           "compute_tile_smooth_family", "escape_counts_family",
+           "escape_smooth_family", "DeepTileSpec", "compute_counts_perturb",
            "compute_smooth_perturb", "compute_tile_perturb"]

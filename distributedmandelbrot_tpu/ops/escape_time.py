@@ -287,6 +287,30 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
     return counts_from_survival(state[5], total_steps)
 
 
+def family_step(zr, zi, c_real, c_imag, *, power: int, burning: bool):
+    """One update of the generalized recurrence ``z <- z^power + c``
+    (Multibrot), optionally through the Burning Ship's ``|Re z| +
+    i|Im z|`` fold first.  The numpy golden
+    (reference.escape_counts_family) mirrors the general formula and
+    operation order exactly, so parity differences are FMA-contraction-
+    only, as for the core kernels.
+
+    Degree-2 non-burning takes the specialized form — ``(zr+zr)*zi`` is
+    one op cheaper than ``zr*zi + zi*zr`` and IEEE-identical (both are
+    exact doublings) — so this step also serves the plain Mandelbrot
+    recurrence at zero cost (the smooth kernel uses it that way).
+    """
+    if burning:
+        zr = jnp.abs(zr)
+        zi = jnp.abs(zi)
+    if power == 2:
+        return zr * zr - zi * zi + c_real, (zr + zr) * zi + c_imag
+    wr, wi = zr, zi
+    for _ in range(power - 1):
+        wr, wi = wr * zr - wi * zi, wr * zi + wi * zr
+    return wr + c_real, wi + c_imag
+
+
 def escape_loop_generic(step_fn, zr0, zi0, *, total_steps: int, segment: int,
                         cycle_check: bool = False):
     """Segmented select-free escape loop for an arbitrary one-step map
@@ -529,12 +553,14 @@ def escape_smooth_julia(z_real: jax.Array, z_imag: jax.Array, c: complex, *,
 
 
 @partial(jax.jit, static_argnames=("max_iter", "segment", "bailout",
-                                   "interior_check", "cycle_check"))
+                                   "interior_check", "cycle_check", "power",
+                                   "burning"))
 def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
                        c_real: jax.Array, c_imag: jax.Array, *,
                        max_iter: int, segment: int, bailout: float,
                        interior_check: bool = False,
-                       cycle_check: bool = False) -> jax.Array:
+                       cycle_check: bool = False, power: int = 2,
+                       burning: bool = False) -> jax.Array:
     dtype = jnp.result_type(zr0)
     zr0 = zr0.astype(dtype)
     zi0 = zi0.astype(dtype)
@@ -551,8 +577,10 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
             zr, zi, active, n, bounded2, n2, szr, szi, next_snap = state
         else:
             zr, zi, active, n, bounded2, n2 = state
-        nzi = (zr + zr) * zi + c_imag
-        nzr = zr * zr - zi * zi + c_real
+        # family_step's power-2 path IS the plain recurrence (exact same
+        # op mix); other degrees/burning serve the extended families.
+        nzr, nzi = family_step(zr, zi, c_real, c_imag, power=power,
+                               burning=burning)
         zr = jnp.where(active, nzr, zr)
         zi = jnp.where(active, nzi, zi)
         m2 = zr * zr + zi * zi
@@ -611,7 +639,12 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
     # they get log_ratio 1 -> nu = n + 2.
     mag2 = jnp.maximum(zr * zr + zi * zi, b2)
     log_ratio = jnp.log(mag2) / jnp.asarray(2.0 * np.log(bailout), dtype)
-    nu = (n + 2).astype(dtype) - jnp.log2(log_ratio)
+    corr = jnp.log2(log_ratio)
+    if power != 2:
+        # Degree-d renormalization: |z| grows like |z|^d per step, so the
+        # fractional correction is log_d of the log-ratio.
+        corr = corr / jnp.asarray(np.log2(power), dtype)
+    nu = (n + 2).astype(dtype) - corr
     # In-set iff the radius-2 count exhausted the reference budget (n2
     # counts only iterations 1..total_steps thanks to the sticky mask and
     # the fact that an overrun past total_steps implies n2 already

@@ -40,23 +40,12 @@ import numpy as np
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
 from distributedmandelbrot_tpu.ops.escape_time import (
-    DEFAULT_SEGMENT, escape_loop_generic, resolve_cycle_check,
-    scale_counts_to_uint8)
+    DEFAULT_SEGMENT, _escape_smooth_jit, escape_loop_generic, family_step,
+    resolve_cycle_check, scale_counts_to_uint8)
 from distributedmandelbrot_tpu.utils.precision import ensure_x64
 
-
-def family_step(zr, zi, c_real, c_imag, *, power: int, burning: bool):
-    """One update of the family recurrence.  The numpy golden
-    (reference.escape_counts_family) mirrors this formula and operation
-    order exactly, so parity differences are FMA-contraction-only, as for
-    the core kernels."""
-    if burning:
-        zr = jnp.abs(zr)
-        zi = jnp.abs(zi)
-    wr, wi = zr, zi
-    for _ in range(power - 1):
-        wr, wi = wr * zr - wi * zi, wr * zi + wi * zr
-    return wr + c_real, wi + c_imag
+__all__ = ["family_step", "escape_counts_family", "escape_smooth_family",
+           "compute_tile_family", "compute_tile_smooth_family"]
 
 
 def _check_family(power: int, burning: bool) -> None:
@@ -98,6 +87,45 @@ def escape_counts_family(c_real: jax.Array, c_imag: jax.Array, *,
                               segment=segment, power=power, burning=burning,
                               cycle_check=resolve_cycle_check(cycle_check,
                                                               max_iter))
+
+
+def escape_smooth_family(c_real: jax.Array, c_imag: jax.Array, *,
+                         max_iter: int, power: int = 2,
+                         burning: bool = False,
+                         segment: int = DEFAULT_SEGMENT,
+                         bailout: float = 256.0,
+                         cycle_check: bool | None = None) -> jax.Array:
+    """Smooth (band-free) values for the extended families: the shared
+    smooth kernel (escape_time._escape_smooth_jit) with the family's
+    recurrence and degree-``power`` renormalization; 0 = in-set.  The
+    closed-form interior shortcut does not apply; the cycle probe does."""
+    _check_family(power, burning)
+    dt = getattr(c_real, "dtype", None)
+    if dt is not None and np.dtype(dt) == np.float64:
+        ensure_x64()
+    return _escape_smooth_jit(c_real, c_imag, c_real, c_imag,
+                              max_iter=max_iter, segment=segment,
+                              bailout=float(bailout), interior_check=False,
+                              cycle_check=resolve_cycle_check(cycle_check,
+                                                              max_iter),
+                              power=power, burning=burning)
+
+
+def compute_tile_smooth_family(spec: TileSpec, max_iter: int, *,
+                               power: int = 2, burning: bool = False,
+                               dtype: np.dtype = np.float64,
+                               segment: int = DEFAULT_SEGMENT,
+                               bailout: float = 256.0) -> np.ndarray:
+    """One smooth Multibrot/Burning-Ship tile -> 2-D float array."""
+    if np.dtype(dtype) == np.float64:
+        ensure_x64()
+    g_real, g_imag = spec.grid_2d()
+    nu = escape_smooth_family(jnp.asarray(g_real, dtype=dtype),
+                              jnp.asarray(g_imag, dtype=dtype),
+                              max_iter=max_iter, power=power,
+                              burning=burning, segment=segment,
+                              bailout=bailout)
+    return np.asarray(nu)
 
 
 def compute_tile_family(spec: TileSpec, max_iter: int, *, power: int = 2,

@@ -109,7 +109,7 @@ def test_render_family_rejects_unsupported_combos(tmp_path):
     from distributedmandelbrot_tpu import cli
     out = str(tmp_path / "x.png")
     for argv in (
-        ["render", "--fractal", "ship", "--smooth", "--out", out],
+        ["render", "--fractal", "ship", "--deep", "--out", out],
         # no perturbation path: sub-threshold spans would alias float64
         ["render", "--fractal", "ship", "--span", "1e-14", "--out", out],
         ["render", "--fractal", "multibrot", "--power", "1", "--out", out],
@@ -118,3 +118,41 @@ def test_render_family_rejects_unsupported_combos(tmp_path):
     ):
         with pytest.raises(SystemExit):
             cli.main(argv)
+
+
+def test_family_smooth_classification_and_bands():
+    """Smooth family values: in-set classification tracks the integer
+    kernel, and escaped values are band-free (fractional parts present)
+    with the degree-d renormalization keeping nu near the integer count."""
+    from distributedmandelbrot_tpu.ops import escape_smooth_family
+    import jax.numpy as jnp
+    for power, burning, spec in [(3, False, MULTIBROT_VIEW),
+                                 (2, True, SHIP_VIEW)]:
+        cr, ci = spec.grid_2d()
+        nu = np.asarray(escape_smooth_family(
+            jnp.asarray(cr, jnp.float32), jnp.asarray(ci, jnp.float32),
+            max_iter=300, power=power, burning=burning))
+        counts = np.asarray(escape_counts_family(
+            jnp.asarray(cr, jnp.float32), jnp.asarray(ci, jnp.float32),
+            max_iter=300, power=power, burning=burning))
+        agree = ((nu == 0) == (counts == 0)).mean()
+        assert agree >= 0.995, f"in-set classification diverges: {agree}"
+        esc = (nu > 0) & (counts > 0)
+        # nu tracks the integer count within a small offset (the radius-2
+        # -> bailout tail is degree-dependent, so the offset grows with
+        # d; what matters is that it stays bounded)...
+        assert np.abs(nu[esc] - counts[esc]).max() < 8.0
+        # ...and is genuinely continuous (not integer-quantized).
+        frac = nu[esc] % 1.0
+        assert ((frac > 0.05) & (frac < 0.95)).mean() > 0.5
+
+
+def test_render_family_smooth(tmp_path):
+    from distributedmandelbrot_tpu import cli
+    out = str(tmp_path / "ship_smooth.png")
+    rc = cli.main(["render", "--fractal", "ship", "--smooth",
+                   "--center", "-0.5,-0.5", "--definition", "64",
+                   "--max-iter", "100", "--span", "3", "--out", out])
+    assert rc == 0
+    import os
+    assert os.path.getsize(out) > 0
