@@ -209,6 +209,26 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
                          colormap=colormap)
 
 
+def _resolve_family(fractal: str, power: int | None
+                    ) -> tuple[int, bool] | None:
+    """(power, burning) for the extended families, None for the core
+    fractals — with --power placement validation (shared by render and
+    animate so their behavior can never diverge)."""
+    if fractal == "ship":
+        if power is not None:
+            raise SystemExit("--power applies to multibrot only "
+                             "(the burning ship is degree 2)")
+        return (2, True)
+    if fractal == "multibrot":
+        p = 3 if power is None else power
+        if p < 2:
+            raise SystemExit("--power must be >= 2")
+        return (p, False)
+    if power is not None:
+        raise SystemExit("--power applies to --fractal multibrot only")
+    return None
+
+
 def _save_png(path: str, rgba) -> None:
     import matplotlib
     matplotlib.use("Agg")
@@ -494,8 +514,8 @@ def cmd_render(argv: Sequence[str]) -> int:
     args = parser.parse_args(_join_negative_values(argv, ("--c", "--center")))
     _configure_logging(args)
 
-    family = None
-    if args.fractal in ("multibrot", "ship"):
+    family = _resolve_family(args.fractal, args.power)
+    if family is not None:
         if args.deep:
             raise SystemExit(f"--fractal {args.fractal} has no perturbation "
                              "path (no --deep)")
@@ -503,18 +523,6 @@ def cmd_render(argv: Sequence[str]) -> int:
             raise SystemExit(f"--fractal {args.fractal} has no perturbation "
                              f"path; spans below {DEEP_SPAN_THRESHOLD} alias "
                              "float64 pixel coordinates")
-        if args.fractal == "ship":
-            if args.power is not None:
-                raise SystemExit("--power applies to multibrot only "
-                                 "(the burning ship is degree 2)")
-            family = (2, True)
-        else:
-            power = 3 if args.power is None else args.power
-            if power < 2:
-                raise SystemExit("--power must be >= 2")
-            family = (power, False)
-    elif args.power is not None:
-        raise SystemExit("--power applies to --fractal multibrot only")
     default_center = "0,0" if args.fractal == "julia" else "-0.5,0.0"
     center_str = args.center or default_center
     c_re, c_im = (s.strip() for s in center_str.split(","))
@@ -544,8 +552,12 @@ def cmd_animate(argv: Sequence[str]) -> int:
                         help="zoom target as RE,IM (decimal strings — "
                              "precision beyond float64 is honored on "
                              "deep frames)")
-    parser.add_argument("--fractal", choices=["mandelbrot", "julia"],
+    parser.add_argument("--fractal",
+                        choices=["mandelbrot", "julia", "multibrot", "ship"],
                         default="mandelbrot")
+    parser.add_argument("--power", type=int, default=None,
+                        help="multibrot degree d in z^d + c (>= 2; "
+                             "default 3; multibrot only)")
     parser.add_argument("--c", default="-0.8,0.156",
                         help="Julia constant as RE,IM")
     parser.add_argument("--span-start", type=float, default=4.0)
@@ -572,6 +584,14 @@ def cmd_animate(argv: Sequence[str]) -> int:
     import os
     import time
 
+    family = _resolve_family(args.fractal, args.power)
+    if family is not None and min(args.span_start,
+                                  args.span_end) < DEEP_SPAN_THRESHOLD:
+        # min of both ends: a zoom-OUT run starts at the small span.
+        raise SystemExit(f"--fractal {args.fractal} has no perturbation "
+                         f"path; spans below {DEEP_SPAN_THRESHOLD} "
+                         "would alias float64 pixel coordinates")
+
     os.makedirs(args.out_dir, exist_ok=True)
     c_re, c_im = (s.strip() for s in args.center.split(","))
     julia_c = tuple(s.strip() for s in args.c.split(",")) \
@@ -589,7 +609,7 @@ def cmd_animate(argv: Sequence[str]) -> int:
         rgba = _render_view(c_re, c_im, span, args.definition,
                             args.max_iter, smooth=args.smooth,
                             np_dtype=np_dtype, colormap=args.colormap,
-                            deep=deep, julia_c=julia_c)
+                            deep=deep, julia_c=julia_c, family=family)
         path = os.path.join(args.out_dir, f"frame_{f:04d}.png")
         _save_png(path, rgba)
         print(f"frame {f + 1}/{args.frames} span {span:.3g}"

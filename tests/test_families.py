@@ -147,6 +147,45 @@ def test_family_smooth_classification_and_bands():
         assert ((frac > 0.05) & (frac < 0.95)).mean() > 0.5
 
 
+def test_animate_family_frames(tmp_path):
+    from distributedmandelbrot_tpu import cli
+    out_dir = str(tmp_path / "frames")
+    rc = cli.main(["animate", "--fractal", "ship", "--center", "-1.75,-0.03",
+                   "--span-start", "1.0", "--span-end", "0.5",
+                   "--frames", "2", "--definition", "32",
+                   "--max-iter", "40", "--out-dir", out_dir])
+    assert rc == 0
+    import os
+    assert sorted(os.listdir(out_dir)) == ["frame_0000.png",
+                                           "frame_0001.png"]
+    with pytest.raises(SystemExit):  # no perturbation path for families
+        cli.main(["animate", "--fractal", "ship", "--center", "-1.75,-0.03",
+                  "--span-end", "1e-14", "--out-dir", out_dir])
+    with pytest.raises(SystemExit):  # zoom-OUT starting sub-threshold
+        cli.main(["animate", "--fractal", "ship", "--center", "-1.75,-0.03",
+                  "--span-start", "1e-14", "--span-end", "1.0",
+                  "--out-dir", out_dir])
+
+
+def test_family_smooth_high_power_f32_no_overflow():
+    """power >= 8 freezes lanes at |z|^2 beyond float32 max; the mag2
+    clamp must keep escaped pixels finite and escaped (nu > 0)."""
+    from distributedmandelbrot_tpu.ops import escape_smooth_family
+    import jax.numpy as jnp
+    spec = TileSpec(-1.1, -1.1, 2.2, 2.2, width=64, height=64)
+    cr, ci = spec.grid_2d()
+    nu = np.asarray(escape_smooth_family(
+        jnp.asarray(cr, jnp.float32), jnp.asarray(ci, jnp.float32),
+        max_iter=100, power=9))
+    counts = np.asarray(escape_counts_family(
+        jnp.asarray(cr, jnp.float32), jnp.asarray(ci, jnp.float32),
+        max_iter=100, power=9))
+    assert np.isfinite(nu).all()
+    esc = counts > 0
+    assert esc.any()
+    assert (nu[esc] > 0).all(), "escaped pixels must not classify in-set"
+
+
 def test_render_family_smooth(tmp_path):
     from distributedmandelbrot_tpu import cli
     out = str(tmp_path / "ship_smooth.png")
