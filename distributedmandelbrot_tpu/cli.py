@@ -131,9 +131,22 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
             nu = compute_tile_smooth_family(spec, max_iter, power=power,
                                             burning=burning, dtype=np_dtype)
             return smooth_to_rgba(nu, max_iter, colormap=colormap)
-        from distributedmandelbrot_tpu.ops import compute_tile_family
-        values = compute_tile_family(spec, max_iter, power=power,
-                                     burning=burning, dtype=np_dtype)
+        values = None
+        if np_dtype == np.float32:
+            # Pallas-first on TPU, same policy as the core fractals; only
+            # the kernel call sits in the try so rendering errors surface.
+            try:
+                from distributedmandelbrot_tpu.ops.pallas_escape import (
+                    compute_tile_family_pallas, pallas_available)
+                if pallas_available():
+                    values = compute_tile_family_pallas(
+                        spec, max_iter, power=power, burning=burning)
+            except ValueError:
+                values = None  # shape/budget outside the kernel -> XLA
+        if values is None:
+            from distributedmandelbrot_tpu.ops import compute_tile_family
+            values = compute_tile_family(spec, max_iter, power=power,
+                                         burning=burning, dtype=np_dtype)
         return value_to_rgba(values.reshape(spec.height, spec.width),
                              colormap=colormap)
 

@@ -637,14 +637,17 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
     # negative.  The clamp guards lanes that never reached the smoothing
     # radius within the extra budget (hovering just outside radius 2):
     # they get log_ratio 1 -> nu = n + 2.
-    # Clipped on BOTH sides: the lower bound is the laggard clamp (see
+    # Sanitized on BOTH sides: the lower bound is the laggard clamp (see
     # below); the upper bound keeps high multibrot degrees finite in f32
     # — a lane freezes one step past bailout, where |z|^2 ~ bailout^(2d)
-    # overflows float32 to inf for d >= 8, and an inf here would turn
-    # the escaped pixel's nu into -inf (rendered as in-set).  Clamping
-    # to the dtype max costs a bounded correction error on exactly those
-    # saturated lanes.
-    mag2 = jnp.clip(zr * zr + zi * zi, b2, jnp.finfo(dtype).max)
+    # overflows float32 to inf for d >= 8 (and the step's inf - inf
+    # leaves NaN components in the frozen z for d >= 17), either of
+    # which would corrupt nu (to -inf/NaN, rendered as in-set).  Pinning
+    # both to the dtype max costs a bounded correction error on exactly
+    # those saturated lanes.
+    big = float(jnp.finfo(dtype).max)
+    mag2 = jnp.clip(jnp.nan_to_num(zr * zr + zi * zi, nan=big, posinf=big),
+                    b2, big)
     log_ratio = jnp.log(mag2) / jnp.asarray(2.0 * np.log(bailout), dtype)
     corr = jnp.log2(log_ratio)
     if power != 2:

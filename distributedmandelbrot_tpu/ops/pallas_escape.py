@@ -41,7 +41,8 @@ import numpy as np
 from jax import lax
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
-from distributedmandelbrot_tpu.ops.escape_time import (mandelbrot_interior,
+from distributedmandelbrot_tpu.ops.escape_time import (family_step,
+                                                       mandelbrot_interior,
                                                        resolve_cycle_check)
 
 def _pallas():
@@ -87,7 +88,8 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                          act_ref, n_ref, *snap_refs, max_iter: int,
                          unroll: int, block_h: int, block_w: int,
                          clamp: bool, interior_check: bool,
-                         cycle_check: bool, julia: bool = False):
+                         cycle_check: bool, julia: bool = False,
+                         power: int = 2, burning: bool = False):
     """One (block_h, block_w) block: in-kernel grid -> escape loop -> uint8.
 
     Semantics pinned to the reference kernel
@@ -172,8 +174,16 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
         zr2 = zr * zr
         zi2 = zi * zi
         for _ in range(unroll):
-            zi = (zr + zr) * zi + c_imag
-            zr = zr2 - zi2 + c_real
+            if power == 2:
+                # Cached-squares form.  The Burning Ship fold reduces to
+                # ONE extra abs here: squares are abs-invariant, so the
+                # zr update is unchanged and 2|zr||zi| = |2 zr zi|.
+                cross = (zr + zr) * zi
+                zi = (jnp.abs(cross) if burning else cross) + c_imag
+                zr = zr2 - zi2 + c_real
+            else:
+                zr, zi = family_step(zr, zi, c_real, c_imag, power=power,
+                                     burning=burning)
             zr2 = zr * zr
             zi2 = zi * zi
             act = act & (zr2 + zi2 < four).astype(jnp.int32)
@@ -217,17 +227,21 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
 
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
                                    "block_h", "block_w", "clamp", "interpret",
-                                   "interior_check", "cycle_check", "julia"))
+                                   "interior_check", "cycle_check", "julia",
+                                   "power", "burning"))
 def _pallas_escape(params, mrd=None, *, height: int, width: int,
                    max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, clamp: bool = False,
                    interpret: bool = False, interior_check: bool = True,
-                   cycle_check: bool | None = None, julia: bool = False):
+                   cycle_check: bool | None = None, julia: bool = False,
+                   power: int = 2, burning: bool = False):
     """``max_iter`` is the static compile cap; ``mrd`` (defaults to the
     cap) is this tile's traced budget — see ``_escape_block_kernel``.
     ``julia`` expects params of shape (1, 5): the grid scalars plus the
-    Julia constant."""
+    Julia constant.  ``power``/``burning`` select the extended families
+    (the closed-form interior shortcut only applies to the plain
+    Mandelbrot recurrence and is forced off otherwise)."""
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
@@ -236,11 +250,12 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
     # forms miss (higher-period bulbs, minibrots), whose eventual exact-
     # f32 limit cycles the probe retires (ops.escape_time.escape_loop).
     cycle_check = resolve_cycle_check(cycle_check, max_iter)
+    interior_check = interior_check and power == 2 and not burning
     kernel = partial(_escape_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
                      block_h=block_h, block_w=block_w, clamp=clamp,
                      interior_check=interior_check, cycle_check=cycle_check,
-                     julia=julia)
+                     julia=julia, power=power, burning=burning)
     n_params = 5 if julia else 3
     return pl.pallas_call(
         kernel,
@@ -539,11 +554,23 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
                                clamp: bool = False,
                                interpret: bool | None = None,
                                interior_check: bool = True,
-                               cycle_check: bool | None = None) -> jax.Array:
+                               cycle_check: bool | None = None,
+                               power: int = 2, burning: bool = False,
+                               julia_c: complex | None = None) -> jax.Array:
     """Dispatch one tile's kernel; returns the (height, width) uint8 tile
     still on device.  Callers that pipeline (dispatch batch, then
-    materialize) overlap compute with device->host transfers."""
+    materialize) overlap compute with device->host transfers.
+
+    The single dispatch body for every integer-kernel variant —
+    Mandelbrot, Julia (``julia_c``), Multibrot/Burning Ship
+    (``power``/``burning``) — so the budget guard, block sizing, and
+    params layout exist exactly once.
+    """
     from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
+    from distributedmandelbrot_tpu.ops.families import _check_family
+    _check_family(power, burning)
+    if julia_c is not None and (power != 2 or burning):
+        raise ValueError("julia mode supports the degree-2 recurrence only")
     if max_iter - 1 >= INT32_SCALE_LIMIT:
         # In-kernel scaling is int32; such budgets need the XLA path
         # (callers catch ValueError and fall back).
@@ -553,15 +580,45 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
     if interpret is None:
         interpret = not pallas_available()
     step = spec.range_real / (spec.width - 1)
-    params = jnp.asarray([[spec.start_real, spec.start_imag, step]],
-                         jnp.float32)
+    row = [spec.start_real, spec.start_imag, step]
+    if julia_c is not None:
+        jc = complex(julia_c)
+        row += [jc.real, jc.imag]
+    params = jnp.asarray([row], jnp.float32)
     cap = bucket_cap(max_iter)
     mrd = jnp.asarray([[max_iter]], jnp.int32)
     return _pallas_escape(params, mrd, height=spec.height, width=spec.width,
                           max_iter=cap, unroll=unroll, block_h=block_h,
                           block_w=block_w, clamp=clamp, interpret=interpret,
-                          interior_check=interior_check,
-                          cycle_check=cycle_check)
+                          interior_check=interior_check
+                          and julia_c is None,
+                          cycle_check=cycle_check,
+                          julia=julia_c is not None, power=power,
+                          burning=burning)
+
+
+def compute_tile_family_pallas(spec: TileSpec, max_iter: int, *,
+                               power: int = 2, burning: bool = False,
+                               unroll: int = DEFAULT_UNROLL,
+                               block_h: int = DEFAULT_BLOCK_H,
+                               block_w: int | None = None,
+                               clamp: bool = False,
+                               interpret: bool | None = None,
+                               cycle_check: bool | None = None) -> np.ndarray:
+    """Multibrot / Burning-Ship tile via the Pallas kernel -> flat uint8.
+
+    Same block-granular early exit and cycle probe as the Mandelbrot
+    kernel; the degree-2 ship costs one extra abs per step (squares are
+    abs-invariant, so the cached-squares form survives the fold).  Same
+    ValueError contract as the XLA family path (parameter validation
+    included) for unsupported shapes/budgets/degrees.
+    """
+    out = compute_tile_pallas_device(spec, max_iter, unroll=unroll,
+                                     block_h=block_h, block_w=block_w,
+                                     clamp=clamp, interpret=interpret,
+                                     cycle_check=cycle_check, power=power,
+                                     burning=burning)
+    return np.asarray(out).ravel()
 
 
 def compute_tile_julia_pallas(spec: TileSpec, c: complex, max_iter: int, *,
@@ -578,24 +635,10 @@ def compute_tile_julia_pallas(spec: TileSpec, c: complex, max_iter: int, *,
     path's behavior (escape_time.escape_counts_julia).  Same ValueError
     contract for unsupported shapes/budgets as the Mandelbrot wrapper.
     """
-    from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
-    if max_iter - 1 >= INT32_SCALE_LIMIT:
-        raise ValueError(f"max_iter {max_iter} too deep for the pallas path")
-    c = complex(c)
-    block_h, block_w = fit_blocks(spec.height, spec.width,
-                                  block_h=block_h, block_w=block_w)
-    if interpret is None:
-        interpret = not pallas_available()
-    step = spec.range_real / (spec.width - 1)
-    params = jnp.asarray([[spec.start_real, spec.start_imag, step,
-                           c.real, c.imag]], jnp.float32)
-    cap = bucket_cap(max_iter)
-    mrd = jnp.asarray([[max_iter]], jnp.int32)
-    out = _pallas_escape(params, mrd, height=spec.height, width=spec.width,
-                         max_iter=cap, unroll=unroll, block_h=block_h,
-                         block_w=block_w, clamp=clamp, interpret=interpret,
-                         interior_check=False, cycle_check=cycle_check,
-                         julia=True)
+    out = compute_tile_pallas_device(spec, max_iter, unroll=unroll,
+                                     block_h=block_h, block_w=block_w,
+                                     clamp=clamp, interpret=interpret,
+                                     cycle_check=cycle_check, julia_c=c)
     return np.asarray(out).ravel()
 
 

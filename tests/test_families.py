@@ -167,19 +167,21 @@ def test_animate_family_frames(tmp_path):
                   "--out-dir", out_dir])
 
 
-def test_family_smooth_high_power_f32_no_overflow():
-    """power >= 8 freezes lanes at |z|^2 beyond float32 max; the mag2
-    clamp must keep escaped pixels finite and escaped (nu > 0)."""
+@pytest.mark.parametrize("power", [9, 17])
+def test_family_smooth_high_power_f32_no_overflow(power):
+    """power >= 8 freezes lanes at |z|^2 beyond float32 max (and >= 17
+    leaves NaN components via inf - inf in the frozen z); the mag2
+    sanitization must keep escaped pixels finite and escaped (nu > 0)."""
     from distributedmandelbrot_tpu.ops import escape_smooth_family
     import jax.numpy as jnp
     spec = TileSpec(-1.1, -1.1, 2.2, 2.2, width=64, height=64)
     cr, ci = spec.grid_2d()
     nu = np.asarray(escape_smooth_family(
         jnp.asarray(cr, jnp.float32), jnp.asarray(ci, jnp.float32),
-        max_iter=100, power=9))
+        max_iter=100, power=power))
     counts = np.asarray(escape_counts_family(
         jnp.asarray(cr, jnp.float32), jnp.asarray(ci, jnp.float32),
-        max_iter=100, power=9))
+        max_iter=100, power=power))
     assert np.isfinite(nu).all()
     esc = counts > 0
     assert esc.any()

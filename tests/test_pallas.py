@@ -150,6 +150,56 @@ def test_pallas_smooth_julia_matches_escape_smooth():
     assert float(np.abs(got[both] - want[both]).max()) <= 0.05
 
 
+def test_pallas_family_matches_xla_path():
+    """Multibrot-3 and Burning Ship through the block kernel vs the XLA
+    family kernel on the kernel's own coordinate convention."""
+    from distributedmandelbrot_tpu.ops.families import escape_counts_family
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_family_pallas)
+
+    # Ship band is wider: its |.| folds amplify FMA-contraction
+    # differences between the two compiled graphs into outright
+    # trajectory divergence (see ops/families.py parity note).
+    for power, burning, tol, spec in [
+        (3, False, 0.03, TileSpec(-1.2, -1.2, 2.4, 2.4, width=128,
+                                  height=64)),
+        (2, True, 0.08, TileSpec(-2.2, -1.2, 2.4, 2.4, width=128,
+                                 height=64)),
+    ]:
+        got = compute_tile_family_pallas(spec, 100, power=power,
+                                         burning=burning, block_h=32,
+                                         interpret=True)
+        step = np.float32(spec.range_real / (spec.width - 1))
+        cr = (np.float32(spec.start_real)
+              + np.arange(spec.width, dtype=np.float32) * step)[None, :] \
+            .repeat(spec.height, 0)
+        ci = (np.float32(spec.start_imag)
+              + np.arange(spec.height, dtype=np.float32) * step)[:, None] \
+            .repeat(spec.width, 1)
+        counts = np.asarray(escape_counts_family(
+            cr, ci, max_iter=100, power=power, burning=burning))
+        want = np.asarray(escape_time.scale_counts_to_uint8(
+            counts, max_iter=100)).ravel()
+        mism = float((got != want).mean())
+        assert mism <= tol, (
+            f"family pallas (d={power}, ship={burning}): "
+            f"{mism:.2%} mismatch vs XLA")
+
+
+def test_pallas_family_validation_matches_xla_contract():
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_family_pallas, compute_tile_pallas_device)
+    spec = TileSpec(-1.2, -1.2, 2.4, 2.4, width=128, height=64)
+    with pytest.raises(ValueError, match="degree"):
+        compute_tile_family_pallas(spec, 50, power=1, interpret=True)
+    with pytest.raises(ValueError, match="degree 2"):
+        compute_tile_family_pallas(spec, 50, power=3, burning=True,
+                                   interpret=True)
+    with pytest.raises(ValueError, match="degree-2"):
+        compute_tile_pallas_device(spec, 50, power=3, julia_c=0.1 + 0.1j,
+                                   interpret=True)
+
+
 def test_pallas_smooth_cycle_check_is_output_identical():
     from distributedmandelbrot_tpu.ops.pallas_escape import (
         compute_tile_smooth_pallas)
