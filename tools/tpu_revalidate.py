@@ -91,6 +91,33 @@ def main() -> int:
     print(f"smooth in-set mask agreement: {agree:.4%}")
     assert agree >= 0.999
 
+    step("3c. shortcut output-identity on hardware (interior + cycle)")
+    on = compute_tile_pallas(spec, 1000)
+    off = compute_tile_pallas(spec, 1000, interior_check=False,
+                              cycle_check=False)
+    ident = bool((on == off).all())
+    print("interior/cycle shortcuts output-identical:", ident)
+    assert ident
+    deep_spec = TileSpec(-0.2, 0.7, 0.15, 0.15, width=256, height=256)
+    on = compute_tile_pallas(deep_spec, 5000)  # cap 8192 -> cycle probe on
+    off = compute_tile_pallas(deep_spec, 5000, cycle_check=False)
+    ident = bool((on == off).all())
+    print("cycle probe at depth 5000 output-identical:", ident)
+    assert ident
+
+    step("3d. julia + family kernels on hardware")
+    from distributedmandelbrot_tpu.ops.families import escape_counts_family
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_family_pallas, compute_tile_julia_pallas)
+    jspec = TileSpec(-1.5, -1.5, 3.0, 3.0, width=256, height=256)
+    got = compute_tile_julia_pallas(jspec, -0.8 + 0.156j, 500)
+    print("julia pallas levels:", len(np.unique(got)))
+    assert len(np.unique(got)) > 10
+    sspec = TileSpec(-2.2, -1.2, 2.4, 2.4, width=256, height=256)
+    got = compute_tile_family_pallas(sspec, 500, burning=True)
+    print("burning-ship pallas levels:", len(np.unique(got)))
+    assert len(np.unique(got)) > 10
+
     step("4. sharded pallas batch (mixed budgets)")
     from distributedmandelbrot_tpu.parallel import (
         batched_escape_pixels, batched_escape_pixels_pallas, tile_mesh)
