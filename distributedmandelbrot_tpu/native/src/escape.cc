@@ -18,7 +18,24 @@
 
 namespace {
 
+// Closed-form interior test (main cardioid + period-2 bulb), strict by a
+// margin far above the expression's f64 rounding error, so a true result
+// PROVES the exact orbit never escapes — returning 0 without iterating is
+// output-identical to the full loop (mirrors
+// ops/escape_time.py:mandelbrot_interior; see there for the margin math).
+// This is where set-crossing tiles spend ~90%+ of their iteration budget.
+inline bool provably_interior(double cr, double ci) {
+    const double margin = 1e-12;
+    const double y2 = ci * ci;
+    const double xm = cr - 0.25;
+    const double q = xm * xm + y2;
+    if (q * (q + xm) < 0.25 * y2 - margin) return true;  // main cardioid
+    const double xp = cr + 1.0;
+    return xp * xp + y2 < 0.0625 - margin;  // period-2 bulb
+}
+
 inline std::int32_t escape_iter(double cr, double ci, std::int32_t max_iter) {
+    if (provably_interior(cr, ci)) return 0;
     double zr = cr;
     double zi = ci;
     for (std::int32_t it = 1; it < max_iter; ++it) {
