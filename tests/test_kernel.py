@@ -113,6 +113,26 @@ def test_compute_tile_f32_close_to_golden():
     assert mismatch < 0.02, f"f32 path diverges on {mismatch:.1%} of pixels"
 
 
+@pytest.mark.parametrize("seed", range(4))
+def test_random_views_f64_parity(seed):
+    """Seeded random views (center, span, budget) vs the golden — catches
+    regressions outside the hand-picked VIEWS, including interior/cycle
+    shortcut interactions anywhere in the plane."""
+    rng = np.random.default_rng(1234 + seed)
+    cx, cy = rng.uniform(-2.0, 2.0, size=2)
+    span = float(10.0 ** rng.uniform(-3, 0.6))
+    max_iter = int(rng.integers(50, 500))
+    spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
+                    width=64, height=64)
+    cr, ci = grids(spec)
+    golden = ref.escape_counts(cr, ci, max_iter)
+    got = np.asarray(escape_counts(cr, ci, max_iter=max_iter))
+    mism = (got != golden).mean()
+    assert mism <= 5e-4, (
+        f"seed {seed} (c={cx:.4f},{cy:.4f} span={span:.3g} "
+        f"mi={max_iter}): {mism:.2%} mismatch")
+
+
 # ---------------------------------------------------------------------------
 # Closed-form interior shortcut (main cardioid + period-2 bulb).
 
