@@ -138,6 +138,36 @@ def mandelbrot_interior(c_real, c_imag, margin: float | None = None):
     return cardioid | bulb
 
 
+def multibrot_interior_radius(power: int) -> float:
+    """Radius of the inscribed disk (centered at 0) of the degree-``power``
+    Multibrot's period-1 hyperbolic component.
+
+    The component is ``c = w - w^d`` over ``|w| < d^(-1/(d-1))`` (where the
+    fixed point's multiplier ``d w^(d-1)`` is attracting) and contains 0;
+    on its boundary ``|c| = |w - w^d| >= |w|(1 - |w|^(d-1)) =
+    (d-1) d^(-d/(d-1))``, so the disk of that radius lies strictly inside
+    — every c in it has an attracting fixed point and provably never
+    escapes.  For d=2 this is the |c| < 1/4 disk inside the cardioid
+    (the cardioid test is strictly stronger there; this exists for d > 2,
+    where no simple closed boundary form is available)."""
+    d = float(power)
+    return (d - 1.0) * d ** (-d / (d - 1.0))
+
+
+def multibrot_interior(c_real, c_imag, power: int,
+                       margin: float | None = None):
+    """Conservative interior mask for the degree-``power`` Multibrot: the
+    inscribed disk of :func:`multibrot_interior_radius`, strict by the
+    same per-dtype margin policy as :func:`mandelbrot_interior` (the test
+    is two multiplies and an add — rounding is a couple of ulps)."""
+    dtype = jnp.result_type(c_real)
+    if margin is None:
+        margin = INTERIOR_MARGIN.get(np.dtype(dtype), 1e-5)
+    r = multibrot_interior_radius(power)
+    lim = jnp.asarray(r * r - margin, dtype)
+    return c_real * c_real + c_imag * c_imag < lim
+
+
 def cycle_probe_update(zr, zi, szr, szi, live, n, total_steps: int):
     """Shared per-step Brent probe bookkeeping: retire exactly-repeating
     live orbits and saturate their count so they classify never-escaped
@@ -312,17 +342,18 @@ def family_step(zr, zi, c_real, c_imag, *, power: int, burning: bool):
 
 
 def escape_loop_generic(step_fn, zr0, zi0, *, total_steps: int, segment: int,
-                        cycle_check: bool = False):
+                        cycle_check: bool = False, interior=None):
     """Segmented select-free escape loop for an arbitrary one-step map
     ``step_fn(zr, zi) -> (zr, zi)`` (the Multibrot / Burning Ship
     families, ops.families).
 
     Same protocol as :func:`escape_loop` — sticky mask, survived-count
-    recovery, Brent probe, overrun cancellation — sharing its helpers
-    (:func:`cycle_probe_update`, :func:`brent_snap_hook`,
-    :func:`counts_from_survival`); any protocol change must land in both
-    (the z^2+c loop stays specialized so it can reuse its cached squares
-    for the next update; this variant recomputes ``|z|^2``).
+    recovery, Brent probe, overrun cancellation, optional proven-interior
+    pre-saturation — sharing its helpers (:func:`cycle_probe_update`,
+    :func:`brent_snap_hook`, :func:`counts_from_survival`); any protocol
+    change must land in both (the z^2+c loop stays specialized so it can
+    reuse its cached squares for the next update; this variant recomputes
+    ``|z|^2``).
     """
     four = jnp.asarray(4.0, jnp.result_type(zr0))
 
@@ -342,7 +373,11 @@ def escape_loop_generic(step_fn, zr0, zi0, *, total_steps: int, segment: int,
         return (zr, zi, active, n)
 
     active0 = zr0 * 0 == 0
-    init = (zr0, zi0, active0, jnp.zeros(zr0.shape, jnp.int32))
+    n0 = jnp.zeros(zr0.shape, jnp.int32)
+    if interior is not None:
+        active0 = active0 & ~interior
+        n0 = n0 + interior.astype(jnp.int32) * total_steps
+    init = (zr0, zi0, active0, n0)
     if cycle_check:
         init = init + (zr0, zi0, jnp.asarray(2, jnp.int32))
     state = segmented_while(

@@ -17,10 +17,13 @@ the update, 0 = never escaped.  (Radius 2 remains a valid escape bound for
 every degree >= 2: once ``|z| > 2`` and ``|c| <= |z|``,
 ``|z^d + c| >= |z|^d - |c| >= |z|(|z|^{d-1} - 1) > |z|``.)
 
-No closed interior form exists for these families, so the cycle probe is
-the only in-set shortcut (same policy: on at budgets >=
-:data:`escape_time.CYCLE_CHECK_MIN_ITER`).  Goldens live beside the other
-pins in :mod:`distributedmandelbrot_tpu.ops.reference`.
+In-set shortcuts: the Multibrot gets the exact inscribed disk of its
+period-1 component (:func:`escape_time.multibrot_interior_radius`; the
+full cardioid+bulb closed forms at degree 2), the Burning Ship has no
+known interior form, and the Brent cycle probe covers what the closed
+forms miss on both (same policy: on at budgets >=
+:data:`escape_time.CYCLE_CHECK_MIN_ITER`).  Goldens live beside the
+other pins in :mod:`distributedmandelbrot_tpu.ops.reference`.
 
 Parity note: the select-free protocol is exact (a pure-numpy mirror of
 this loop matches the frozen golden bit-for-bit), but XLA's FMA
@@ -41,7 +44,8 @@ import numpy as np
 from distributedmandelbrot_tpu.core.geometry import TileSpec
 from distributedmandelbrot_tpu.ops.escape_time import (
     DEFAULT_SEGMENT, _escape_smooth_jit, escape_loop_generic, family_step,
-    resolve_cycle_check, scale_counts_to_uint8)
+    mandelbrot_interior, multibrot_interior, resolve_cycle_check,
+    scale_counts_to_uint8)
 from distributedmandelbrot_tpu.utils.precision import ensure_x64
 
 __all__ = ["family_step", "escape_counts_family", "escape_smooth_family",
@@ -68,9 +72,20 @@ def _family_counts_jit(c_real, c_imag, *, max_iter: int, segment: int,
         return jnp.zeros(c_real.shape, jnp.int32)
     step = partial(family_step, c_real=c_real, c_imag=c_imag, power=power,
                    burning=burning)
+    # Multibrot gets an exact interior shortcut: the full cardioid+bulb
+    # closed forms at degree 2, the inscribed period-1 disk above (see
+    # escape_time.multibrot_interior_radius — no closed boundary form
+    # exists for d > 2).  The Burning Ship has no known interior form;
+    # its shortcut is the cycle probe alone.
+    if burning:
+        interior = None
+    elif power == 2:
+        interior = mandelbrot_interior(c_real, c_imag)
+    else:
+        interior = multibrot_interior(c_real, c_imag, power)
     return escape_loop_generic(step, c_real, c_imag,
                                total_steps=total_steps, segment=segment,
-                               cycle_check=cycle_check)
+                               cycle_check=cycle_check, interior=interior)
 
 
 def escape_counts_family(c_real: jax.Array, c_imag: jax.Array, *,

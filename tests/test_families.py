@@ -56,6 +56,25 @@ def test_family_power2_matches_mandelbrot_golden():
     assert mism <= 5e-4
 
 
+@pytest.mark.parametrize("power", [3, 4, 7])
+def test_multibrot_interior_disk_pixels_never_escape(power):
+    """Every pixel inside the inscribed disk must be one the golden finds
+    never escapes (the disk is a strict subset of the period-1
+    component), and the disk must be maximal enough to contain 0's
+    neighborhood."""
+    from distributedmandelbrot_tpu.ops.escape_time import (
+        multibrot_interior, multibrot_interior_radius)
+    spec = TileSpec(-0.8, -0.8, 1.6, 1.6, width=128, height=128)
+    cr, ci = spec.grid_2d()
+    mask = np.asarray(multibrot_interior(cr.astype(np.float32),
+                                         ci.astype(np.float32), power))
+    assert mask.any()
+    golden = ref.escape_counts_family(cr, ci, 2000, power=power)
+    assert (golden[mask] == 0).all()
+    # d=2 must reproduce the known 1/4 value.
+    assert abs(multibrot_interior_radius(2) - 0.25) < 1e-15
+
+
 def test_family_cycle_check_is_output_identical():
     import jax.numpy as jnp
     for power, burning, spec in [(3, False, MULTIBROT_VIEW),
