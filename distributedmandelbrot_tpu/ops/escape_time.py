@@ -168,6 +168,20 @@ def multibrot_interior(c_real, c_imag, power: int,
     return c_real * c_real + c_imag * c_imag < lim
 
 
+def family_interior(c_real, c_imag, power: int = 2, burning: bool = False):
+    """The proven-interior mask for a recurrence family, or ``None`` when
+    no closed form is known (the Burning Ship): cardioid + period-2 bulb
+    at degree 2, the inscribed period-1 disk at higher multibrot degrees.
+    The single source of the family -> interior-test policy — used by the
+    XLA count loop, the smooth kernel, and the Pallas block kernels, so
+    the dispatch can never diverge between them."""
+    if burning:
+        return None
+    if power == 2:
+        return mandelbrot_interior(c_real, c_imag)
+    return multibrot_interior(c_real, c_imag, power)
+
+
 def cycle_probe_update(zr, zi, szr, szi, live, n, total_steps: int):
     """Shared per-step Brent probe bookkeeping: retire exactly-repeating
     live orbits and saturate their count so they classify never-escaped
@@ -648,14 +662,16 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
     mix = zr0 * 0 + zi0 * 0
     active0 = mix == 0
     n2_0 = mix.astype(jnp.int32)
-    if interior_check:  # valid only for z0 == c (Mandelbrot callers)
-        interior = mandelbrot_interior(c_real + mix, c_imag + mix)
-        # Proven-interior pixels: inactive from the start (their z stays
-        # frozen at c — harmless, the output branch discards it), radius-2
-        # count pre-saturated so they classify in-set (nu = 0) exactly as
-        # if they had iterated the full budget.
-        active0 = active0 & ~interior
-        n2_0 = n2_0 + interior.astype(jnp.int32) * total_steps
+    if interior_check:  # valid only for z0 == c (Mandelbrot-family callers)
+        interior = family_interior(c_real + mix, c_imag + mix, power,
+                                   burning)
+        if interior is not None:
+            # Proven-interior pixels: inactive from the start (their z
+            # stays frozen at c — harmless, the output branch discards
+            # it), radius-2 count pre-saturated so they classify in-set
+            # (nu = 0) exactly as if they had iterated the full budget.
+            active0 = active0 & ~interior
+            n2_0 = n2_0 + interior.astype(jnp.int32) * total_steps
     init = (zr0 + mix, zi0 + mix, active0, mix.astype(jnp.int32),
             active0, n2_0)
     if cycle_check:

@@ -43,9 +43,8 @@ import numpy as np
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
 from distributedmandelbrot_tpu.ops.escape_time import (
-    DEFAULT_SEGMENT, _escape_smooth_jit, escape_loop_generic, family_step,
-    mandelbrot_interior, multibrot_interior, resolve_cycle_check,
-    scale_counts_to_uint8)
+    DEFAULT_SEGMENT, _escape_smooth_jit, escape_loop_generic, family_interior,
+    family_step, resolve_cycle_check, scale_counts_to_uint8)
 from distributedmandelbrot_tpu.utils.precision import ensure_x64
 
 __all__ = ["family_step", "escape_counts_family", "escape_smooth_family",
@@ -72,20 +71,14 @@ def _family_counts_jit(c_real, c_imag, *, max_iter: int, segment: int,
         return jnp.zeros(c_real.shape, jnp.int32)
     step = partial(family_step, c_real=c_real, c_imag=c_imag, power=power,
                    burning=burning)
-    # Multibrot gets an exact interior shortcut: the full cardioid+bulb
-    # closed forms at degree 2, the inscribed period-1 disk above (see
-    # escape_time.multibrot_interior_radius — no closed boundary form
-    # exists for d > 2).  The Burning Ship has no known interior form;
-    # its shortcut is the cycle probe alone.
-    if burning:
-        interior = None
-    elif power == 2:
-        interior = mandelbrot_interior(c_real, c_imag)
-    else:
-        interior = multibrot_interior(c_real, c_imag, power)
+    # Exact interior shortcut where a closed form exists (single-sourced
+    # policy: escape_time.family_interior — cardioid+bulb at degree 2,
+    # the inscribed period-1 disk above, None for the ship).
     return escape_loop_generic(step, c_real, c_imag,
                                total_steps=total_steps, segment=segment,
-                               cycle_check=cycle_check, interior=interior)
+                               cycle_check=cycle_check,
+                               interior=family_interior(c_real, c_imag,
+                                                        power, burning))
 
 
 def escape_counts_family(c_real: jax.Array, c_imag: jax.Array, *,
@@ -118,9 +111,11 @@ def escape_smooth_family(c_real: jax.Array, c_imag: jax.Array, *,
     dt = getattr(c_real, "dtype", None)
     if dt is not None and np.dtype(dt) == np.float64:
         ensure_x64()
+    # interior_check on: the smooth kernel routes through the same
+    # family_interior policy (cardioid+bulb / inscribed disk / None).
     return _escape_smooth_jit(c_real, c_imag, c_real, c_imag,
                               max_iter=max_iter, segment=segment,
-                              bailout=float(bailout), interior_check=False,
+                              bailout=float(bailout), interior_check=True,
                               cycle_check=resolve_cycle_check(cycle_check,
                                                               max_iter),
                               power=power, burning=burning)

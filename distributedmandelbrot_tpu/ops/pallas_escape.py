@@ -41,8 +41,8 @@ import numpy as np
 from jax import lax
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
-from distributedmandelbrot_tpu.ops.escape_time import (family_step,
-                                                       mandelbrot_interior,
+from distributedmandelbrot_tpu.ops.escape_time import (family_interior,
+                                                       family_step,
                                                        resolve_cycle_check)
 
 def _pallas():
@@ -68,16 +68,19 @@ DEFAULT_BLOCK_W = 128
 DEFAULT_UNROLL = 32
 
 
-def _interior_init(c_real, c_imag, dyn_steps, shape, interior_check: bool):
+def _interior_init(c_real, c_imag, dyn_steps, shape, interior_check: bool,
+                   power: int = 2, burning: bool = False):
     """Shared scratch-state seed for both block kernels: ``(act0, n_sat,
-    live0)`` where proven-interior pixels (closed-form cardioid/bulb test,
-    ops.escape_time.mandelbrot_interior) start inactive with their bounded
-    count pre-saturated at ``dyn_steps`` — so they classify "never escaped"
-    (0 / nu=0) with zero iterations — and ``live0`` seeds the while-loop's
-    live count so a block of only interior + sky pixels exits before a
-    single escape segment runs."""
-    if interior_check:
-        interior = mandelbrot_interior(c_real, c_imag).astype(jnp.int32)
+    live0)`` where proven-interior pixels (the single-sourced policy of
+    ops.escape_time.family_interior) start inactive with their bounded
+    count pre-saturated at ``dyn_steps`` — so they classify "never
+    escaped" (0 / nu=0) with zero iterations — and ``live0`` seeds the
+    while-loop's live count so a block of only interior + sky pixels
+    exits before a single escape segment runs."""
+    mask = (family_interior(c_real, c_imag, power, burning)
+            if interior_check else None)
+    if mask is not None:
+        interior = mask.astype(jnp.int32)
         act0 = 1 - interior
         return act0, interior * dyn_steps, jnp.sum(act0, dtype=jnp.int32)
     return (jnp.ones(shape, jnp.int32), jnp.zeros(shape, jnp.int32),
@@ -140,8 +143,9 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     zi_ref[:] = g_imag
     # Interior pixels otherwise dominate iteration work on set-crossing
     # views — this shortcut is where the block-granular exit really pays.
-    act0, n_sat, live0 = _interior_init(c_real, c_imag, dyn_steps, shape,
-                                        interior_check and not julia)
+    act0, n_sat, live0 = _interior_init(
+        c_real, c_imag, dyn_steps, shape, interior_check and not julia,
+        power=power, burning=burning)
     act_ref[:] = act0
     n_ref[:] = n_sat
     if cycle_check:
@@ -239,9 +243,10 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
     """``max_iter`` is the static compile cap; ``mrd`` (defaults to the
     cap) is this tile's traced budget — see ``_escape_block_kernel``.
     ``julia`` expects params of shape (1, 5): the grid scalars plus the
-    Julia constant.  ``power``/``burning`` select the extended families
-    (the closed-form interior shortcut only applies to the plain
-    Mandelbrot recurrence and is forced off otherwise)."""
+    Julia constant.  ``power``/``burning`` select the extended families;
+    the interior shortcut follows escape_time.family_interior's policy
+    (cardioid+bulb at degree 2, inscribed disk at higher degrees, none
+    for the ship or julia mode)."""
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
@@ -250,7 +255,6 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
     # forms miss (higher-period bulbs, minibrots), whose eventual exact-
     # f32 limit cycles the probe retires (ops.escape_time.escape_loop).
     cycle_check = resolve_cycle_check(cycle_check, max_iter)
-    interior_check = interior_check and power == 2 and not burning
     kernel = partial(_escape_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
                      block_h=block_h, block_w=block_w, clamp=clamp,
