@@ -95,6 +95,25 @@ def test_numpy_backend_is_bit_exact(tmp_path):
         np.testing.assert_array_equal(pixels, golden_tile(1, 0, 0, 12))
 
 
+def test_native_backend_is_bit_exact(tmp_path):
+    """The native C++ backend (the fast bit-exact anchor, including its
+    closed-form interior shortcut) must also persist byte-identical
+    tiles through the full farm pipeline."""
+    from distributedmandelbrot_tpu.worker import NativeBackend
+    try:
+        backend = NativeBackend()
+    except Exception:
+        pytest.skip("native library unavailable on this host")
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, 12)]) as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            backend, overlap_io=False)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=1)
+        pixels, _ = DataClient("127.0.0.1", farm.dataserver_port).fetch(1, 0, 0)
+        np.testing.assert_array_equal(pixels, golden_tile(1, 0, 0, 12))
+
+
 def test_rgba_rendering_matches_reference_semantics():
     """In-set pixels (value 0) must render black; others via inverted jet."""
     values = np.zeros((8, 8), dtype=np.uint8)
