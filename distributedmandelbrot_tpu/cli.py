@@ -105,6 +105,21 @@ def _join_negative_values(argv: Sequence[str], flags: Sequence[str]) -> list:
 DEEP_SPAN_THRESHOLD = 1e-12
 
 
+def _pallas_first(kernel: str, /, *args, **kwargs):
+    """Run the named ops.pallas_escape kernel on TPU, or return None when
+    Pallas is unavailable or rejects the shape/budget (callers fall back
+    to the XLA path).  The single copy of the f32 fast-path dispatch
+    policy; only unavailability maps to None — errors downstream of the
+    kernel (rendering, IO) surface normally from the caller."""
+    try:
+        from distributedmandelbrot_tpu.ops import pallas_escape
+        if not pallas_escape.pallas_available():
+            return None
+        return getattr(pallas_escape, kernel)(*args, **kwargs)
+    except ValueError:
+        return None
+
+
 def _render_view(c_re: str, c_im: str, span: float, definition: int,
                  max_iter: int, *, smooth: bool, np_dtype, colormap: str,
                  deep: bool | None = None,
@@ -126,23 +141,19 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
         spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
                         width=definition, height=definition)
         if smooth:
-            from distributedmandelbrot_tpu.ops.families import (
-                compute_tile_smooth_family)
-            nu = compute_tile_smooth_family(spec, max_iter, power=power,
-                                            burning=burning, dtype=np_dtype)
+            nu = _pallas_first("compute_tile_smooth_pallas", spec, max_iter,
+                               power=power, burning=burning) \
+                if np_dtype == np.float32 else None
+            if nu is None:
+                from distributedmandelbrot_tpu.ops.families import (
+                    compute_tile_smooth_family)
+                nu = compute_tile_smooth_family(spec, max_iter, power=power,
+                                                burning=burning,
+                                                dtype=np_dtype)
             return smooth_to_rgba(nu, max_iter, colormap=colormap)
-        values = None
-        if np_dtype == np.float32:
-            # Pallas-first on TPU, same policy as the core fractals; only
-            # the kernel call sits in the try so rendering errors surface.
-            try:
-                from distributedmandelbrot_tpu.ops.pallas_escape import (
-                    compute_tile_family_pallas, pallas_available)
-                if pallas_available():
-                    values = compute_tile_family_pallas(
-                        spec, max_iter, power=power, burning=burning)
-            except ValueError:
-                values = None  # shape/budget outside the kernel -> XLA
+        values = _pallas_first("compute_tile_family_pallas", spec, max_iter,
+                               power=power, burning=burning) \
+            if np_dtype == np.float32 else None
         if values is None:
             from distributedmandelbrot_tpu.ops import compute_tile_family
             values = compute_tile_family(spec, max_iter, power=power,
@@ -175,40 +186,21 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
     spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
                     width=definition, height=definition)
     if smooth:
-        if np_dtype == np.float32:
-            # f32 smooth throughput path: Pallas on TPU, XLA otherwise
-            # (Mandelbrot and Julia both ride the same kernel).
-            nu = None
-            try:
-                from distributedmandelbrot_tpu.ops.pallas_escape import (
-                    compute_tile_smooth_pallas, pallas_available)
-                if pallas_available():
-                    nu = compute_tile_smooth_pallas(spec, max_iter,
-                                                    julia_c=jc)
-            except ValueError:
-                nu = None  # shape/budget outside the kernel -> XLA below
-            if nu is not None:
-                # Rendering errors must surface, not trigger a fallback
-                # recompute — only the kernel call sits in the try.
-                return smooth_to_rgba(nu, max_iter, colormap=colormap)
-        from distributedmandelbrot_tpu.ops import compute_tile_smooth
-        nu = compute_tile_smooth(spec, max_iter, dtype=np_dtype,
-                                 julia_c=jc)
+        # f32 smooth throughput path: Pallas on TPU, XLA otherwise
+        # (Mandelbrot and Julia both ride the same kernel).
+        nu = _pallas_first("compute_tile_smooth_pallas", spec, max_iter,
+                           julia_c=jc) if np_dtype == np.float32 else None
+        if nu is None:
+            from distributedmandelbrot_tpu.ops import compute_tile_smooth
+            nu = compute_tile_smooth(spec, max_iter, dtype=np_dtype,
+                                     julia_c=jc)
         return smooth_to_rgba(nu, max_iter, colormap=colormap)
     if np_dtype == np.float32:
-        # Integer f32 fast path, same Pallas-first policy.  Only the
-        # kernel call sits in the try: rendering errors must surface,
-        # not trigger a fallback recompute.
-        values = None
-        try:
-            from distributedmandelbrot_tpu.ops.pallas_escape import (
-                compute_tile_julia_pallas, compute_tile_pallas,
-                pallas_available)
-            if pallas_available():
-                values = (compute_tile_pallas(spec, max_iter) if jc is None
-                          else compute_tile_julia_pallas(spec, jc, max_iter))
-        except ValueError:
-            values = None  # shape/budget outside the kernel -> XLA below
+        # Integer f32 fast path, same Pallas-first policy.
+        values = (_pallas_first("compute_tile_pallas", spec, max_iter)
+                  if jc is None else
+                  _pallas_first("compute_tile_julia_pallas", spec, jc,
+                                max_iter))
         if values is not None:
             return value_to_rgba(values.reshape(spec.height, spec.width),
                                  colormap=colormap)

@@ -287,7 +287,8 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                          max_iter: int, unroll: int, block_h: int,
                          block_w: int, bailout: float, extra: int,
                          interior_check: bool, cycle_check: bool,
-                         julia: bool = False):
+                         julia: bool = False, power: int = 2,
+                         burning: bool = False):
     """Smooth-coloring twin of :func:`_escape_block_kernel`: freezes the
     full value at the first radius-``bailout`` crossing while a sticky
     radius-2 count keeps in-set classification identical to the integer
@@ -296,7 +297,9 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     constraint, same early exit — here on the radius-``bailout`` mask,
     run ``extra`` steps past the budget so late escapees reach the
     smoothing radius).  ``julia`` as in the integer kernel: params (1, 5),
-    z starts at the grid, constant ``c`` from SMEM."""
+    z starts at the grid, constant ``c`` from SMEM.  ``power``/``burning``
+    select the extended families, with the degree-``power``
+    renormalization of ``ops.escape_time._escape_smooth_jit``."""
     pl, _ = _pallas()
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -330,7 +333,8 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     # Same interior shortcut as the integer kernel (radius-2 count is the
     # one pre-saturated: it owns in-set classification, nu = 0).
     act0, n2_sat, live0 = _interior_init(c_real, c_imag, dyn_steps, shape,
-                                         interior_check and not julia)
+                                         interior_check and not julia,
+                                         power=power, burning=burning)
     actb_ref[:] = act0
     n_ref[:] = jnp.zeros(shape, jnp.int32)
     act2_ref[:] = act0
@@ -354,8 +358,8 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
             szi = jnp.where(do_snap, zi, szi_ref[:])
             next_snap = jnp.where(do_snap, it + it, next_snap)
         for _ in range(unroll):
-            nzi = (zr + zr) * zi + c_imag
-            nzr = zr * zr - zi * zi + c_real
+            nzr, nzi = family_step(zr, zi, c_real, c_imag, power=power,
+                                   burning=burning)
             # Escaped-from-bailout lanes freeze — their z at the first
             # crossing IS the smoothing payload, so no separate snapshot
             # state is needed.
@@ -401,22 +405,31 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     # same laggard handling as the XLA kernel).
     fzr = zr_ref[:]
     fzi = zi_ref[:]
-    mag2 = jnp.maximum(fzr * fzr + fzi * fzi, b2)
+    # Same two-sided sanitization as the XLA smooth kernel: high degrees
+    # freeze past bailout with |z|^2 (or its inf - inf) beyond f32 range.
+    big = float(jnp.finfo(dtype).max)
+    mag2 = jnp.clip(jnp.nan_to_num(fzr * fzr + fzi * fzi, nan=big,
+                                   posinf=big), b2, big)
     log_ratio = jnp.log(mag2) / jnp.asarray(2.0 * np.log(bailout), dtype)
-    nu = (n + 2).astype(dtype) - jnp.log2(log_ratio)
+    corr = jnp.log2(log_ratio)
+    if power != 2:
+        corr = corr / jnp.asarray(np.log2(power), dtype)
+    nu = (n + 2).astype(dtype) - corr
     out_ref[:] = jnp.where(n2 >= dyn_steps, jnp.zeros((), dtype), nu)
 
 
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
                                    "block_h", "block_w", "bailout",
                                    "interpret", "interior_check",
-                                   "cycle_check", "julia"))
+                                   "cycle_check", "julia", "power",
+                                   "burning"))
 def _pallas_smooth(params, mrd=None, *, height: int, width: int,
                    max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, bailout: float = 256.0,
                    interpret: bool = False, interior_check: bool = True,
-                   cycle_check: bool | None = None, julia: bool = False):
+                   cycle_check: bool | None = None, julia: bool = False,
+                   power: int = 2, burning: bool = False):
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
@@ -427,7 +440,8 @@ def _pallas_smooth(params, mrd=None, *, height: int, width: int,
                      block_h=block_h, block_w=block_w,
                      bailout=float(bailout), extra=extra,
                      interior_check=interior_check,
-                     cycle_check=cycle_check, julia=julia)
+                     cycle_check=cycle_check, julia=julia, power=power,
+                     burning=burning)
     n_params = 5 if julia else 3
     return pl.pallas_call(
         kernel,
@@ -458,17 +472,23 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
                                interpret: bool | None = None,
                                interior_check: bool = True,
                                cycle_check: bool | None = None,
-                               julia_c: complex | None = None) -> np.ndarray:
+                               julia_c: complex | None = None,
+                               power: int = 2,
+                               burning: bool = False) -> np.ndarray:
     """Smooth (band-free) tile via the Pallas kernel -> (h, w) float32 nu.
 
     The f32 TPU throughput path for smooth rendering (animations, live
     views); the f64 quality path stays on the XLA kernel.  ``julia_c``
     renders the Julia set for that constant (rides SMEM — sweeping it
-    reuses one executable).  Same ValueError contract as
-    :func:`compute_tile_pallas_device` for unsupported shapes/budgets —
-    callers fall back to XLA.
+    reuses one executable); ``power``/``burning`` the extended families.
+    Same ValueError contract as :func:`compute_tile_pallas_device` for
+    unsupported shapes/budgets/degrees — callers fall back to XLA.
     """
     from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
+    from distributedmandelbrot_tpu.ops.families import _check_family
+    _check_family(power, burning)
+    if julia_c is not None and (power != 2 or burning):
+        raise ValueError("julia mode supports the degree-2 recurrence only")
     if max_iter - 1 >= INT32_SCALE_LIMIT:
         raise ValueError(f"max_iter {max_iter} too deep for the pallas path")
     block_h, block_w = fit_blocks(spec.height, spec.width,
@@ -487,7 +507,8 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
                          max_iter=cap, unroll=unroll, block_h=block_h,
                          block_w=block_w, bailout=bailout,
                          interpret=interpret, interior_check=interior_check,
-                         cycle_check=cycle_check, julia=julia_c is not None)
+                         cycle_check=cycle_check, julia=julia_c is not None,
+                         power=power, burning=burning)
     return np.asarray(out)
 
 
