@@ -134,6 +134,46 @@ def test_batch_request_and_response(farm):
     assert client.request_batch(1) == []
 
 
+def test_servers_survive_malformed_clients(farm):
+    """Hostile/broken clients — unknown purpose bytes, truncated frames,
+    mid-frame disconnects, random garbage — must never take down either
+    accept loop: a well-behaved client still gets served afterward."""
+    rng = np.random.default_rng(7)
+    attacks_distributer = [
+        b"\xff",                      # unknown purpose byte
+        b"",                          # connect-then-close
+        b"\x01" + b"\x00" * 7,        # response purpose, truncated echo
+        bytes(rng.integers(0, 256, size=64, dtype=np.uint8)),  # garbage
+    ]
+    for payload in attacks_distributer:
+        with raw_conn(farm.distributer_port) as s:
+            s.sendall(payload) if payload else None
+            # server may reply or just drop us; either way it must not die
+            s.settimeout(2)
+            try:
+                s.recv(64)
+            except (socket.timeout, ConnectionError, OSError):
+                pass
+    attacks_dataserver = [
+        b"\x01\x02",                  # truncated 12-byte query
+        bytes(rng.integers(0, 256, size=12, dtype=np.uint8)),  # random query
+        b"",
+    ]
+    for payload in attacks_dataserver:
+        with raw_conn(farm.dataserver_port) as s:
+            s.sendall(payload) if payload else None
+            s.settimeout(2)
+            try:
+                s.recv(64)
+            except (socket.timeout, ConnectionError, OSError):
+                pass
+    # Both servers still alive and correct for a legitimate client.
+    wl = DistributerClient("127.0.0.1", farm.distributer_port).request()
+    assert wl is not None
+    _, status = DataClient("127.0.0.1", farm.dataserver_port).fetch(2, 0, 0)
+    assert status is FetchStatus.NOT_AVAILABLE
+
+
 def test_lease_expiry_then_stale_rejected_and_regrant():
     """Full redistribution flow over virtual time through the real servers."""
     import tempfile
