@@ -744,13 +744,17 @@ def compute_tile(spec: TileSpec, max_iter: int, *,
                  dtype: np.dtype = np.float32,
                  segment: int = DEFAULT_SEGMENT,
                  clamp: bool = False,
-                 device: jax.Device | None = None) -> np.ndarray:
+                 device: jax.Device | None = None,
+                 interior_check: bool = True,
+                 cycle_check: bool | None = None) -> np.ndarray:
     """Compute one tile end-to-end: grid -> device kernel -> uint8 pixels.
 
     Returns the flat uint8 array in the canonical real-fastest order.  The
     sample grid is always generated in float64 on the host (bit-identical to
     the reference's ``np.linspace``) and cast to ``dtype`` for the kernel, so
-    the float64 path is the exact parity path.
+    the float64 path is the exact parity path.  The shortcut toggles pass
+    through to :func:`escape_counts` (output-identical either way; off for
+    timing the raw loop).
     """
     if np.dtype(dtype) == np.float64:
         ensure_x64()
@@ -760,6 +764,8 @@ def compute_tile(spec: TileSpec, max_iter: int, *,
     if device is not None:
         c_real = jax.device_put(c_real, device)
         c_imag = jax.device_put(c_imag, device)
-    counts = escape_counts(c_real, c_imag, max_iter=max_iter, segment=segment)
+    counts = escape_counts(c_real, c_imag, max_iter=max_iter, segment=segment,
+                           interior_check=interior_check,
+                           cycle_check=cycle_check)
     pixels = scale_counts_to_uint8(counts, max_iter=max_iter, clamp=clamp)
     return np.asarray(pixels).ravel()
