@@ -86,8 +86,10 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int):
     from distributedmandelbrot_tpu.ops.pallas_escape import (_pallas_escape,
                                                              fit_blocks)
 
+    from distributedmandelbrot_tpu.parallel.sharding import widen_square_pitch
+
     block_h, block_w = fit_blocks(tile, tile)
-    params = jnp.asarray(params_np, jnp.float32)
+    params = jnp.asarray(widen_square_pitch(params_np), jnp.float32)
 
     @jax.jit
     def run(params):
@@ -104,22 +106,28 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int):
 
 
 def _pallas_sharded_chain(mesh, params_np: np.ndarray, mrds: np.ndarray,
-                          tile: int):
+                          tile: int, interpret: bool | None = None):
     """The shard_map-wrapped Pallas path, reduced on device — the mesh-
-    apples-to-apples twin of _xla_chain."""
+    apples-to-apples twin of _xla_chain.  ``interpret`` defaults to
+    auto (compiled on TPU, interpreter elsewhere) so the chain stays
+    drivable on the CPU config."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from distributedmandelbrot_tpu.ops.pallas_escape import (fit_blocks,
+                                                             pallas_available,
                                                              DEFAULT_UNROLL)
     from distributedmandelbrot_tpu.parallel.mesh import TILE_AXIS
     from distributedmandelbrot_tpu.parallel.sharding import (
-        _batched_pallas_sharded, pad_to_mesh)
+        _batched_pallas_sharded, pad_to_mesh, widen_square_pitch)
 
     cap = int(mrds.max())
     block_h, block_w = fit_blocks(tile, tile)
     params_np, mrds = pad_to_mesh(params_np, mrds, mesh.devices.size)
+    params_np = widen_square_pitch(params_np)
+    if interpret is None:
+        interpret = not pallas_available()
     sharding = NamedSharding(mesh, P(TILE_AXIS))
     params = jax.device_put(jnp.asarray(params_np, jnp.float32), sharding)
     mrd_arr = jax.device_put(jnp.asarray(mrds, jnp.int32), sharding)
@@ -129,7 +137,8 @@ def _pallas_sharded_chain(mesh, params_np: np.ndarray, mrds: np.ndarray,
         out = _batched_pallas_sharded(params, mrd_arr, mesh=mesh,
                                       definition=tile, max_iter_cap=cap,
                                       unroll=DEFAULT_UNROLL, block_h=block_h,
-                                      block_w=block_w, clamp=False)
+                                      block_w=block_w, clamp=False,
+                                      interpret=interpret)
         return jnp.sum(out.astype(jnp.int32), dtype=jnp.int32)
 
     return lambda: run(params, mrd_arr)

@@ -115,18 +115,19 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     j = pl.program_id(1)
     start_r = params_ref[0, 0]
     start_i = params_ref[0, 1]
-    step = params_ref[0, 2]
+    step_r = params_ref[0, 2]
+    step_i = params_ref[0, 3]  # per-axis pitch: anisotropic TileSpecs differ
     mrd = mrd_ref[0, 0]
     shape = out_ref.shape
     dtype = params_ref.dtype
 
     col = lax.broadcasted_iota(jnp.int32, shape, 1) + j * block_w
     row = lax.broadcasted_iota(jnp.int32, shape, 0) + i * block_h
-    g_real = start_r + col.astype(dtype) * step
-    g_imag = start_i + row.astype(dtype) * step
+    g_real = start_r + col.astype(dtype) * step_r
+    g_imag = start_i + row.astype(dtype) * step_i
     if julia:
-        c_real = jnp.full(shape, params_ref[0, 3], dtype)
-        c_imag = jnp.full(shape, params_ref[0, 4], dtype)
+        c_real = jnp.full(shape, params_ref[0, 4], dtype)
+        c_imag = jnp.full(shape, params_ref[0, 5], dtype)
     else:
         c_real = g_real
         c_imag = g_imag
@@ -242,11 +243,13 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
                    power: int = 2, burning: bool = False):
     """``max_iter`` is the static compile cap; ``mrd`` (defaults to the
     cap) is this tile's traced budget — see ``_escape_block_kernel``.
-    ``julia`` expects params of shape (1, 5): the grid scalars plus the
-    Julia constant.  ``power``/``burning`` select the extended families;
-    the interior shortcut follows escape_time.family_interior's policy
-    (cardioid+bulb at degree 2, inscribed disk at higher degrees, none
-    for the ship or julia mode)."""
+    params shape (1, 4): ``(start_real, start_imag, step_real,
+    step_imag)`` — two pitch scalars so anisotropic tiles render
+    correctly; ``julia`` appends the constant for shape (1, 6).
+    ``power``/``burning`` select the extended families; the interior
+    shortcut follows escape_time.family_interior's policy (cardioid+bulb
+    at degree 2, inscribed disk at higher degrees, none for the ship or
+    julia mode)."""
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
@@ -260,7 +263,7 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
                      block_h=block_h, block_w=block_w, clamp=clamp,
                      interior_check=interior_check, cycle_check=cycle_check,
                      julia=julia, power=power, burning=burning)
-    n_params = 5 if julia else 3
+    n_params = 6 if julia else 4
     return pl.pallas_call(
         kernel,
         grid=(height // block_h, width // block_w),
@@ -296,7 +299,7 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     in VMEM scratch; the while carries scalars only (same Mosaic
     constraint, same early exit — here on the radius-``bailout`` mask,
     run ``extra`` steps past the budget so late escapees reach the
-    smoothing radius).  ``julia`` as in the integer kernel: params (1, 5),
+    smoothing radius).  ``julia`` as in the integer kernel: params (1, 6),
     z starts at the grid, constant ``c`` from SMEM.  ``power``/``burning``
     select the extended families, with the degree-``power``
     renormalization of ``ops.escape_time._escape_smooth_jit``."""
@@ -305,18 +308,19 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
     j = pl.program_id(1)
     start_r = params_ref[0, 0]
     start_i = params_ref[0, 1]
-    step = params_ref[0, 2]
+    step_r = params_ref[0, 2]
+    step_i = params_ref[0, 3]  # per-axis pitch: anisotropic TileSpecs differ
     mrd = mrd_ref[0, 0]
     shape = out_ref.shape
     dtype = params_ref.dtype
 
     col = lax.broadcasted_iota(jnp.int32, shape, 1) + j * block_w
     row = lax.broadcasted_iota(jnp.int32, shape, 0) + i * block_h
-    g_real = start_r + col.astype(dtype) * step
-    g_imag = start_i + row.astype(dtype) * step
+    g_real = start_r + col.astype(dtype) * step_r
+    g_imag = start_i + row.astype(dtype) * step_i
     if julia:
-        c_real = jnp.full(shape, params_ref[0, 3], dtype)
-        c_imag = jnp.full(shape, params_ref[0, 4], dtype)
+        c_real = jnp.full(shape, params_ref[0, 4], dtype)
+        c_imag = jnp.full(shape, params_ref[0, 5], dtype)
     else:
         c_real = g_real
         c_imag = g_imag
@@ -442,7 +446,7 @@ def _pallas_smooth(params, mrd=None, *, height: int, width: int,
                      interior_check=interior_check,
                      cycle_check=cycle_check, julia=julia, power=power,
                      burning=burning)
-    n_params = 5 if julia else 3
+    n_params = 6 if julia else 4
     return pl.pallas_call(
         kernel,
         grid=(height // block_h, width // block_w),
@@ -495,8 +499,9 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
                                   block_h=block_h, block_w=block_w)
     if interpret is None:
         interpret = not pallas_available()
-    step = spec.range_real / (spec.width - 1)
-    row = [spec.start_real, spec.start_imag, step]
+    row = [spec.start_real, spec.start_imag,
+           spec.range_real / (spec.width - 1),
+           spec.range_imag / (spec.height - 1)]
     if julia_c is not None:
         jc = complex(julia_c)
         row += [jc.real, jc.imag]
@@ -604,8 +609,9 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
                                   block_h=block_h, block_w=block_w)
     if interpret is None:
         interpret = not pallas_available()
-    step = spec.range_real / (spec.width - 1)
-    row = [spec.start_real, spec.start_imag, step]
+    row = [spec.start_real, spec.start_imag,
+           spec.range_real / (spec.width - 1),
+           spec.range_imag / (spec.height - 1)]
     if julia_c is not None:
         jc = complex(julia_c)
         row += [jc.real, jc.imag]

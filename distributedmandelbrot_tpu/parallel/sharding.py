@@ -111,6 +111,14 @@ def _one_tile_pixels(params, mrd, *, definition: int, max_iter_cap: int,
     return _scale_pixels(counts, mrd, clamp)
 
 
+def widen_square_pitch(starts_steps: np.ndarray) -> np.ndarray:
+    """(k, 3) square-pitch batch rows -> the Pallas kernel's (k, 4)
+    per-axis-pitch params layout (duplicate the step).  Every raw caller
+    of ``_pallas_escape``/``_batched_pallas_sharded`` must widen through
+    here; the batched APIs are square-pitch by construction."""
+    return np.concatenate([starts_steps, starts_steps[:, 2:3]], axis=1)
+
+
 def pad_to_mesh(starts_steps: np.ndarray, mrds: np.ndarray,
                 n_dev: int) -> tuple[np.ndarray, np.ndarray]:
     """Right-pad a tile batch to a multiple of the mesh size with trivial
@@ -236,6 +244,7 @@ def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
     if interpret is None:
         interpret = not pallas_available()
     starts_steps, mrds = pad_to_mesh(starts_steps, mrds, mesh.devices.size)
+    starts_steps = widen_square_pitch(starts_steps)
     sharding = NamedSharding(mesh, P(TILE_AXIS))
     params = jax.device_put(jnp.asarray(starts_steps, jnp.float32), sharding)
     mrd_arr = jax.device_put(jnp.asarray(mrds, jnp.int32), sharding)

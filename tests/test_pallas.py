@@ -29,14 +29,15 @@ VIEWS = {
 
 def kernel_grid(spec):
     """(cr, ci) f32 grids in the kernel's own coordinate convention
-    (start + index * step in f32, matching in-kernel generation) —
-    the single copy used by every parity comparison here."""
-    step = np.float32(spec.range_real / (spec.width - 1))
+    (start + index * step in f32, per-axis pitch, matching in-kernel
+    generation) — the single copy used by every parity comparison here."""
+    step_r = np.float32(spec.range_real / (spec.width - 1))
+    step_i = np.float32(spec.range_imag / (spec.height - 1))
     cr = (np.float32(spec.start_real)
-          + np.arange(spec.width, dtype=np.float32) * step)[None, :].repeat(
+          + np.arange(spec.width, dtype=np.float32) * step_r)[None, :].repeat(
               spec.height, 0)
     ci = (np.float32(spec.start_imag)
-          + np.arange(spec.height, dtype=np.float32) * step)[:, None].repeat(
+          + np.arange(spec.height, dtype=np.float32) * step_i)[:, None].repeat(
               spec.width, 1)
     return cr, ci
 
@@ -249,6 +250,32 @@ def test_pallas_unsupported_height_raises():
     spec = TileSpec(-0.8, 0.1, 0.2, 0.2, width=128, height=28)
     with pytest.raises(ValueError, match="unsupported"):
         compute_tile_pallas(spec, 40, interpret=True)
+
+
+def test_pallas_anisotropic_pitch():
+    """A TileSpec whose imag pitch differs from its real pitch must render
+    the view the spec describes, not a square-pitch distortion of it
+    (round-2 defect: one step scalar was applied to both axes)."""
+    spec = TileSpec(-0.8, 0.1, 0.2, 0.05, width=128, height=128)
+    got = compute_tile_pallas(spec, 60, block_h=32, interpret=True)
+    want = xla_f32_reference(spec, 60)
+    assert float((got != want).mean()) <= 0.02
+    # The old square-pitch reading of the same spec (imag pitch taken
+    # from range_real) must NOT match: the two views genuinely differ
+    # (guards against the test going vacuous).
+    square = TileSpec(-0.8, 0.1, 0.2, 0.2, width=128, height=128)
+    assert float((xla_f32_reference(square, 60) != want).mean()) > 0.05
+
+
+def test_pallas_smooth_anisotropic_pitch():
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_smooth_pallas)
+    spec = TileSpec(-0.8, 0.1, 0.2, 0.05, width=128, height=128)
+    got = compute_tile_smooth_pallas(spec, 60, block_h=32, interpret=True)
+    cr, ci = kernel_grid(spec)
+    want = np.asarray(escape_time.escape_smooth(cr, ci, max_iter=60))
+    close = np.isclose(got, want, rtol=1e-4, atol=1e-4)
+    assert float((~close).mean()) <= 0.02
 
 
 def test_pallas_clamp_mode():
