@@ -109,29 +109,56 @@ def _pallas_first(kernel: str, /, *args, **kwargs):
     """Run the named ops.pallas_escape kernel on TPU, or return None when
     Pallas is unavailable or rejects the shape/budget (callers fall back
     to the XLA path).  The single copy of the f32 fast-path dispatch
-    policy; only unavailability maps to None — errors downstream of the
-    kernel (rendering, IO) surface normally from the caller."""
-    try:
-        from distributedmandelbrot_tpu.ops import pallas_escape
-        if not pallas_escape.pallas_available():
-            return None
-        return getattr(pallas_escape, kernel)(*args, **kwargs)
-    except ValueError:
+    policy; only unavailability and the kernel's *intentional*
+    PallasUnsupported rejections map to None — any other error (including
+    a genuine kernel bug surfacing as ValueError) propagates rather than
+    silently degrading to the XLA path."""
+    from distributedmandelbrot_tpu.ops import pallas_escape
+    if not pallas_escape.pallas_available():
         return None
+    try:
+        return getattr(pallas_escape, kernel)(*args, **kwargs)
+    except pallas_escape.PallasUnsupported as e:
+        logger.debug("pallas path declined %s: %s", kernel, e)
+        return None
+
+
+def _add_no_pallas(parser: argparse.ArgumentParser) -> None:
+    """Shared by render and animate so the flag's contract can never
+    diverge between them (same single-copy rule as _render_view)."""
+    parser.add_argument("--no-pallas", action="store_true",
+                        help="force the XLA/host-grid compute path even on "
+                             "TPU: the Pallas f32 fast path generates its "
+                             "pixel grid on device (start + i*step in f32), "
+                             "which can differ from the host-linspace grid "
+                             "at the last ulp; use this to reproduce "
+                             "host-grid renders exactly")
 
 
 def _render_view(c_re: str, c_im: str, span: float, definition: int,
                  max_iter: int, *, smooth: bool, np_dtype, colormap: str,
                  deep: bool | None = None,
                  julia_c: tuple[str, str] | None = None,
-                 family: tuple[int, bool] | None = None):
+                 family: tuple[int, bool] | None = None,
+                 no_pallas: bool = False):
     """One view -> RGBA (Mandelbrot, or Julia when ``julia_c`` is set, or
     a Multibrot/Burning-Ship view when ``family=(power, burning)``),
     choosing direct vs perturbation rendering.  Shared by the render and
     animate commands so their behavior can never diverge; ``deep=None``
-    auto-selects below :data:`DEEP_SPAN_THRESHOLD`."""
+    auto-selects below :data:`DEEP_SPAN_THRESHOLD`.
+
+    ``no_pallas`` forces the XLA/host-grid path even on TPU.  Grid
+    convention note: the Pallas kernel generates its pixel grid on
+    device as ``start + index * step`` in f32, which differs from the
+    XLA path's host float64 linspace (exact endpoint) by up to one ulp
+    per coordinate — O(1) chaotic-boundary pixels per tile can land one
+    iteration bucket apart.  ``no_pallas`` reproduces the host-grid
+    output exactly (e.g. to re-render frames from a pre-Pallas build).
+    """
     from distributedmandelbrot_tpu.core.geometry import TileSpec
     from distributedmandelbrot_tpu.viewer import smooth_to_rgba, value_to_rgba
+
+    pallas_first = ((lambda *a, **k: None) if no_pallas else _pallas_first)
 
     if family is not None:
         # Extended families: direct rendering only (no perturbation
@@ -141,8 +168,8 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
         spec = TileSpec(cx - span / 2, cy - span / 2, span, span,
                         width=definition, height=definition)
         if smooth:
-            nu = _pallas_first("compute_tile_smooth_pallas", spec, max_iter,
-                               power=power, burning=burning) \
+            nu = pallas_first("compute_tile_smooth_pallas", spec, max_iter,
+                              power=power, burning=burning) \
                 if np_dtype == np.float32 else None
             if nu is None:
                 from distributedmandelbrot_tpu.ops.families import (
@@ -151,8 +178,8 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
                                                 burning=burning,
                                                 dtype=np_dtype)
             return smooth_to_rgba(nu, max_iter, colormap=colormap)
-        values = _pallas_first("compute_tile_family_pallas", spec, max_iter,
-                               power=power, burning=burning) \
+        values = pallas_first("compute_tile_family_pallas", spec, max_iter,
+                              power=power, burning=burning) \
             if np_dtype == np.float32 else None
         if values is None:
             from distributedmandelbrot_tpu.ops import compute_tile_family
@@ -188,8 +215,8 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
     if smooth:
         # f32 smooth throughput path: Pallas on TPU, XLA otherwise
         # (Mandelbrot and Julia both ride the same kernel).
-        nu = _pallas_first("compute_tile_smooth_pallas", spec, max_iter,
-                           julia_c=jc) if np_dtype == np.float32 else None
+        nu = pallas_first("compute_tile_smooth_pallas", spec, max_iter,
+                          julia_c=jc) if np_dtype == np.float32 else None
         if nu is None:
             from distributedmandelbrot_tpu.ops import compute_tile_smooth
             nu = compute_tile_smooth(spec, max_iter, dtype=np_dtype,
@@ -197,10 +224,10 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
         return smooth_to_rgba(nu, max_iter, colormap=colormap)
     if np_dtype == np.float32:
         # Integer f32 fast path, same Pallas-first policy.
-        values = (_pallas_first("compute_tile_pallas", spec, max_iter)
+        values = (pallas_first("compute_tile_pallas", spec, max_iter)
                   if jc is None else
-                  _pallas_first("compute_tile_julia_pallas", spec, jc,
-                                max_iter))
+                  pallas_first("compute_tile_julia_pallas", spec, jc,
+                               max_iter))
         if values is not None:
             return value_to_rgba(values.reshape(spec.height, spec.width),
                                  colormap=colormap)
@@ -512,6 +539,7 @@ def cmd_render(argv: Sequence[str]) -> int:
     parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
                         help="default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
+    _add_no_pallas(parser)
     parser.add_argument("--out", required=True, help="output PNG path")
     _add_common(parser)
     # argparse rejects negative-valued "--c -0.8,0.156" (looks like an
@@ -538,7 +566,8 @@ def cmd_render(argv: Sequence[str]) -> int:
                         np_dtype=_resolve_dtype(args),
                         colormap=args.colormap,
                         deep=True if args.deep else None,
-                        julia_c=julia_c, family=family)
+                        julia_c=julia_c, family=family,
+                        no_pallas=args.no_pallas)
     _save_png(args.out, rgba)
     return 0
 
@@ -575,6 +604,7 @@ def cmd_animate(argv: Sequence[str]) -> int:
     parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
                         help="default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
+    _add_no_pallas(parser)
     parser.add_argument("--out-dir", required=True,
                         help="directory for frame_NNNN.png files")
     _add_common(parser)
@@ -614,7 +644,8 @@ def cmd_animate(argv: Sequence[str]) -> int:
         rgba = _render_view(c_re, c_im, span, args.definition,
                             args.max_iter, smooth=args.smooth,
                             np_dtype=np_dtype, colormap=args.colormap,
-                            deep=deep, julia_c=julia_c, family=family)
+                            deep=deep, julia_c=julia_c, family=family,
+                            no_pallas=args.no_pallas)
         path = os.path.join(args.out_dir, f"frame_{f:04d}.png")
         _save_png(path, rgba)
         print(f"frame {f + 1}/{args.frames} span {span:.3g}"

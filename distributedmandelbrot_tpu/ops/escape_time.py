@@ -80,8 +80,10 @@ INTERIOR_MARGIN = {np.dtype(np.float32): 1e-5, np.dtype(np.float64): 1e-12}
 # Budgets at/above this enable the Brent cycle probe by default (see
 # escape_loop): deep budgets are where in-set pixels missed by the closed
 # forms dominate; shallow budgets lose more to the probe's per-step
-# compares than they save.  The Pallas kernel applies the same policy to
-# its static cap via the same resolve_cycle_check.
+# compares than they save.  The Pallas wrappers resolve the same policy
+# from the tile's REQUESTED budget (before bucket_cap padding), so a
+# shallow tile whose bucket rounds past this threshold never pays the
+# probe.
 CYCLE_CHECK_MIN_ITER = 4096
 
 
@@ -127,7 +129,16 @@ def mandelbrot_interior(c_real, c_imag, margin: float | None = None):
     """
     dtype = jnp.result_type(c_real)
     if margin is None:
-        margin = INTERIOR_MARGIN.get(np.dtype(dtype), 1e-5)
+        try:
+            margin = INTERIOR_MARGIN[np.dtype(dtype)]
+        except KeyError:
+            # The strict-by-margin guarantee is only validated for the
+            # dtypes in the table; for anything narrower (f16/bf16) the
+            # f32 margin would be below one ulp of the test polynomials
+            # and could misclassify — demand an explicit margin instead.
+            raise ValueError(
+                f"no validated interior margin for dtype {dtype}; pass "
+                "margin= explicitly (f32/f64 are supported by default)")
     m = jnp.asarray(margin, dtype)
     y2 = c_imag * c_imag
     xm = c_real - jnp.asarray(0.25, dtype)

@@ -52,6 +52,16 @@ def _pallas():
     from jax.experimental.pallas import tpu as pltpu
     return pl, pltpu
 
+
+class PallasUnsupported(ValueError):
+    """The *intentional* shape/budget rejections of the Pallas dispatch
+    path (tile extent below the block granule, iteration cap needing
+    int64) — the documented cue for callers to fall back to the XLA
+    path.  A subclass of ValueError so pre-existing ``except ValueError``
+    callers keep working, but fall-back sites should catch THIS type:
+    a genuine kernel bug surfacing as a bare ValueError must propagate,
+    not silently degrade to the XLA path (round-2 advisor finding)."""
+
 # Block shape: one early-exit domain.  Swept on a real v5e (2048^2 view,
 # depth 1000, K=8 tiles per dispatch to amortize the tunnel latency):
 # (64,128) and (32,128) tie at the top — ~395 Mpix/s on the full -2..2
@@ -253,10 +263,10 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
     pl, pltpu = _pallas()
     if mrd is None:
         mrd = jnp.asarray([[max_iter]], jnp.int32)
-    # Deep static caps default the Brent probe on: the blocks still live
-    # at depth are exactly the ones held open by in-set pixels the closed
-    # forms miss (higher-period bulbs, minibrots), whose eventual exact-
-    # f32 limit cycles the probe retires (ops.escape_time.escape_loop).
+    # None resolves against THIS call's static cap — the right default
+    # for raw callers (bench chains); the public wrappers resolve from
+    # the tile's requested budget before bucketing and pass a bool, so
+    # bucket padding never turns the probe on for shallow tiles.
     cycle_check = resolve_cycle_check(cycle_check, max_iter)
     kernel = partial(_escape_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
@@ -485,8 +495,9 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
     views); the f64 quality path stays on the XLA kernel.  ``julia_c``
     renders the Julia set for that constant (rides SMEM — sweeping it
     reuses one executable); ``power``/``burning`` the extended families.
-    Same ValueError contract as :func:`compute_tile_pallas_device` for
-    unsupported shapes/budgets/degrees — callers fall back to XLA.
+    Same :class:`PallasUnsupported` contract as
+    :func:`compute_tile_pallas_device` for unsupported shapes/budgets —
+    fall-back sites catch that type (not bare ValueError) and use XLA.
     """
     from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
     from distributedmandelbrot_tpu.ops.families import _check_family
@@ -494,7 +505,8 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
     if julia_c is not None and (power != 2 or burning):
         raise ValueError("julia mode supports the degree-2 recurrence only")
     if max_iter - 1 >= INT32_SCALE_LIMIT:
-        raise ValueError(f"max_iter {max_iter} too deep for the pallas path")
+        raise PallasUnsupported(
+            f"max_iter {max_iter} too deep for the pallas path")
     block_h, block_w = fit_blocks(spec.height, spec.width,
                                   block_h=block_h, block_w=block_w)
     if interpret is None:
@@ -512,7 +524,9 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
                          max_iter=cap, unroll=unroll, block_h=block_h,
                          block_w=block_w, bailout=bailout,
                          interpret=interpret, interior_check=interior_check,
-                         cycle_check=cycle_check, julia=julia_c is not None,
+                         cycle_check=resolve_cycle_check(cycle_check,
+                                                         max_iter),
+                         julia=julia_c is not None,
                          power=power, burning=burning)
     return np.asarray(out)
 
@@ -560,7 +574,8 @@ def _fit_block(extent: int, block: int, floor: int) -> int:
     while fit >= floor and extent % fit:
         fit //= 2
     if fit < floor or fit % floor:
-        raise ValueError(f"tile extent {extent} unsupported by pallas path")
+        raise PallasUnsupported(
+            f"tile extent {extent} unsupported by pallas path")
     return fit
 
 
@@ -568,7 +583,7 @@ def fit_blocks(height: int, width: int, *,
                block_h: int = DEFAULT_BLOCK_H,
                block_w: int | None = None) -> tuple[int, int]:
     """The (block_h, block_w) the kernel will actually use for a tile, with
-    granule validation — raises ValueError for unsupported extents.  Every
+    granule validation — raises PallasUnsupported for bad extents.  Every
     caller of :func:`_pallas_escape` must size blocks through here, or a
     non-divisible tile silently computes only ``extent // block`` blocks."""
     if block_w is None:
@@ -603,8 +618,9 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
         raise ValueError("julia mode supports the degree-2 recurrence only")
     if max_iter - 1 >= INT32_SCALE_LIMIT:
         # In-kernel scaling is int32; such budgets need the XLA path
-        # (callers catch ValueError and fall back).
-        raise ValueError(f"max_iter {max_iter} too deep for the pallas path")
+        # (fall-back sites catch PallasUnsupported specifically).
+        raise PallasUnsupported(
+            f"max_iter {max_iter} too deep for the pallas path")
     block_h, block_w = fit_blocks(spec.height, spec.width,
                                   block_h=block_h, block_w=block_w)
     if interpret is None:
@@ -618,12 +634,17 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
     params = jnp.asarray([row], jnp.float32)
     cap = bucket_cap(max_iter)
     mrd = jnp.asarray([[max_iter]], jnp.int32)
+    # Probe policy follows the tile's ACTUAL budget, not the padded
+    # compile cap: a shallow tile whose bucket rounds up past the probe
+    # threshold must not pay the probe's per-step compares and snapshot
+    # VMEM (round-2 advisor finding).
     return _pallas_escape(params, mrd, height=spec.height, width=spec.width,
                           max_iter=cap, unroll=unroll, block_h=block_h,
                           block_w=block_w, clamp=clamp, interpret=interpret,
                           interior_check=interior_check
                           and julia_c is None,
-                          cycle_check=cycle_check,
+                          cycle_check=resolve_cycle_check(cycle_check,
+                                                          max_iter),
                           julia=julia_c is not None, power=power,
                           burning=burning)
 
@@ -640,9 +661,10 @@ def compute_tile_family_pallas(spec: TileSpec, max_iter: int, *,
 
     Same block-granular early exit and cycle probe as the Mandelbrot
     kernel; the degree-2 ship costs one extra abs per step (squares are
-    abs-invariant, so the cached-squares form survives the fold).  Same
-    ValueError contract as the XLA family path (parameter validation
-    included) for unsupported shapes/budgets/degrees.
+    abs-invariant, so the cached-squares form survives the fold).
+    Unsupported shapes/budgets raise :class:`PallasUnsupported`; invalid
+    family parameters raise the XLA path's plain ValueError (a user
+    error on every path, not a fall-back cue).
     """
     out = compute_tile_pallas_device(spec, max_iter, unroll=unroll,
                                      block_h=block_h, block_w=block_w,
@@ -663,8 +685,9 @@ def compute_tile_julia_pallas(spec: TileSpec, c: complex, max_iter: int, *,
 
     The constant rides SMEM as traced scalars, so sweeping ``c`` — a
     Julia animation — reuses one compiled executable, matching the XLA
-    path's behavior (escape_time.escape_counts_julia).  Same ValueError
-    contract for unsupported shapes/budgets as the Mandelbrot wrapper.
+    path's behavior (escape_time.escape_counts_julia).  Same
+    :class:`PallasUnsupported` contract for unsupported shapes/budgets
+    as the Mandelbrot wrapper.
     """
     out = compute_tile_pallas_device(spec, max_iter, unroll=unroll,
                                      block_h=block_h, block_w=block_w,

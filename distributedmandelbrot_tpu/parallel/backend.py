@@ -64,15 +64,19 @@ class MeshBackend:
             mrds[i] = w.max_iter
         pixels = None
         if self._use_pallas():
+            from distributedmandelbrot_tpu.ops.pallas_escape import (
+                PallasUnsupported)
             from distributedmandelbrot_tpu.parallel.sharding import (
                 batched_escape_pixels_pallas)
             try:
                 pixels = batched_escape_pixels_pallas(
                     self.mesh, params, mrds, definition=self.definition)
-            except ValueError:
+            except PallasUnsupported:
+                # Intentional granule/cap rejection -> XLA path; genuine
+                # kernel errors propagate (see PallasUnsupported).
                 if self.kernel == "pallas":
                     raise
-                pixels = None  # granule/cap mismatch -> XLA path
+                pixels = None
         if pixels is None:
             pixels = batched_escape_pixels(self.mesh, params, mrds,
                                            definition=self.definition,

@@ -189,11 +189,13 @@ def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
 
 @partial(jax.jit,
          static_argnames=("mesh", "definition", "max_iter_cap", "unroll",
-                          "block_h", "block_w", "clamp", "interpret"))
+                          "block_h", "block_w", "clamp", "interpret",
+                          "cycle_check"))
 def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
                             max_iter_cap: int, unroll: int, block_h: int,
                             block_w: int, clamp: bool,
-                            interpret: bool = False):
+                            interpret: bool = False,
+                            cycle_check: bool | None = None):
     """The Pallas kernel under shard_map: each device walks its tile shard
     sequentially, every tile running the block-early-exit kernel with its
     own traced budget (static cap = the batch max)."""
@@ -204,7 +206,7 @@ def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
                               height=definition, width=definition,
                               max_iter=max_iter_cap, unroll=unroll,
                               block_h=block_h, block_w=block_w, clamp=clamp,
-                              interpret=interpret)
+                              interpret=interpret, cycle_check=cycle_check)
 
     def shard_fn(p_shard, m_shard):
         return lax.map(lambda args: one_tile(*args), (p_shard, m_shard))
@@ -221,24 +223,30 @@ def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
 def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
                                  mrds: np.ndarray, *, definition: int,
                                  clamp: bool = False,
-                                 interpret: bool | None = None) -> np.ndarray:
+                                 interpret: bool | None = None,
+                                 cycle_check: bool | None = None
+                                 ) -> np.ndarray:
     """Pallas-kernel twin of :func:`batched_escape_pixels` (f32 only).
 
-    Raises ValueError when the tile shape doesn't fit the kernel's block
-    granule or the iteration cap needs int64 — callers fall back to the
-    XLA path (see :meth:`MeshBackend.compute_batch`).
+    Raises :class:`~...ops.pallas_escape.PallasUnsupported` when the tile
+    shape doesn't fit the kernel's block granule or the iteration cap
+    needs int64 — callers fall back to the XLA path (see
+    :meth:`MeshBackend.compute_batch`).
     """
-    from distributedmandelbrot_tpu.ops.pallas_escape import (fit_blocks,
-                                                             pallas_available,
-                                                             DEFAULT_UNROLL)
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        PallasUnsupported, fit_blocks, pallas_available, DEFAULT_UNROLL)
 
     k = starts_steps.shape[0]
     if k == 0:
         return np.zeros((0, definition, definition), np.uint8)
     cap = int(mrds.max())
     if cap - 1 >= INT32_SCALE_LIMIT:
-        raise ValueError("pallas path is int32-only; cap needs the XLA path")
+        raise PallasUnsupported(
+            "pallas path is int32-only; cap needs the XLA path")
     from distributedmandelbrot_tpu.ops.pallas_escape import bucket_cap
+    # Probe policy from the batch's true deepest budget, not the padded
+    # compile cap (same policy as compute_tile_pallas_device).
+    cycle_check = resolve_cycle_check(cycle_check, cap)
     cap = bucket_cap(cap)
     block_h, block_w = fit_blocks(definition, definition)
     if interpret is None:
@@ -252,7 +260,8 @@ def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
                                   definition=definition, max_iter_cap=cap,
                                   unroll=DEFAULT_UNROLL, block_h=block_h,
                                   block_w=block_w, clamp=clamp,
-                                  interpret=interpret)
+                                  interpret=interpret,
+                                  cycle_check=cycle_check)
     return np.asarray(out)[:k]
 
 

@@ -118,15 +118,18 @@ class PallasBackend:
         # Two-phase: dispatch every tile's kernel first (the device queue
         # runs them back to back), then materialize — compute of tile k
         # overlaps the device->host transfer of tile k-1.
+        from distributedmandelbrot_tpu.ops.pallas_escape import (
+            PallasUnsupported)
         pending: list = []
         for w in workloads:
             spec = _spec_for(w, self.definition)
             try:
                 pending.append(self._dispatch(spec, w.max_iter,
                                               clamp=self.clamp))
-            except ValueError:
-                # Tile smaller than the kernel's (32, 128) block granule —
-                # the XLA path handles any shape.
+            except PallasUnsupported:
+                # Tile smaller than the kernel's (32, 128) block granule
+                # or budget past the int32 cap — the XLA path handles
+                # both; other errors propagate (see PallasUnsupported).
                 pending.append(escape_time.compute_tile(spec, w.max_iter,
                                                         clamp=self.clamp))
         return [np.asarray(p).ravel() for p in pending]

@@ -102,3 +102,13 @@ def test_animate_spans_shallow_and_deep(tmp_path):
     frames = sorted(p.name for p in tmp_path.iterdir())
     assert frames == ["frame_0000.png", "frame_0001.png", "frame_0002.png"]
     assert _png_size(tmp_path / "frame_0002.png") == (48, 48)
+
+
+def test_render_no_pallas_flag(tmp_path):
+    """--no-pallas forces the XLA/host-grid path (grid-convention escape
+    hatch documented in the render help; on the CPU config both paths
+    already agree, so this exercises the flag plumbing)."""
+    out = tmp_path / "np.png"
+    rc = cli.main(["render", "--definition", "64", "--max-iter", "64",
+                   "--span", "3.0", "--no-pallas", "--out", str(out)])
+    assert rc == 0 and out.exists()

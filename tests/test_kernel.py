@@ -399,3 +399,20 @@ def test_julia_smooth_classification_and_reuse():
         assert mismatch <= 5e-4, f"c={c}: {mismatch:.2%} divergence"
     # One compilation serves all three constants (same shapes/dtype).
     assert _escape_smooth_jit._cache_size() - before <= 1
+
+
+def test_interior_margin_rejects_unvalidated_dtypes():
+    """The strict-by-margin guarantee is validated for f32/f64 only; an
+    f16 input without an explicit margin must raise instead of silently
+    using a margin below one ulp of the test polynomials (round-2
+    advisor finding)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from distributedmandelbrot_tpu.ops.escape_time import mandelbrot_interior
+
+    c = jnp.zeros((4, 4), jnp.float16)
+    with pytest.raises(ValueError, match="no validated interior margin"):
+        mandelbrot_interior(c, c)
+    # An explicit margin opts in.
+    assert bool(mandelbrot_interior(c, c, margin=1e-2).any())

@@ -388,3 +388,69 @@ def test_pallas_smooth_unsupported_budget_raises():
     with pytest.raises(ValueError):
         compute_tile_smooth_pallas(spec, INT32_SCALE_LIMIT + 2,
                                    interpret=True)
+
+
+def test_pallas_unsupported_is_dedicated_type():
+    """The intentional shape/budget rejections raise PallasUnsupported (a
+    ValueError subclass) so fall-back sites can catch exactly them and a
+    genuine kernel bug surfacing as ValueError propagates (round-2
+    advisor finding)."""
+    from distributedmandelbrot_tpu.ops.escape_time import INT32_SCALE_LIMIT
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        PallasUnsupported, compute_tile_pallas_device, fit_blocks)
+
+    assert issubclass(PallasUnsupported, ValueError)
+    with pytest.raises(PallasUnsupported):
+        fit_blocks(28, 128)  # below the 32-sublane granule
+    spec = TileSpec(-0.8, 0.1, 0.2, 0.2, width=128, height=128)
+    with pytest.raises(PallasUnsupported):
+        compute_tile_pallas_device(spec, INT32_SCALE_LIMIT + 2,
+                                   interpret=True)
+
+
+def test_pallas_first_propagates_non_unsupported_errors(monkeypatch):
+    """cli._pallas_first falls back ONLY on PallasUnsupported; any other
+    ValueError from the kernel chain must surface."""
+    from distributedmandelbrot_tpu import cli
+    from distributedmandelbrot_tpu.ops import pallas_escape as pe
+
+    monkeypatch.setattr(pe, "pallas_available", lambda: True)
+    monkeypatch.setattr(pe, "compute_tile_pallas",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            ValueError("genuine bug")),
+                        raising=False)
+    with pytest.raises(ValueError, match="genuine bug"):
+        cli._pallas_first("compute_tile_pallas", None, 10)
+    monkeypatch.setattr(pe, "compute_tile_pallas",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            pe.PallasUnsupported("declined")),
+                        raising=False)
+    assert cli._pallas_first("compute_tile_pallas", None, 10) is None
+
+
+def test_cycle_probe_follows_requested_budget(monkeypatch):
+    """The Brent probe resolves from the tile's ACTUAL budget, not the
+    bucketed compile cap: max_iter=3000 buckets to a 4096 cap (>= the
+    probe threshold) but must not pay the probe (round-2 advisor
+    finding)."""
+    from distributedmandelbrot_tpu.ops import pallas_escape as pe
+    from distributedmandelbrot_tpu.ops.escape_time import (
+        CYCLE_CHECK_MIN_ITER)
+
+    seen = {}
+    real = pe._pallas_escape
+
+    def spy(*args, **kwargs):
+        seen.update(kwargs)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(pe, "_pallas_escape", spy)
+    # Sky-only view: every pixel escapes in the first segment, so the
+    # deep budget costs nothing in interpret mode.
+    spec = TileSpec(1.5, 1.5, 0.1, 0.1, width=128, height=32)
+    pe.compute_tile_pallas_device(spec, 3000, interpret=True)
+    assert seen["max_iter"] == pe.bucket_cap(3000) >= CYCLE_CHECK_MIN_ITER
+    assert seen["cycle_check"] is False
+    pe.compute_tile_pallas_device(spec, CYCLE_CHECK_MIN_ITER,
+                                  interpret=True)
+    assert seen["cycle_check"] is True
