@@ -49,18 +49,27 @@ def _mesh_and_kernel():
     return jax, mesh, batched_escape_pixels
 
 
-def _bench_params(tile: int, tiles: int):
-    # One batch = `tiles` sub-tiles of a FIXED 4x4 seahorse window; batches
-    # larger than 16 cycle through the same 16 sub-windows, so growing the
-    # batch amortizes dispatch latency without drifting the view toward
-    # easier (faster-escaping) regions.
-    span = 0.005
+def _grid_params(center, span: float, tile: int, tiles: int) -> np.ndarray:
+    """(tiles, 3) params covering a FIXED 4x4 grid of sub-windows of the
+    view: batches larger than 16 cycle through the same 16 sub-windows,
+    so growing the batch amortizes dispatch latency without drifting the
+    view toward easier (faster-escaping) regions.  The single copy of
+    the sub-window scheme — the seahorse headline and the worst-case
+    configs must never diverge in methodology."""
+    sub = span / 4
+    x0, y0 = center[0] - span / 2, center[1] - span / 2
     params = np.empty((tiles, 3))
     for i in range(tiles):
-        params[i] = (SEAHORSE[0] + (i % 4) * span,
-                     SEAHORSE[1] + ((i // 4) % 4) * span,
-                     span / (tile - 1))
+        params[i] = (x0 + (i % 4) * sub, y0 + ((i // 4) % 4) * sub,
+                     sub / (tile - 1))
     return params
+
+
+def _bench_params(tile: int, tiles: int):
+    # The historical seahorse window: 4x4 sub-tiles of span 0.005 corner-
+    # anchored at SEAHORSE (== a 0.02 window centered half a span up-right).
+    return _grid_params((SEAHORSE[0] + 0.01, SEAHORSE[1] + 0.01), 0.02,
+                        tile, tiles)
 
 
 def _time_chain(fn, repeats: int) -> float:
@@ -76,9 +85,13 @@ def _time_chain(fn, repeats: int) -> float:
     return times[len(times) // 2]
 
 
-def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int):
+def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
+                  **kernel_kw):
     """One jitted call: lax.map of the Pallas kernel over K tiles,
-    each reduced to a checksum on device."""
+    each reduced to a checksum on device.  ``kernel_kw`` passes static
+    kernel options through (interior_check/cycle_check for raw-loop
+    timing, power/burning for the extended families, interpret for the
+    CPU config)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -96,7 +109,7 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int):
         def one(p):
             out = _pallas_escape(p[None, :], height=tile, width=tile,
                                  max_iter=max_iter, block_h=block_h,
-                                 block_w=block_w)
+                                 block_w=block_w, **kernel_kw)
             # dtypes pinned: under x64 a bare sum would accumulate in
             # int64, which this TPU generation does not support.
             return jnp.sum(out.astype(jnp.int32), dtype=jnp.int32)
@@ -145,7 +158,8 @@ def _pallas_sharded_chain(mesh, params_np: np.ndarray, mrds: np.ndarray,
 
 
 def _xla_chain(mesh, params_np: np.ndarray, mrds: np.ndarray, tile: int,
-               segment: int, np_dtype):
+               segment: int, np_dtype, *, interior_check: bool = True,
+               cycle_check: bool | None = None):
     """The sharded XLA path, reduced on device (same methodology)."""
     import jax
     import jax.numpy as jnp
@@ -170,7 +184,9 @@ def _xla_chain(mesh, params_np: np.ndarray, mrds: np.ndarray, tile: int,
     def run(params, mrd_arr):
         out = _batched_escape_sharded(params, mrd_arr, mesh=mesh,
                                       definition=tile, max_iter_cap=cap,
-                                      segment=segment, clamp=False)
+                                      segment=segment, clamp=False,
+                                      cycle_check=cycle_check,
+                                      interior_check=interior_check)
         return jnp.sum(out.astype(jnp.int32), dtype=jnp.int32)
 
     return lambda: run(params, mrd_arr)
@@ -399,13 +415,98 @@ def bench_config5(repeats: int, segment: int) -> dict:
             "value": round(v, 2), "unit": "Mpix/s"}
 
 
+# Boundary-only views: windows crossing NO provable interior (verified
+# 0.0000% mandelbrot_interior coverage at these coordinates), where the
+# interior shortcut cannot help and throughput reverts to the raw masked
+# loop — the number that governs worst-case renders.  The ship window has
+# no closed-form interior at all (family_interior returns None).
+WORST_VIEWS = {
+    "filament": {"center": (-0.7436447, 0.1318252), "span": 2e-3,
+                 "max_iter": 2000, "burning": False},
+    "ship": {"center": (-1.7443, -0.0356), "span": 0.01,
+             "max_iter": 1000, "burning": True},
+}
+
+
+def bench_worstcase(repeats: int, *, tile: int | None = None,
+                    tiles: int | None = None) -> dict:
+    """Boundary-only views, raw (shortcut-less) vs full-shortcut numbers
+    per view.  The headline `value` is the WORST per-view best — the
+    throughput floor a user can hit on views the interior shortcut
+    cannot touch.  Runs the Pallas kernel on TPU (compiled) and falls
+    back to the XLA chain off-TPU (the interpreter would distort raw-loop
+    timing)."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import pallas_available
+
+    jax, mesh, _ = _mesh_and_kernel()
+    on_tpu = pallas_available()
+    if tile is None:
+        tile = 1024 if on_tpu else 256
+    if tiles is None:
+        tiles = 16 if on_tpu else 4
+    out: dict = {}
+    skipped: list[str] = []
+    floor = float("inf")
+    for name, view in WORST_VIEWS.items():
+        params = _grid_params(view["center"], view["span"], tile, tiles)
+        mi = view["max_iter"]
+        pixels = tiles * tile * tile
+        per_path: dict[str, float] = {}
+        if on_tpu:
+            kw = {"burning": True} if view["burning"] else {}
+            per_path["raw"] = pixels / _time_chain(
+                _pallas_chain(params, tile, mi, interior_check=False,
+                              cycle_check=False, **kw), repeats) / 1e6
+            per_path["full"] = pixels / _time_chain(
+                _pallas_chain(params, tile, mi, **kw), repeats) / 1e6
+        elif not view["burning"]:
+            # CPU fallback control: XLA chain only (no ship support in
+            # the sharded XLA path), marked by the cpu_fallback flag.
+            mrds = np.full(tiles, mi, np.int64)
+            per_path["raw"] = pixels / _time_chain(
+                _xla_chain(mesh, params, mrds, tile, 256, np.float32,
+                           interior_check=False, cycle_check=False),
+                repeats) / 1e6
+            per_path["full"] = pixels / _time_chain(
+                _xla_chain(mesh, params, mrds, tile, 256, np.float32),
+                repeats) / 1e6
+        else:
+            skipped.append(name)
+            continue
+        for path, v in per_path.items():
+            out[f"{name}_{path}_mpix_s"] = round(v, 2)
+        floor = min(floor, max(per_path.values()))
+    if skipped:
+        # No silent coverage caps: a CPU run measures fewer views than a
+        # TPU run, and the floor must say so.
+        out["skipped_views"] = skipped
+    out = {
+        "metric": f"worst-case boundary views ({tiles}x{tile}^2, "
+                  f"no provable interior; floor of per-view best"
+                  + (f"; skipped: {','.join(skipped)}" if skipped else "")
+                  + ")",
+        "value": round(floor, 2), "unit": "Mpix/s",
+        "vs_baseline": round(floor / NORTH_STAR_MPIX_S, 4),
+        **out,
+    }
+    return out
+
+
 def bench_farm(repeats: int, *, levels: str = "3:1000",
-               definition: int = 4096, batch_size: int = 3) -> dict:
+               definition: int = 4096, batch_size: int = 3,
+               backend_name: str = "auto") -> dict:
     """Production shape: coordinator + worker over loopback TCP, 4096^2
     chunks, batched dispatch, full pipeline (lease -> compute -> upload ->
     persist).  Real materialization everywhere — on this rig the device->
     host tunnel (~35 MB/s) dominates; on a co-located TPU host the same
-    path runs at PCIe rates."""
+    path runs at PCIe rates.
+
+    The JSON line carries a per-phase breakdown (lease / compute / upload
+    / persist seconds and shares, plus the device idle fraction) so the
+    tunnel cost is separable from the framework cost; run with
+    ``backend_name="native"`` (CLI: ``--farm-backend native``) as the
+    no-device control — any phase share that persists there is framework
+    overhead, not tunnel."""
     import tempfile
 
     from distributedmandelbrot_tpu.cli import parse_level_settings
@@ -419,7 +520,12 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
 
     with tempfile.TemporaryDirectory() as tmp, \
             EmbeddedCoordinator(tmp, settings) as co:
-        backend = auto_backend(definition=definition)
+        if backend_name == "auto":
+            backend = auto_backend(definition=definition)
+        else:
+            from distributedmandelbrot_tpu.cli import _make_backend
+            backend = _make_backend(backend_name, "f32", "auto",
+                                    definition=definition)
         client = DistributerClient("127.0.0.1", co.distributer_port)
         worker = Worker(client, backend, batch_size=batch_size,
                         overlap_io=True)
@@ -427,6 +533,7 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
         from distributedmandelbrot_tpu.core.workload import Workload
         backend.compute_batch([Workload(settings[0].level,
                                         settings[0].max_iter, 0, 0)])
+        phase0 = dict(getattr(backend, "phase_us", {}))
         t0 = time.perf_counter()
         while True:
             r0 = time.perf_counter()
@@ -438,19 +545,46 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
             per_round.append((time.perf_counter() - r0, n_round))
         co.wait_saves_settled(expected_accepted=n_tiles, timeout=600)
         total = time.perf_counter() - t0
-        backend_name = type(backend).__name__
+        wc = worker.counters.snapshot()
+        cc = co.counters.snapshot()
+        phase1 = dict(getattr(backend, "phase_us", {}))
+        backend_cls = type(backend).__name__
 
     # One per-tile sample per tile actually leased that round (the last
     # round is usually short).
     per_tile = sorted(dt / k for dt, k in per_round if k for _ in range(k))
     p50 = per_tile[len(per_tile) // 2] if per_tile else float("nan")
     pixels = n_tiles * definition * definition
-    return {"metric": f"farm e2e {levels} {n_tiles}x{definition}^2 "
-                      f"batched-dispatch ({backend_name}, incl. upload + "
-                      f"persist)",
-            "value": round(_mpix(pixels, total), 2), "unit": "Mpix/s",
-            "p50_tile_turnaround_s": round(p50, 3),
-            "total_s": round(total, 2)}
+    out = {"metric": f"farm e2e {levels} {n_tiles}x{definition}^2 "
+                     f"batched-dispatch ({backend_cls}, incl. upload + "
+                     f"persist)",
+           "value": round(_mpix(pixels, total), 2), "unit": "Mpix/s",
+           "p50_tile_turnaround_s": round(p50, 3),
+           "total_s": round(total, 2)}
+    # Phase breakdown.  lease/compute are on the worker's critical path;
+    # upload rides the overlap-IO thread and persist the coordinator's
+    # save tasks, so their shares can exceed what the wall clock shows —
+    # a share > ~1.0 of either means the pipeline is hiding it well, not
+    # that the clock is wrong.  Device idle fraction ~= the critical
+    # path's non-compute share (only meaningful for device backends).
+    phases = {"lease": wc.get("lease_us", 0) / 1e6,
+              "compute": wc.get("compute_us", 0) / 1e6,
+              "upload": wc.get("upload_us", 0) / 1e6,
+              "persist": cc.get("persist_us", 0) / 1e6}
+    for name, secs in phases.items():
+        out[f"{name}_s"] = round(secs, 2)
+        out[f"{name}_share"] = round(secs / total, 3) if total else 0.0
+    if phase1:
+        # PallasBackend's split of compute: host dispatch vs materialize
+        # (device completion wait + D2H — the tunnel, on this rig).
+        out["compute_dispatch_s"] = round(
+            (phase1.get("dispatch", 0) - phase0.get("dispatch", 0)) / 1e6, 2)
+        out["compute_materialize_s"] = round(
+            (phase1.get("materialize", 0)
+             - phase0.get("materialize", 0)) / 1e6, 2)
+    out["device_idle_frac"] = round(
+        max(0.0, 1.0 - phases["compute"] / total), 3) if total else 0.0
+    return out
 
 
 def _ensure_live_backend(probe_timeout: float = 120.0) -> bool:
@@ -487,6 +621,15 @@ def main() -> int:
                              "headline metric")
     parser.add_argument("--farm", action="store_true",
                         help="run only the production-shape farm config")
+    parser.add_argument("--farm-backend", default="auto",
+                        choices=["auto", "jax", "pallas", "numpy", "native",
+                                 "mesh"],
+                        help="compute backend for the farm config; 'native' "
+                             "is the no-device control that isolates "
+                             "framework overhead from tunnel/device cost")
+    parser.add_argument("--worst", action="store_true",
+                        help="run only the worst-case boundary-view config "
+                             "(raw vs shortcut per view)")
     args = parser.parse_args()
     fell_back = _ensure_live_backend()
 
@@ -497,7 +640,11 @@ def main() -> int:
         print(json.dumps(result), flush=True)
 
     if args.farm:
-        emit(bench_farm(args.repeats))
+        emit(bench_farm(args.repeats, backend_name=args.farm_backend))
+        return 0
+
+    if args.worst:
+        emit(bench_worstcase(args.repeats))
         return 0
 
     if args.all:
@@ -507,6 +654,7 @@ def main() -> int:
                    lambda r: bench_config3(r, args.segment),
                    bench_config4,
                    lambda r: bench_config5(r, args.segment),
+                   bench_worstcase,
                    bench_farm):
             try:
                 emit(fn(args.repeats))

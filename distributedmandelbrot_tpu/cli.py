@@ -338,30 +338,32 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
     return 0
 
 
-def _make_backend(name: str, dtype: str, kernel: str = "auto"):
+def _make_backend(name: str, dtype: str, kernel: str = "auto",
+                  definition: int | None = None):
     np_dtype = _NP_DTYPES[dtype]
+    kw = {} if definition is None else {"definition": definition}
     if name == "numpy":
         from distributedmandelbrot_tpu.worker import NumpyBackend
-        return NumpyBackend()
+        return NumpyBackend(**kw)
     if name == "native":
         from distributedmandelbrot_tpu.worker import NativeBackend
-        return NativeBackend()
+        return NativeBackend(**kw)
     if name == "jax":
         from distributedmandelbrot_tpu.worker import JaxBackend
-        return JaxBackend(dtype=np_dtype)
+        return JaxBackend(dtype=np_dtype, **kw)
     if name == "pallas":
         if dtype != "f32":
             raise SystemExit(
                 "--backend pallas is f32-only (the TPU throughput path); "
                 "use --backend jax for f64")
         from distributedmandelbrot_tpu.worker import PallasBackend
-        return PallasBackend()
+        return PallasBackend(**kw)
     if name == "auto":
         from distributedmandelbrot_tpu.worker import auto_backend
-        return auto_backend(dtype=np_dtype)
+        return auto_backend(dtype=np_dtype, **kw)
     if name == "mesh":
         from distributedmandelbrot_tpu.parallel import MeshBackend
-        return MeshBackend(dtype=np_dtype, kernel=kernel)
+        return MeshBackend(dtype=np_dtype, kernel=kernel, **kw)
     raise ValueError(f"unknown backend {name!r}")
 
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 import numpy as np
@@ -229,7 +230,10 @@ class Distributer:
 
     async def _save_chunk(self, w: Workload, chunk: Chunk) -> None:
         try:
+            t0 = time.monotonic()
             await asyncio.to_thread(self.store.save, chunk)
+            self.counters.inc("persist_us",
+                              int((time.monotonic() - t0) * 1e6))
             self.counters.inc("chunks_saved")
             logger.info("saved chunk %s", chunk.key)
         except Exception:

@@ -80,6 +80,10 @@ class EmbeddedCoordinator:
         return self.coordinator.scheduler
 
     @property
+    def counters(self):
+        return self.coordinator.counters
+
+    @property
     def store(self):
         return self.coordinator.store
 

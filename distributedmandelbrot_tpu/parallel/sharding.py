@@ -59,9 +59,11 @@ def _device_grid(start_r, start_i, step, shape, dtype, row_offset=0):
 
 
 def _masked_escape(c_real, c_imag, max_iter_cap: int, segment: int,
-                   cycle_check: bool | None = None):
+                   cycle_check: bool | None = None,
+                   interior_check: bool = True):
     """The segmented escape loop (ops.escape_time.escape_loop; see there
-    for the recurrence and count recovery)."""
+    for the recurrence and count recovery).  The shortcut toggles are
+    output-identical; off only for timing the raw loop (bench)."""
     total_steps = max_iter_cap - 1
     if total_steps <= 0:
         return jnp.zeros(c_real.shape, jnp.int32)
@@ -78,7 +80,7 @@ def _masked_escape(c_real, c_imag, max_iter_cap: int, segment: int,
     # closed-form interior shortcut always applies (output-identical;
     # see ops.escape_time.mandelbrot_interior); deep budgets also get the
     # Brent cycle probe (same policy as escape_counts).
-    interior = mandelbrot_interior(zr0, zi0)
+    interior = mandelbrot_interior(zr0, zi0) if interior_check else None
     return escape_loop(zr0, zi0, c_real, c_imag, total_steps=total_steps,
                        segment=segment, interior=interior,
                        cycle_check=resolve_cycle_check(cycle_check,
@@ -98,13 +100,15 @@ def _scale_pixels(counts, mrd, clamp: bool):
 
 def _one_tile_pixels(params, mrd, *, definition: int, max_iter_cap: int,
                      segment: int, clamp: bool,
-                     cycle_check: bool | None = None):
+                     cycle_check: bool | None = None,
+                     interior_check: bool = True):
     """params = (start_r, start_i, step) scalars; mrd = per-tile budget."""
     start_r, start_i, step = params[0], params[1], params[2]
     c_real, c_imag = _device_grid(start_r, start_i, step,
                                   (definition, definition), params.dtype)
     counts = _masked_escape(c_real, c_imag, max_iter_cap, segment,
-                            cycle_check=cycle_check)
+                            cycle_check=cycle_check,
+                            interior_check=interior_check)
     counts = jnp.where(counts <= mrd - 1, counts, 0)
     if max_iter_cap - 1 >= INT32_SCALE_LIMIT:
         counts = counts.astype(jnp.int64)
@@ -134,13 +138,14 @@ def pad_to_mesh(starts_steps: np.ndarray, mrds: np.ndarray,
 
 @partial(jax.jit,
          static_argnames=("mesh", "definition", "max_iter_cap", "segment",
-                          "clamp", "cycle_check"))
+                          "clamp", "cycle_check", "interior_check"))
 def _batched_escape_sharded(params, mrds, *, mesh: Mesh, definition: int,
                             max_iter_cap: int, segment: int, clamp: bool,
-                            cycle_check: bool | None = None):
+                            cycle_check: bool | None = None,
+                            interior_check: bool = True):
     tile_fn = partial(_one_tile_pixels, definition=definition,
                       max_iter_cap=max_iter_cap, segment=segment, clamp=clamp,
-                      cycle_check=cycle_check)
+                      cycle_check=cycle_check, interior_check=interior_check)
 
     def shard_fn(p_shard, m_shard):
         # Sequential walk of this device's tiles: each keeps its own
@@ -156,7 +161,8 @@ def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
                           mrds: np.ndarray, *, definition: int,
                           dtype=np.float32, segment: int = DEFAULT_SEGMENT,
                           clamp: bool = False,
-                          cycle_check: bool | None = None) -> np.ndarray:
+                          cycle_check: bool | None = None,
+                          interior_check: bool = True) -> np.ndarray:
     """Compute a batch of tiles sharded over ``mesh``'s ``tiles`` axis.
 
     ``starts_steps``: float (k, 3) of ``(start_real, start_imag, step)``;
@@ -183,7 +189,8 @@ def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
     out = _batched_escape_sharded(params, mrd_arr, mesh=mesh,
                                   definition=definition, max_iter_cap=cap,
                                   segment=segment, clamp=clamp,
-                                  cycle_check=cycle_check)
+                                  cycle_check=cycle_check,
+                                  interior_check=interior_check)
     return np.asarray(out)[:k]
 
 

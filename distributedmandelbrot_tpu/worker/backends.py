@@ -19,6 +19,7 @@ into one device dispatch; scalar backends just loop.
 
 from __future__ import annotations
 
+import time
 from typing import Protocol, Sequence
 
 import numpy as np
@@ -113,6 +114,11 @@ class PallasBackend:
         self._dispatch = compute_tile_pallas_device
         self.definition = definition
         self.clamp = clamp
+        # Cumulative phase split for the farm bench's breakdown: host-side
+        # dispatch/queue time vs materialize time (the latter includes the
+        # wait for device completion AND the device->host transfer — on a
+        # tunneled rig it measures the tunnel).
+        self.phase_us = {"dispatch": 0, "materialize": 0}
 
     def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
         # Two-phase: dispatch every tile's kernel first (the device queue
@@ -120,6 +126,7 @@ class PallasBackend:
         # overlaps the device->host transfer of tile k-1.
         from distributedmandelbrot_tpu.ops.pallas_escape import (
             PallasUnsupported)
+        t0 = time.monotonic()
         pending: list = []
         for w in workloads:
             spec = _spec_for(w, self.definition)
@@ -132,7 +139,12 @@ class PallasBackend:
                 # both; other errors propagate (see PallasUnsupported).
                 pending.append(escape_time.compute_tile(spec, w.max_iter,
                                                         clamp=self.clamp))
-        return [np.asarray(p).ravel() for p in pending]
+        t1 = time.monotonic()
+        out = [np.asarray(p).ravel() for p in pending]
+        self.phase_us["dispatch"] += int((t1 - t0) * 1e6)
+        self.phase_us["materialize"] += int(
+            (time.monotonic() - t1) * 1e6)
+        return out
 
 
 def auto_backend(definition: int = CHUNK_WIDTH,

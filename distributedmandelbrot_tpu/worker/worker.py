@@ -55,10 +55,17 @@ class Worker:
         return self.client.request_batch(self.batch_size)
 
     def _submit(self, results: Sequence[tuple[Workload, np.ndarray]]) -> None:
+        t0 = time.monotonic()
         if len(results) == 1:
             accepted = [self.client.submit(*results[0])]
         else:
             accepted = self.client.submit_batch(results)
+        # Timed here so both the inline and the overlap-IO thread path
+        # feed the same counter (bench_farm's phase breakdown).
+        # Microsecond units: sub-ms loopback events would floor to zero
+        # in ms and hide exactly the overheads the breakdown exposes.
+        self.counters.inc("upload_us",
+                          int((time.monotonic() - t0) * 1e6))
         n_ok = sum(accepted)
         self.counters.inc("results_accepted", n_ok)
         self.counters.inc("results_rejected", len(accepted) - n_ok)
@@ -86,7 +93,10 @@ class Worker:
 
     def run_once(self) -> bool:
         """One pull/compute/submit round; False when no work was available."""
+        t_lease = time.monotonic()
         workloads = self._acquire()
+        self.counters.inc("lease_us",
+                          int((time.monotonic() - t_lease) * 1e6))
         if not workloads:
             self._join_upload()
             return False
@@ -94,7 +104,7 @@ class Worker:
         pixels = self.backend.compute_batch(workloads)
         compute_s = time.monotonic() - t0
         self.counters.inc("tiles_computed", len(workloads))
-        self.counters.inc("compute_ms", int(compute_s * 1000))
+        self.counters.inc("compute_us", int(compute_s * 1e6))
         logger.info("computed %d tiles in %.2fs", len(workloads), compute_s)
         results = list(zip(workloads, pixels))
         self._join_upload()  # previous batch must land before the next starts
