@@ -318,15 +318,22 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
     _configure_channel_logging(args)
 
     from distributedmandelbrot_tpu.coordinator import Coordinator
+    from distributedmandelbrot_tpu.storage.ownership import LevelOwnedError
+    from distributedmandelbrot_tpu.storage.store import DataDirError
 
     settings = parse_level_settings(args.levels)
-    coordinator = Coordinator(
-        settings, data_dir_parent=args.data_dir, host=args.host,
-        distributer_port=args.distributer_port,
-        dataserver_port=args.dataserver_port,
-        lease_timeout=args.lease_timeout, sweep_period=args.sweep_period,
-        read_timeout=None if args.no_read_timeout else args.read_timeout,
-        fsync_index=args.fsync_index, stats_period=args.stats_period)
+    try:
+        coordinator = Coordinator(
+            settings, data_dir_parent=args.data_dir, host=args.host,
+            distributer_port=args.distributer_port,
+            dataserver_port=args.dataserver_port,
+            lease_timeout=args.lease_timeout, sweep_period=args.sweep_period,
+            read_timeout=None if args.no_read_timeout else args.read_timeout,
+            fsync_index=args.fsync_index, stats_period=args.stats_period)
+    except (DataDirError, LevelOwnedError) as e:
+        # Clean pre-start failures (reference: Program.cs:159-176 prints
+        # and exits on an unwritable -o): no traceback, exit code 1.
+        raise SystemExit(f"dmtpu coordinator: {e}")
     total = coordinator.scheduler.total_tiles
     done = coordinator.scheduler.completed_count
     print(f"coordinator: {len(settings)} level(s), {total} tiles "

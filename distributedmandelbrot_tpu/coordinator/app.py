@@ -19,6 +19,7 @@ from distributedmandelbrot_tpu.coordinator.distributer import Distributer
 from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
 from distributedmandelbrot_tpu.core.workload import LevelSetting
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.storage.ownership import LevelClaims
 from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils.metrics import Counters
 
@@ -38,30 +39,51 @@ class Coordinator:
                  fsync_index: bool = False,
                  stats_period: float = 0.0) -> None:
         self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index)
-        completed = self.store.completed_keys(
-            levels=[s.level for s in level_settings])
-        if completed:
-            logger.info("resume: %d tiles already completed on disk",
-                        len(completed))
-        self.counters = Counters()
-        kwargs = {} if clock is None else {"clock": clock}
-        self.scheduler = TileScheduler(level_settings, completed=completed,
-                                       lease_timeout=lease_timeout, **kwargs)
-        self.distributer = Distributer(self.scheduler, self.store, host=host,
-                                       port=distributer_port,
-                                       sweep_period=sweep_period,
-                                       read_timeout=read_timeout,
-                                       counters=self.counters)
-        self.dataserver = DataServer(self.store, host=host,
-                                     port=dataserver_port,
-                                     read_timeout=read_timeout,
-                                     counters=self.counters)
+        # Fail loudly if another live coordinator owns any of our levels
+        # on this data dir (reference: the static claimed-levels set,
+        # Distributer.cs:14,109-115 — file-based here because our
+        # coordinators are separate processes).  Released in stop().
+        self._level_claims = LevelClaims(
+            self.store.data_dir, [s.level for s in level_settings])
+        try:
+            completed = self.store.completed_keys(
+                levels=[s.level for s in level_settings])
+            if completed:
+                logger.info("resume: %d tiles already completed on disk",
+                            len(completed))
+            self.counters = Counters()
+            kwargs = {} if clock is None else {"clock": clock}
+            self.scheduler = TileScheduler(level_settings,
+                                           completed=completed,
+                                           lease_timeout=lease_timeout,
+                                           **kwargs)
+            self.distributer = Distributer(self.scheduler, self.store,
+                                           host=host, port=distributer_port,
+                                           sweep_period=sweep_period,
+                                           read_timeout=read_timeout,
+                                           counters=self.counters)
+            self.dataserver = DataServer(self.store, host=host,
+                                         port=dataserver_port,
+                                         read_timeout=read_timeout,
+                                         counters=self.counters)
+        except BaseException:
+            # Construction failed after the claim: release it, or the
+            # level stays locked by this live process forever.
+            self._level_claims.release()
+            raise
         self.stats_period = stats_period
         self._stats_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
-        await self.distributer.start()
-        await self.dataserver.start()
+        try:
+            await self.distributer.start()
+            await self.dataserver.start()
+        except BaseException:
+            # A failed startup (e.g. port already bound) will never reach
+            # stop(); a leaked claim from a live pid would lock the level
+            # for the life of this process (release() is idempotent).
+            self._level_claims.release()
+            raise
         if self.stats_period > 0:
             self._stats_task = asyncio.create_task(self._stats_loop())
 
@@ -76,8 +98,12 @@ class Coordinator:
                 # A previously-failed stats task must never prevent the
                 # services below from shutting down.
                 logger.exception("stats task had failed")
-        await self.distributer.stop()
-        await self.dataserver.stop()
+        try:
+            await self.distributer.stop()
+            await self.dataserver.stop()
+        finally:
+            # Claims must release even when a service stop raises.
+            self._level_claims.release()
 
     async def _stats_loop(self) -> None:
         """Periodic progress/throughput report (survey §5.1/§5.5 — the

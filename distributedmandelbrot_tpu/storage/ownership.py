@@ -1,0 +1,129 @@
+"""Per-level ownership locks for a data directory.
+
+The reference keeps a process-global claimed-levels set so two
+Distributers can never serve the same level (``Distributer.cs:14,109-115``)
+— but that guard lives in one process's memory.  Here coordinators are
+independent processes that may be pointed at the same data directory, so
+the claim is a lock *file* per level inside ``Data/``: a second
+coordinator claiming an overlapping level fails loudly at startup instead
+of silently duplicating work and index entries.
+
+Lock files are ``_level_<n>.lock`` containing the owner's pid.  A lock
+whose pid is no longer alive is stale (crashed coordinator — the
+reference's in-memory set has the same semantics: claims die with the
+process) and is reclaimed.  Claims are released on clean shutdown.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import os
+
+logger = logging.getLogger("dmtpu.storage")
+
+
+class LevelOwnedError(RuntimeError):
+    """Another live coordinator already owns one of the requested levels."""
+
+
+def _lock_path(data_dir: str, level: int) -> str:
+    return os.path.join(data_dir, f"_level_{level}.lock")
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    return True
+
+
+class LevelClaims:
+    """Holds the lock files for a coordinator's levels; release() on stop."""
+
+    def __init__(self, data_dir: str, levels: list[int]) -> None:
+        self.data_dir = data_dir
+        self._held: list[int] = []
+        try:
+            for level in levels:
+                self._claim_one(level)
+        except BaseException:
+            self.release()
+            raise
+
+    def _claim_one(self, level: int, retried: bool = False) -> None:
+        # Atomic publish: the lock is materialized via os.link from a
+        # fully-written temp file, so it is never visible without its
+        # owner pid — a concurrent claimant can't race the pid write and
+        # misread a half-created lock as stale (classic TOCTOU).
+        path = _lock_path(self.data_dir, level)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(str(os.getpid()))
+        try:
+            try:
+                os.link(tmp, path)
+            except OSError as e:
+                if e.errno != errno.EEXIST:
+                    raise
+                owner = self._read_owner(path)
+                if owner is None or _pid_alive(owner):
+                    # Live owner — or unreadable content, which a correct
+                    # claimant can never produce (atomic publish above):
+                    # treat foreign junk as contested, never reclaim it.
+                    raise LevelOwnedError(
+                        f"level {level} is already owned by "
+                        + (f"a live coordinator (pid {owner}, "
+                           if owner is not None else "an unreadable claim (")
+                        + f"lock {path}); two coordinators on one data "
+                        "directory would duplicate work and index entries"
+                    ) from None
+                # Stale lock: the owning pid is gone (crashed coordinator).
+                if retried:
+                    raise LevelOwnedError(
+                        f"cannot reclaim contested lock {path}") from None
+                logger.info("reclaiming stale level lock %s (pid %s)", path,
+                            owner)
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+                self._claim_one(level, retried=True)
+                return
+        finally:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+        self._held.append(level)
+
+    @staticmethod
+    def _read_owner(path: str) -> int | None:
+        """The claiming pid, or None when the file is unreadable or holds
+        anything but a positive integer (callers treat None as contested,
+        not stale — see _claim_one)."""
+        try:
+            with open(path) as f:
+                pid = int(f.read().strip())
+            return pid if pid > 0 else None
+        except FileNotFoundError:
+            # Vanished between EEXIST and the read: the other claimant
+            # reclaimed a stale lock — report as a dead owner so our
+            # retry path re-races the os.link cleanly.
+            return -1
+        except (OSError, ValueError):
+            return None
+
+    def release(self) -> None:
+        """Unlink every held lock (idempotent; best-effort on errors)."""
+        for level in self._held:
+            try:
+                os.unlink(_lock_path(self.data_dir, level))
+            except OSError:
+                pass
+        self._held = []

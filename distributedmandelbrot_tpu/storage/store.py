@@ -39,6 +39,11 @@ INDEX_FILENAME = "_index.dat"
 DATA_DIR_NAME = "Data"
 
 
+class DataDirError(OSError):
+    """The data directory cannot be created or written (clean CLI error;
+    reference: the pre-start writability probe, ``Program.cs:159-176``)."""
+
+
 class ChunkStore:
     """Durable chunk storage rooted at ``parent_dir/Data/``."""
 
@@ -59,8 +64,31 @@ class ChunkStore:
     # -- directory / bookkeeping ------------------------------------------
 
     def setup(self) -> None:
-        """Create the data directory and an empty index if absent."""
-        os.makedirs(self.data_dir, exist_ok=True)
+        """Create the data directory and an empty index if absent.
+
+        Probes writability the way the reference does before starting
+        (``Program.cs:159-176`` writes and deletes a test file) and
+        raises :class:`DataDirError` with a clean message instead of
+        letting a raw OSError traceback surface from the CLI.
+        """
+        try:
+            os.makedirs(self.data_dir, exist_ok=True)
+        except (OSError, ValueError) as e:
+            # NotADirectoryError/FileExistsError: the path (or a parent)
+            # is occupied by a file; PermissionError: unwritable parent.
+            raise DataDirError(
+                f"cannot create data directory {self.data_dir!r}: "
+                f"{e}") from e
+        probe = os.path.join(self.data_dir,
+                             f"_writable_probe_{os.getpid()}.tmp")
+        try:
+            with open(probe, "wb") as f:
+                f.write(b"probe")
+            os.unlink(probe)
+        except OSError as e:
+            raise DataDirError(
+                f"data directory {self.data_dir!r} is not writable: "
+                f"{e}") from e
         with self._index_lock:
             if not os.path.exists(self.index_path):
                 with open(self.index_path, "wb"):

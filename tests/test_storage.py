@@ -135,3 +135,120 @@ def test_store_survives_torn_index_tail(tmp_path):
         f.write(b"\x04\x00\x00")  # torn append
     store2 = ChunkStore(str(tmp_path))
     assert store2.completed_keys() == {(4, 0, 0)}
+
+
+def test_unwritable_data_dir_raises_clean_error(tmp_path):
+    """Reference parity: Program.cs:159-176 probes -o writability and
+    fails cleanly; ChunkStore.setup raises DataDirError (not a raw
+    OSError traceback) for an unwritable or file-occupied path."""
+    import os
+
+    import pytest
+
+    from distributedmandelbrot_tpu.storage.store import ChunkStore, DataDirError
+
+    # Path occupied by a regular file.
+    occupied = tmp_path / "occupied"
+    (occupied / "Data").parent.mkdir(exist_ok=True)
+    (occupied / "Data").write_text("a file, not a directory")
+    with pytest.raises(DataDirError, match="cannot create data directory"):
+        ChunkStore(str(occupied))
+
+    # Read-only directory (skip when running as root: chmod is advisory).
+    ro = tmp_path / "ro"
+    (ro / "Data").mkdir(parents=True)
+    (ro / "Data").chmod(0o555)
+    try:
+        if os.access(str(ro / "Data"), os.W_OK):
+            pytest.skip("running as root; chmod cannot make dir unwritable")
+        with pytest.raises(DataDirError, match="not writable"):
+            ChunkStore(str(ro))
+    finally:
+        (ro / "Data").chmod(0o755)
+
+
+def test_level_ownership_locks(tmp_path):
+    """Two coordinators on one data dir with overlapping levels must fail
+    loudly (reference: the static claimed-levels set,
+    Distributer.cs:14,109-115); disjoint levels coexist; stale locks from
+    dead pids are reclaimed; release() frees the level."""
+    import os
+
+    import pytest
+
+    from distributedmandelbrot_tpu.storage.ownership import (LevelClaims,
+                                                             LevelOwnedError)
+
+    data_dir = str(tmp_path)
+    a = LevelClaims(data_dir, [4, 10])
+    # Overlap -> loud failure, and the failed claimant must not leave
+    # partial locks behind (level 20 stays claimable).
+    with pytest.raises(LevelOwnedError, match="level 10"):
+        LevelClaims(data_dir, [20, 10])
+    b = LevelClaims(data_dir, [20])
+    b.release()
+    # Release frees the level for a new claimant.
+    a.release()
+    c = LevelClaims(data_dir, [4])
+    c.release()
+    # A stale lock (dead pid) is reclaimed, not fatal.
+    stale = os.path.join(data_dir, "_level_7.lock")
+    with open(stale, "w") as f:
+        f.write("999999999")  # PID beyond pid_max: never alive
+    d = LevelClaims(data_dir, [7])
+    d.release()
+    assert not os.path.exists(stale)
+
+
+def test_coordinator_level_ownership_e2e(tmp_path):
+    """A second embedded coordinator on the same data dir + level fails at
+    startup; after the first stops, the level is claimable again."""
+    import pytest
+
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    from distributedmandelbrot_tpu.core.workload import LevelSetting
+    from distributedmandelbrot_tpu.storage.ownership import LevelOwnedError
+
+    with EmbeddedCoordinator(str(tmp_path), [LevelSetting(2, 16)]):
+        with pytest.raises(LevelOwnedError):
+            with EmbeddedCoordinator(str(tmp_path), [LevelSetting(2, 16)]):
+                pass
+    # Clean shutdown released the claim: restart-resume still works.
+    with EmbeddedCoordinator(str(tmp_path), [LevelSetting(2, 16)]):
+        pass
+
+
+def test_level_claims_released_on_failed_startup(tmp_path):
+    """A Coordinator whose startup fails after claiming (e.g. port in
+    use) must release its level claims — a leaked claim from a live pid
+    would lock the level for the life of the process."""
+    import asyncio
+    import socket
+
+    from distributedmandelbrot_tpu.coordinator.app import Coordinator
+    from distributedmandelbrot_tpu.core.workload import LevelSetting
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        async def failing_start():
+            co = Coordinator([LevelSetting(2, 16)],
+                             data_dir_parent=str(tmp_path),
+                             host="127.0.0.1", distributer_port=port,
+                             dataserver_port=0)
+            try:
+                await co.start()
+            except OSError:
+                return True
+            await co.stop()
+            return False
+
+        assert asyncio.run(failing_start()), "expected bind failure"
+    finally:
+        blocker.close()
+    # The claim from the failed startup must be gone.
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    with EmbeddedCoordinator(str(tmp_path), [LevelSetting(2, 16)]):
+        pass
