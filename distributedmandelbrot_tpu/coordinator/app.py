@@ -80,8 +80,17 @@ class Coordinator:
             await self.dataserver.start()
         except BaseException:
             # A failed startup (e.g. port already bound) will never reach
-            # stop(); a leaked claim from a live pid would lock the level
-            # for the life of this process (release() is idempotent).
+            # stop(): shut down whichever service DID start — a
+            # half-started distributer would keep granting tiles for a
+            # level someone else can now claim — then release the claim
+            # (a leaked claim from a live pid would lock the level for
+            # the life of this process).  Both stops tolerate
+            # never-started services; release() is idempotent.
+            try:
+                await self.distributer.stop()
+                await self.dataserver.stop()
+            except Exception:
+                logger.exception("cleanup after failed startup")
             self._level_claims.release()
             raise
         if self.stats_period > 0:

@@ -4,19 +4,26 @@ The reference keeps a process-global claimed-levels set so two
 Distributers can never serve the same level (``Distributer.cs:14,109-115``)
 — but that guard lives in one process's memory.  Here coordinators are
 independent processes that may be pointed at the same data directory, so
-the claim is a lock *file* per level inside ``Data/``: a second
-coordinator claiming an overlapping level fails loudly at startup instead
-of silently duplicating work and index entries.
+the claim is an OS-level ``flock`` on a per-level file inside ``Data/``:
+a second coordinator claiming an overlapping level fails loudly at
+startup instead of silently duplicating work and index entries.
 
-Lock files are ``_level_<n>.lock`` containing the owner's pid.  A lock
-whose pid is no longer alive is stale (crashed coordinator — the
-reference's in-memory set has the same semantics: claims die with the
-process) and is reclaimed.  Claims are released on clean shutdown.
+``flock`` rather than pid files: the kernel drops the lock the instant
+the owning process dies, so there is no stale-lock state and no
+reclaim logic to race (a pid-file scheme needs read-check-unlink, and
+two concurrent claimants reclaiming the same stale file can both
+"win").  The lock file itself is never unlinked — unlinking a path
+others may be flocking reintroduces exactly that race (lock-by-inode vs
+claim-by-path).  The owning pid is written into the file purely for the
+error message.  Caveat: flock is advisory and historically unreliable
+on NFS; the data dir is expected to be a local filesystem (the
+reference makes the same assumption for its index file locking).
 """
 
 from __future__ import annotations
 
 import errno
+import fcntl
 import logging
 import os
 
@@ -31,24 +38,12 @@ def _lock_path(data_dir: str, level: int) -> str:
     return os.path.join(data_dir, f"_level_{level}.lock")
 
 
-def _pid_alive(pid: int) -> bool:
-    if pid <= 0:
-        return False
-    try:
-        os.kill(pid, 0)
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        return True  # exists, owned by someone else
-    return True
-
-
 class LevelClaims:
-    """Holds the lock files for a coordinator's levels; release() on stop."""
+    """Holds flocks on the coordinator's level files; release() on stop."""
 
     def __init__(self, data_dir: str, levels: list[int]) -> None:
         self.data_dir = data_dir
-        self._held: list[int] = []
+        self._fds: dict[int, int] = {}
         try:
             for level in levels:
                 self._claim_one(level)
@@ -56,74 +51,46 @@ class LevelClaims:
             self.release()
             raise
 
-    def _claim_one(self, level: int, retried: bool = False) -> None:
-        # Atomic publish: the lock is materialized via os.link from a
-        # fully-written temp file, so it is never visible without its
-        # owner pid — a concurrent claimant can't race the pid write and
-        # misread a half-created lock as stale (classic TOCTOU).
+    def _claim_one(self, level: int) -> None:
         path = _lock_path(self.data_dir, level)
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as f:
-            f.write(str(os.getpid()))
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
         try:
-            try:
-                os.link(tmp, path)
-            except OSError as e:
-                if e.errno != errno.EEXIST:
-                    raise
-                owner = self._read_owner(path)
-                if owner is None or _pid_alive(owner):
-                    # Live owner — or unreadable content, which a correct
-                    # claimant can never produce (atomic publish above):
-                    # treat foreign junk as contested, never reclaim it.
-                    raise LevelOwnedError(
-                        f"level {level} is already owned by "
-                        + (f"a live coordinator (pid {owner}, "
-                           if owner is not None else "an unreadable claim (")
-                        + f"lock {path}); two coordinators on one data "
-                        "directory would duplicate work and index entries"
-                    ) from None
-                # Stale lock: the owning pid is gone (crashed coordinator).
-                if retried:
-                    raise LevelOwnedError(
-                        f"cannot reclaim contested lock {path}") from None
-                logger.info("reclaiming stale level lock %s (pid %s)", path,
-                            owner)
-                try:
-                    os.unlink(path)
-                except FileNotFoundError:
-                    pass
-                self._claim_one(level, retried=True)
-                return
-        finally:
-            try:
-                os.unlink(tmp)
-            except FileNotFoundError:
-                pass
-        self._held.append(level)
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            owner = self._read_owner(fd)
+            os.close(fd)
+            if e.errno not in (errno.EACCES, errno.EAGAIN):
+                raise
+            raise LevelOwnedError(
+                f"level {level} is already owned by a live coordinator"
+                + (f" (pid {owner})" if owner else "")
+                + f" — lock {path}; two coordinators on one data "
+                "directory would duplicate work and index entries"
+            ) from None
+        # Diagnostics only; ownership is the flock, not the content.
+        os.ftruncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())
+        self._fds[level] = fd
 
     @staticmethod
-    def _read_owner(path: str) -> int | None:
-        """The claiming pid, or None when the file is unreadable or holds
-        anything but a positive integer (callers treat None as contested,
-        not stale — see _claim_one)."""
+    def _read_owner(fd: int) -> int | None:
         try:
-            with open(path) as f:
-                pid = int(f.read().strip())
+            data = os.pread(fd, 64, 0)
+            pid = int(data.decode().strip())
             return pid if pid > 0 else None
-        except FileNotFoundError:
-            # Vanished between EEXIST and the read: the other claimant
-            # reclaimed a stale lock — report as a dead owner so our
-            # retry path re-races the os.link cleanly.
-            return -1
         except (OSError, ValueError):
             return None
 
     def release(self) -> None:
-        """Unlink every held lock (idempotent; best-effort on errors)."""
-        for level in self._held:
+        """Drop every held flock (idempotent; the files stay behind —
+        see the module docstring for why they are never unlinked)."""
+        for level, fd in list(self._fds.items()):
             try:
-                os.unlink(_lock_path(self.data_dir, level))
+                fcntl.flock(fd, fcntl.LOCK_UN)
             except OSError:
                 pass
-        self._held = []
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            del self._fds[level]

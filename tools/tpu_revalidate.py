@@ -10,9 +10,12 @@ surface in dependency order and stops at the first failure:
 2. Pallas single-tile kernel (bucketed compile cap, dynamic budget)
 3. mixed-budget executables share one compile bucket
 4. sharded Pallas batch path (shard_map + lax.map around pallas_call)
+4b. production-shape sharded Pallas: 4096^2 tiles, mixed budgets, Mpix/s
+    within 15% of the single-tile rate
 5. perturbation scan on device (moderate zoom, parity vs XLA f64)
 6. farm e2e with the auto (Pallas) backend at production chunk size
 7. bench headline (prints the JSON line)
+7b. bench worst-case boundary views (raw vs shortcut per view)
 """
 
 from __future__ import annotations
@@ -146,6 +149,32 @@ def main() -> int:
     print(f"sharded parity vs XLA: {mism:.4%}")
     assert mism <= 0.02
 
+    step("4b. production-shape pallas: 4096^2 sharded, mixed budgets")
+    # Device-chained timing (bench.py methodology): the sharded Pallas
+    # dispatch at the farm's real tile size, mixed mrd exercising the
+    # bucket-cap executable-sharing path, vs the single-tile chain —
+    # sharded dispatch overhead must stay small at production shape.
+    from bench import _grid_params, _pallas_chain, _pallas_sharded_chain, \
+        _time_chain
+    big = 4096
+    k4 = max(4, mesh.devices.size)
+    params4 = _grid_params((-0.7436447, 0.1318252), 2e-3, big, k4)
+    mixed_mrds = np.array([[1000, 700, 1000, 513][i % 4]
+                           for i in range(k4)], np.int64)
+    t_shard = _time_chain(_pallas_sharded_chain(mesh, params4, mixed_mrds,
+                                                big), 2)
+    shard_rate = k4 * big * big / t_shard / 1e6
+    t_single = _time_chain(_pallas_chain(params4[:1], big, 1000), 2)
+    single_rate = big * big / t_single / 1e6
+    print(f"4096^2 sharded mixed-mrd: {shard_rate:.1f} Mpix/s; "
+          f"single-tile: {single_rate:.1f} Mpix/s; "
+          f"ratio {shard_rate / single_rate:.2f}")
+    # Mixed budgets average shallower than the single tile's 1000, so the
+    # sharded rate should not fall meaningfully below the single rate.
+    assert shard_rate >= 0.85 * single_rate, (
+        f"sharded 4096^2 path lost >15% vs single-tile "
+        f"({shard_rate:.1f} vs {single_rate:.1f} Mpix/s)")
+
     step("5. perturbation scan on device")
     from distributedmandelbrot_tpu.ops.perturbation import (
         DeepTileSpec, compute_counts_perturb)
@@ -176,6 +205,11 @@ def main() -> int:
     step("7. bench headline")
     rc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
                          "--repeats", "2"], cwd=REPO).returncode
+    assert rc == 0
+
+    step("7b. bench worst-case boundary views (raw vs shortcut)")
+    rc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py"),
+                         "--worst", "--repeats", "2"], cwd=REPO).returncode
     assert rc == 0
     print("\nALL REVALIDATION STEPS PASSED")
     return 0
