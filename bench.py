@@ -91,17 +91,20 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
     each reduced to a checksum on device.  ``kernel_kw`` passes static
     kernel options through (interior_check/cycle_check for raw-loop
     timing, power/burning for the extended families, interpret for the
-    CPU config)."""
+    CPU config, block_h/block_w overrides for the tuning sweep)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from distributedmandelbrot_tpu.ops.pallas_escape import (_pallas_escape,
-                                                             fit_blocks)
+                                                             fit_blocks,
+                                                             DEFAULT_BLOCK_H)
 
     from distributedmandelbrot_tpu.parallel.sharding import widen_square_pitch
 
-    block_h, block_w = fit_blocks(tile, tile)
+    block_h, block_w = fit_blocks(
+        tile, tile, block_h=kernel_kw.pop("block_h", DEFAULT_BLOCK_H),
+        block_w=kernel_kw.pop("block_w", None))
     params = jnp.asarray(widen_square_pitch(params_np), jnp.float32)
 
     @jax.jit

@@ -84,14 +84,16 @@ class Coordinator:
             # half-started distributer would keep granting tiles for a
             # level someone else can now claim — then release the claim
             # (a leaked claim from a live pid would lock the level for
-            # the life of this process).  Both stops tolerate
-            # never-started services; release() is idempotent.
+            # the life of this process).  release() sits in a finally:
+            # the stops await, and a cancellation landing there must not
+            # skip the release (CancelledError is not an Exception).
             try:
                 await self.distributer.stop()
                 await self.dataserver.stop()
             except Exception:
                 logger.exception("cleanup after failed startup")
-            self._level_claims.release()
+            finally:
+                self._level_claims.release()
             raise
         if self.stats_period > 0:
             self._stats_task = asyncio.create_task(self._stats_loop())

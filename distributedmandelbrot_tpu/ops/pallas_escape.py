@@ -164,12 +164,16 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
         szr_ref[:] = g_real  # snapshot of z_0
         szi_ref[:] = g_imag
 
-    # Select-free escape recurrence with a sticky active mask; see
+    # Escape recurrence with a sticky active mask; see
     # ops/escape_time.py:escape_loop for why stickiness matters and how
     # the count recovers the escape iteration.  Vector state lives in the
     # scratch refs; the while carries scalars only (Mosaic constraint).
     # The mask stays int32 end-to-end — i1 vectors can appear only as
-    # transient compare results, never in carries or stores.
+    # transient compare results, never in carries or stores.  Stickiness
+    # is a select (where(cond, act, 0) == act & cond for act in {0,1}):
+    # cmp+select+add per step, one op fewer than cmp+convert+and+add —
+    # this loop body times ~10 vector ops, so every op is ~10% of the
+    # raw throughput.
     def seg_body(carry):
         it, _, next_snap = carry
         zr = zr_ref[:]
@@ -201,7 +205,7 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                                      burning=burning)
             zr2 = zr * zr
             zi2 = zi * zi
-            act = act & (zr2 + zi2 < four).astype(jnp.int32)
+            act = jnp.where(zr2 + zi2 < four, act, 0)
             if cycle_check:
                 # Exact periodicity: z identical (bitwise) to the
                 # snapshot means the orbit repeats forever and can never
@@ -209,7 +213,7 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                 # the same value full iteration would produce, and
                 # retire the lane from the live count.  (inf/NaN lanes
                 # are already inactive; NaN != NaN keeps them inert.)
-                cyc = act & ((zr == szr) & (zi == szi)).astype(jnp.int32)
+                cyc = jnp.where((zr == szr) & (zi == szi), act, 0)
                 act = act - cyc
                 n = n + cyc * dyn_steps
             n = n + act
@@ -381,15 +385,15 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
             zr = jnp.where(sel, nzr, zr)
             zi = jnp.where(sel, nzi, zi)
             m2 = zr * zr + zi * zi
-            act_b = act_b & (m2 < b2).astype(jnp.int32)
+            act_b = jnp.where(m2 < b2, act_b, 0)
             n = n + act_b
-            act2 = act2 & (m2 < four).astype(jnp.int32)
+            act2 = jnp.where(m2 < four, act2, 0)
             if cycle_check:
                 # act2 implies act_b (radius 2 clears before bailout), so
                 # the probe fires only on live orbits; saturating the
                 # radius-2 count classifies the lane in-set and retires
                 # it (see escape_loop for the exactness argument).
-                cyc = act2 & ((zr == szr) & (zi == szi)).astype(jnp.int32)
+                cyc = jnp.where((zr == szr) & (zi == szi), act2, 0)
                 act2 = act2 - cyc
                 act_b = act_b - cyc
                 n2 = n2 + cyc * dyn_steps

@@ -67,10 +67,17 @@ class LevelClaims:
                 + f" — lock {path}; two coordinators on one data "
                 "directory would duplicate work and index entries"
             ) from None
-        # Diagnostics only; ownership is the flock, not the content.
-        os.ftruncate(fd, 0)
-        os.write(fd, str(os.getpid()).encode())
+        # Register BEFORE the diagnostic pid write: if the write failed
+        # (e.g. disk full) with the fd unregistered, release() could
+        # never drop the flock and the level would stay locked for the
+        # life of this process.  Ownership is the flock, not the content.
         self._fds[level] = fd
+        try:
+            os.ftruncate(fd, 0)
+            os.write(fd, str(os.getpid()).encode())
+        except OSError:
+            logger.warning("could not record pid in %s (lock still held)",
+                           path, exc_info=True)
 
     @staticmethod
     def _read_owner(fd: int) -> int | None:

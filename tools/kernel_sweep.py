@@ -1,0 +1,138 @@
+"""On-hardware Pallas kernel sweep: block shape x unroll x view x depth.
+
+VERDICT round-2 items: the shipped DEFAULT_BLOCK_H/W=(64,128) and
+DEFAULT_UNROLL=32 came from one sweep at 2048^2 depth 1000; this re-runs
+the sweep at the production shapes (1024^2 and 4096^2), at deep budgets
+(the cycle probe's extra scratch in play), and on worst-case views where
+the interior shortcut cannot help — and records everything, so the next
+tuning conversation starts from data, not a stale one-off.
+
+Run on a live TPU (aborts cleanly otherwise):
+
+    python tools/kernel_sweep.py [--quick] [--tile 1024] [--out FILE]
+
+Timing methodology = bench.py's device-chained checksum (amortizes the
+dev rig's tunnel round trip; see bench.py docstring).  Results append as
+JSON lines to tools/sweep_results.jsonl and a best-per-view summary
+prints at the end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+def _views():
+    """(name, center, span, depth, burning) rows, derived from bench.py's
+    canonical view definitions so the sweep can never tune for windows
+    the bench no longer measures."""
+    from bench import SEAHORSE, WORST_VIEWS
+    views = [("seahorse", (SEAHORSE[0] + 0.01, SEAHORSE[1] + 0.01), 0.02,
+              1000, False),
+             ("full", (-0.5, 0.0), 4.0, 1000, False)]
+    for name, v in WORST_VIEWS.items():
+        views.append((name, v["center"], v["span"], v["max_iter"],
+                      v["burning"]))
+    return views
+
+GRID_FULL = {
+    "block_h": [32, 64, 128, 256],
+    "block_w": [128, 256],
+    "unroll": [16, 32, 64],
+}
+GRID_QUICK = {
+    "block_h": [32, 64, 128],
+    "block_w": [128],
+    "unroll": [32, 64],
+}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="smaller grid (block_w=128 only, 2 unrolls)")
+    parser.add_argument("--tile", type=int, default=1024)
+    parser.add_argument("--tiles", type=int, default=8,
+                        help="tiles per chained dispatch")
+    parser.add_argument("--repeats", type=int, default=2)
+    parser.add_argument("--deep", action="store_true",
+                        help="add a depth-5000 seahorse config (cycle-probe "
+                             "scratch in play)")
+    parser.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "sweep_results.jsonl"))
+    args = parser.parse_args()
+
+    from __graft_entry__ import backend_alive
+    if not backend_alive():
+        print("backend unreachable; sweep needs a live TPU")
+        return 1
+    import jax
+    if jax.default_backend() != "tpu":
+        print("default backend is not tpu; aborting")
+        return 1
+
+    import numpy as np
+
+    from bench import _grid_params, _pallas_chain, _time_chain
+
+    views = _views()
+    if args.deep:
+        views.append(("seahorse-d5000", (-0.738, 0.1), 0.02, 5000, False))
+
+    grid = GRID_QUICK if args.quick else GRID_FULL
+    combos = [dict(zip(grid, vals))
+              for vals in itertools.product(*grid.values())]
+    tile, k = args.tile, args.tiles
+    pixels = k * tile * tile
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    best: dict[str, tuple[float, dict]] = {}
+    with open(args.out, "a") as out_f:
+        for (name, center, span, depth, burning) in views:
+            params = _grid_params(center, span, tile, k)
+            for combo in combos:
+                if combo["block_h"] > tile or combo["block_w"] > tile:
+                    continue
+                for interior in ((False, True) if not burning
+                                 else (False,)):
+                    kw = dict(combo)
+                    kw["interior_check"] = interior
+                    if burning:
+                        kw["burning"] = True
+                    try:
+                        t = _time_chain(
+                            _pallas_chain(params, tile, depth, **kw),
+                            args.repeats)
+                    except Exception as e:
+                        print(f"{name} {kw}: FAILED {type(e).__name__}: "
+                              f"{e}", flush=True)
+                        continue
+                    rate = pixels / t / 1e6
+                    rec = {"ts": stamp, "view": name, "depth": depth,
+                           "tile": tile, "k": k, **kw,
+                           "mpix_s": round(rate, 2)}
+                    out_f.write(json.dumps(rec) + "\n")
+                    out_f.flush()
+                    print(json.dumps(rec), flush=True)
+                    key = f"{name}{'' if interior else ':raw'}"
+                    if rate > best.get(key, (0.0, {}))[0]:
+                        best[key] = (rate, rec)
+
+    print("\n=== best per view ===")
+    for key in sorted(best):
+        rate, rec = best[key]
+        print(f"{key:24s} {rate:8.1f} Mpix/s  "
+              f"bh={rec['block_h']} bw={rec['block_w']} "
+              f"unroll={rec['unroll']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
