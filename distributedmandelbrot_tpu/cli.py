@@ -64,7 +64,8 @@ def _add_common(parser: argparse.ArgumentParser) -> None:
 _NP_DTYPES = {"f32": np.float32, "f64": np.float64}
 
 
-def _resolve_dtype(args: argparse.Namespace):
+def _resolve_dtype(args: argparse.Namespace,
+                   center: tuple[float, float] | None = None):
     """--dtype default is mode-dependent: smooth rendering defaults to
     the f64 quality path, everything else to f32 (an explicit --dtype
     always wins — 'f32 --smooth' selects the fast smooth path).
@@ -72,7 +73,15 @@ def _resolve_dtype(args: argparse.Namespace):
     or an animation sweeping past the threshold — defaults to f32 even
     with --smooth: there the view's precision comes from the bigint
     reference orbit and f32 deltas are the designed fast path (and a
-    sweep must not change dtype mid-animation)."""
+    sweep must not change dtype mid-animation).
+
+    ``center`` (resolved view center) enables the f32-resolution check:
+    spans between the perturbation threshold and f32's pixel resolution
+    (~1e-4 at 1024^2 near |c|=1) would render banded in f32 — adjacent
+    pixel coordinates collapse to the same float — so the default
+    silently upgrades to the f64 quality path there, matching the
+    reference worker's always-f64 output (its CUDA kernel computes
+    float64, DistributedMandelbrotWorkerCUDA.py:39)."""
     if args.dtype is not None:
         return _NP_DTYPES[args.dtype]
     touches_deep = (
@@ -81,6 +90,19 @@ def _resolve_dtype(args: argparse.Namespace):
         or getattr(args, "span_end", 1.0) < DEEP_SPAN_THRESHOLD)
     if touches_deep:
         return np.float32
+    if center is not None:
+        from distributedmandelbrot_tpu.core.geometry import (
+            f32_pitch_adequate)
+        definition = getattr(args, "definition", 1024)
+        # min over both sweep ends: a zoom-OUT run starts at the small
+        # span (same rule as cmd_animate's family guard).
+        span = min(getattr(args, "span", 4.0),
+                   getattr(args, "span_start", 4.0),
+                   getattr(args, "span_end", 4.0))
+        cx, cy = center
+        if not (f32_pitch_adequate(cx - span / 2, span, definition)
+                and f32_pitch_adequate(cy - span / 2, span, definition)):
+            return np.float64
     return np.float64 if getattr(args, "smooth", False) else np.float32
 
 
@@ -572,7 +594,8 @@ def cmd_render(argv: Sequence[str]) -> int:
         if args.fractal == "julia" else None
     rgba = _render_view(c_re, c_im, args.span, args.definition,
                         args.max_iter, smooth=args.smooth,
-                        np_dtype=_resolve_dtype(args),
+                        np_dtype=_resolve_dtype(
+                            args, center=(float(c_re), float(c_im))),
                         colormap=args.colormap,
                         deep=True if args.deep else None,
                         julia_c=julia_c, family=family,
@@ -640,7 +663,7 @@ def cmd_animate(argv: Sequence[str]) -> int:
     c_re, c_im = (s.strip() for s in args.center.split(","))
     julia_c = tuple(s.strip() for s in args.c.split(",")) \
         if args.fractal == "julia" else None
-    np_dtype = _resolve_dtype(args)
+    np_dtype = _resolve_dtype(args, center=(float(c_re), float(c_im)))
     ratio = (args.span_end / args.span_start) ** (
         1.0 / max(1, args.frames - 1))
 

@@ -57,6 +57,24 @@ def validate_indices(level: int, index_real: int, index_imag: int) -> None:
         raise ValueError(f"index_imag {index_imag} out of range for level {level}")
 
 
+def f32_pitch_adequate(start: float, range_: float, n: int,
+                       min_ulps: float = 4.0) -> bool:
+    """Whether an ``n``-sample axis over ``[start, start + range_]`` is
+    resolvable in float32: the pixel pitch must span at least
+    ``min_ulps`` f32 ulps at the axis's largest-magnitude coordinate.
+    Below ~1 ulp/pixel adjacent samples collapse to the same f32 value
+    (banded, aliased renders); ``min_ulps=4`` leaves headroom for the
+    in-kernel ``start + i*step`` rounding.  Used by the f32 fast paths
+    to decline views only float64 (or perturbation) can render.
+    """
+    if n <= 1:
+        return True
+    pitch = abs(range_) / (n - 1)
+    maxc = max(abs(start), abs(start + range_))
+    return pitch >= min_ulps * float(np.spacing(np.float32(max(maxc,
+                                                               1e-30))))
+
+
 @dataclass(frozen=True)
 class TileSpec:
     """Geometry of one tile to compute: where it sits and how finely sampled.

@@ -56,11 +56,27 @@ def _pallas():
 class PallasUnsupported(ValueError):
     """The *intentional* shape/budget rejections of the Pallas dispatch
     path (tile extent below the block granule, iteration cap needing
-    int64) — the documented cue for callers to fall back to the XLA
-    path.  A subclass of ValueError so pre-existing ``except ValueError``
+    int64, pixel pitch below f32 resolution) — the documented cue for
+    callers to fall back to the XLA path.  A subclass of ValueError
+    so pre-existing ``except ValueError``
     callers keep working, but fall-back sites should catch THIS type:
     a genuine kernel bug surfacing as a bare ValueError must propagate,
     not silently degrade to the XLA path (round-2 advisor finding)."""
+
+def _check_f32_resolvable(spec: TileSpec) -> None:
+    """Decline views whose pixel pitch aliases in f32: the kernel
+    generates coordinates on device as ``start + i*step`` in f32, and
+    below a few ulps per pixel adjacent columns/rows collapse to the
+    same value — a banded render no block size can fix.  Such views
+    need the f64 XLA path (or perturbation)."""
+    from distributedmandelbrot_tpu.core.geometry import f32_pitch_adequate
+    if not (f32_pitch_adequate(spec.start_real, spec.range_real, spec.width)
+            and f32_pitch_adequate(spec.start_imag, spec.range_imag,
+                                   spec.height)):
+        raise PallasUnsupported(
+            f"pixel pitch of {spec!r} is below f32 resolution "
+            "(adjacent pixels alias); use the f64 or perturbation path")
+
 
 # Block shape: one early-exit domain.  Swept on a real v5e (2048^2 view,
 # depth 1000, K=8 tiles per dispatch to amortize the tunnel latency):
@@ -511,6 +527,7 @@ def compute_tile_smooth_pallas(spec: TileSpec, max_iter: int, *,
     if max_iter - 1 >= INT32_SCALE_LIMIT:
         raise PallasUnsupported(
             f"max_iter {max_iter} too deep for the pallas path")
+    _check_f32_resolvable(spec)
     block_h, block_w = fit_blocks(spec.height, spec.width,
                                   block_h=block_h, block_w=block_w)
     if interpret is None:
@@ -625,6 +642,7 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
         # (fall-back sites catch PallasUnsupported specifically).
         raise PallasUnsupported(
             f"max_iter {max_iter} too deep for the pallas path")
+    _check_f32_resolvable(spec)
     block_h, block_w = fit_blocks(spec.height, spec.width,
                                   block_h=block_h, block_w=block_w)
     if interpret is None:

@@ -134,11 +134,21 @@ class PallasBackend:
                 pending.append(self._dispatch(spec, w.max_iter,
                                               clamp=self.clamp))
             except PallasUnsupported:
-                # Tile smaller than the kernel's (32, 128) block granule
-                # or budget past the int32 cap — the XLA path handles
-                # both; other errors propagate (see PallasUnsupported).
+                # Intentional rejections only (granule, int32 cap, or
+                # sub-f32-resolution pitch); other errors propagate.  A
+                # pitch the kernel declined would alias identically on
+                # the XLA f32 path, so those tiles fall back to f64 —
+                # honoring the rejection's point, not just re-routing it.
+                from distributedmandelbrot_tpu.core.geometry import (
+                    f32_pitch_adequate)
+                dt = np.float32 if (
+                    f32_pitch_adequate(spec.start_real, spec.range_real,
+                                       spec.width)
+                    and f32_pitch_adequate(spec.start_imag, spec.range_imag,
+                                           spec.height)) else np.float64
                 pending.append(escape_time.compute_tile(spec, w.max_iter,
-                                                        clamp=self.clamp))
+                                                        clamp=self.clamp,
+                                                        dtype=dt))
         t1 = time.monotonic()
         out = [np.asarray(p).ravel() for p in pending]
         self.phase_us["dispatch"] += int((t1 - t0) * 1e6)

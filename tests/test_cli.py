@@ -112,3 +112,36 @@ def test_render_no_pallas_flag(tmp_path):
     rc = cli.main(["render", "--definition", "64", "--max-iter", "64",
                    "--span", "3.0", "--no-pallas", "--out", str(out)])
     assert rc == 0 and out.exists()
+
+
+def test_dtype_auto_upgrades_below_f32_resolution():
+    """Spans whose pixel pitch aliases in f32 (between the perturbation
+    threshold and ~1e-4 near |c|=1) default to the f64 quality path —
+    the reference's CUDA kernel is always f64, so an f32 default there
+    would produce banded renders the reference never shows."""
+    import argparse
+
+    import numpy as np
+
+    from distributedmandelbrot_tpu.cli import _resolve_dtype
+
+    def ns(**kw):
+        return argparse.Namespace(dtype=None, deep=False, smooth=False, **kw)
+
+    # Shallow span: f32 fast path as before.
+    assert _resolve_dtype(ns(span=0.01, definition=1024),
+                          center=(-0.75, 0.1)) == np.float32
+    # Sub-resolution span near |c|~0.75: silently upgrade to f64.
+    assert _resolve_dtype(ns(span=1e-5, definition=1024),
+                          center=(-0.74529, 0.11307)) == np.float64
+    # Explicit --dtype always wins.
+    n = ns(span=1e-5, definition=1024)
+    n.dtype = "f32"
+    assert _resolve_dtype(n, center=(-0.74529, 0.11307)) == np.float32
+    # At center 0 (Julia default) f32 precision scales with the span:
+    # no upgrade needed.
+    assert _resolve_dtype(ns(span=1e-5, definition=1024),
+                          center=(0.0, 0.0)) == np.float32
+    # Perturbation territory stays f32 (deltas are the designed path).
+    assert _resolve_dtype(ns(span=1e-13, definition=1024),
+                          center=(-0.75, 0.1)) == np.float32

@@ -454,3 +454,16 @@ def test_cycle_probe_follows_requested_budget(monkeypatch):
     pe.compute_tile_pallas_device(spec, CYCLE_CHECK_MIN_ITER,
                                   interpret=True)
     assert seen["cycle_check"] is True
+
+
+def test_pallas_declines_sub_f32_resolution_views():
+    """A view whose pixel pitch aliases in f32 raises PallasUnsupported
+    (adjacent in-kernel coordinates would collapse to the same float —
+    a banded render no block size can fix); callers fall back to the
+    f64/perturbation paths."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        PallasUnsupported, compute_tile_pallas_device)
+
+    spec = TileSpec(-0.74529, 0.11307, 1e-5, 1e-5, width=1024, height=1024)
+    with pytest.raises(PallasUnsupported, match="f32 resolution"):
+        compute_tile_pallas_device(spec, 100, interpret=True)
