@@ -87,12 +87,13 @@ def _resolve_dtype(args: argparse.Namespace,
     touches_deep = (
         getattr(args, "deep", False)
         or getattr(args, "span", 1.0) < DEEP_SPAN_THRESHOLD
+        or getattr(args, "span_start", 1.0) < DEEP_SPAN_THRESHOLD
         or getattr(args, "span_end", 1.0) < DEEP_SPAN_THRESHOLD)
     if touches_deep:
         return np.float32
     if center is not None:
         from distributedmandelbrot_tpu.core.geometry import (
-            f32_pitch_adequate)
+            TileSpec, spec_f32_resolvable)
         definition = getattr(args, "definition", 1024)
         # min over both sweep ends: a zoom-OUT run starts at the small
         # span (same rule as cmd_animate's family guard).
@@ -100,8 +101,9 @@ def _resolve_dtype(args: argparse.Namespace,
                    getattr(args, "span_start", 4.0),
                    getattr(args, "span_end", 4.0))
         cx, cy = center
-        if not (f32_pitch_adequate(cx - span / 2, span, definition)
-                and f32_pitch_adequate(cy - span / 2, span, definition)):
+        if not spec_f32_resolvable(TileSpec(cx - span / 2, cy - span / 2,
+                                            span, span, width=definition,
+                                            height=definition)):
             return np.float64
     return np.float64 if getattr(args, "smooth", False) else np.float32
 
@@ -422,11 +424,63 @@ def cmd_worker(argv: Sequence[str]) -> int:
     parser.add_argument("--profile", metavar="DIR", default="",
                         help="capture a jax.profiler trace of the run into "
                              "DIR (view with TensorBoard / Perfetto)")
+    parser.add_argument("--multihost", action="store_true",
+                        help="slice-spanning SPMD worker: run the SAME "
+                             "invocation on every process of a multi-host "
+                             "slice; the primary process leases/uploads "
+                             "over TCP, all processes compute over the "
+                             "global device mesh (survey §5.8)")
+    parser.add_argument("--mh-coordinator", default=None,
+                        help="jax.distributed coordinator address "
+                             "(default: Cloud TPU auto-detection)")
+    parser.add_argument("--mh-processes", type=int, default=None)
+    parser.add_argument("--mh-process-id", type=int, default=None)
     _add_common(parser)
     args = parser.parse_args(argv)
     _configure_logging(args)
 
     from distributedmandelbrot_tpu.worker import DistributerClient, Worker
+
+    if args.multihost:
+        # The SPMD worker computes through the sharded XLA path on the
+        # global mesh; per-tile backend/kernel selection does not apply.
+        if args.backend != "auto" or args.kernel != "auto":
+            raise SystemExit("--multihost ignores --backend/--kernel "
+                             "(it always computes on the global mesh); "
+                             "drop those flags")
+        import jax
+
+        from distributedmandelbrot_tpu.parallel import multihost
+
+        multihost.initialize(coordinator_address=args.mh_coordinator,
+                             num_processes=args.mh_processes,
+                             process_id=args.mh_process_id)
+        per_dev = max(1, -(-args.batch_size // jax.device_count())) \
+            if args.batch_size > 0 else 1
+        if args.batch_size > 0 and per_dev * jax.device_count() \
+                != args.batch_size:
+            logger.warning(
+                "--batch-size %d rounded to %d (the SPMD batch must be a "
+                "multiple of the %d global devices)", args.batch_size,
+                per_dev * jax.device_count(), jax.device_count())
+        profiling = False
+        if args.profile:
+            jax.profiler.start_trace(args.profile)
+            profiling = True
+        try:
+            rounds = multihost.run_spmd_worker(
+                args.host, args.port, batch_per_device=per_dev,
+                poll=args.poll, dtype=_NP_DTYPES[args.dtype])
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+                print(f"profile trace written to {args.profile}",
+                      flush=True)
+        if multihost.is_primary():
+            print(f"multihost worker: drained after {rounds} round(s) "
+                  f"({jax.process_count()} processes, "
+                  f"{jax.device_count()} devices)", flush=True)
+        return 0
 
     backend = _make_backend(args.backend, args.dtype, args.kernel)
     batch_size = args.batch_size

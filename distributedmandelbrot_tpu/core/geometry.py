@@ -75,6 +75,16 @@ def f32_pitch_adequate(start: float, range_: float, n: int,
                                                                1e-30))))
 
 
+def spec_f32_resolvable(spec: "TileSpec") -> bool:
+    """Both axes of ``spec`` pass :func:`f32_pitch_adequate` — the single
+    policy every f32 fast path consults (Pallas dispatch rejection, the
+    worker fallback's dtype choice, the CLI's default-dtype upgrade), so
+    the threshold can never desynchronize between them."""
+    return (f32_pitch_adequate(spec.start_real, spec.range_real, spec.width)
+            and f32_pitch_adequate(spec.start_imag, spec.range_imag,
+                                   spec.height))
+
+
 @dataclass(frozen=True)
 class TileSpec:
     """Geometry of one tile to compute: where it sits and how finely sampled.

@@ -69,10 +69,8 @@ def _check_f32_resolvable(spec: TileSpec) -> None:
     below a few ulps per pixel adjacent columns/rows collapse to the
     same value — a banded render no block size can fix.  Such views
     need the f64 XLA path (or perturbation)."""
-    from distributedmandelbrot_tpu.core.geometry import f32_pitch_adequate
-    if not (f32_pitch_adequate(spec.start_real, spec.range_real, spec.width)
-            and f32_pitch_adequate(spec.start_imag, spec.range_imag,
-                                   spec.height)):
+    from distributedmandelbrot_tpu.core.geometry import spec_f32_resolvable
+    if not spec_f32_resolvable(spec):
         raise PallasUnsupported(
             f"pixel pitch of {spec!r} is below f32 resolution "
             "(adjacent pixels alias); use the f64 or perturbation path")

@@ -82,4 +82,22 @@ class MeshBackend:
                                            definition=self.definition,
                                            dtype=self.dtype,
                                            segment=self.segment)
-        return [pixels[i].ravel() for i in range(len(workloads))]
+        out = [pixels[i].ravel() for i in range(len(workloads))]
+        if np.dtype(self.dtype) == np.float32:
+            # Tiles whose pixel pitch aliases in f32 (levels beyond
+            # ~1000 at 4096^2) would persist banded from the mesh path;
+            # recompute those few in f64 (same policy as PallasBackend's
+            # fall-back) so tile content never depends on which backend
+            # leased it.
+            from distributedmandelbrot_tpu.core.geometry import (
+                spec_f32_resolvable)
+            from distributedmandelbrot_tpu.ops.escape_time import (
+                compute_tile)
+            for i, w in enumerate(workloads):
+                spec = TileSpec.for_chunk(w.level, w.index_real,
+                                          w.index_imag,
+                                          definition=self.definition)
+                if not spec_f32_resolvable(spec):
+                    out[i] = compute_tile(spec, w.max_iter,
+                                          dtype=np.float64)
+        return out

@@ -119,3 +119,136 @@ def batched_escape_pixels_multihost(mesh: Mesh,
                                   segment=segment, clamp=clamp)
     shards = sorted(out.addressable_shards, key=lambda s: s.index[0].start)
     return np.concatenate([np.asarray(s.data) for s in shards])
+
+
+def run_spmd_worker(host: str, port: int, *, definition: int = 4096,
+                    batch_per_device: int = 1, poll: float = 0.0,
+                    dtype=np.float32, clamp: bool = False,
+                    mesh: Optional[Mesh] = None) -> int:
+    """The multi-host farm worker: one slice-spanning SPMD pull loop.
+
+    Run the same invocation on every process of the slice (after
+    :func:`initialize`).  The control plane stays the reference's pull
+    model — but per *slice*, not per host: the primary process leases a
+    batch sized to the GLOBAL device count and uploads the results over
+    TCP; every process computes its local shard of each batch through
+    :func:`batched_escape_pixels_multihost` (XLA moves tile data over
+    ICI/DCN).  This is the "few fat workers x many cores" shape of
+    survey §5.8, scaled across hosts.
+
+    SPMD discipline: every rank must execute the same collectives in the
+    same order, so the leased batch is broadcast from the primary each
+    round — padded to a fixed ``global_devices * batch_per_device`` rows
+    (trivial rows compute a level-1 tile at budget 1) — and the "no more
+    work" decision rides the same broadcast, keeping ranks in lockstep
+    through polling and shutdown.  Results are allgathered, so every
+    host briefly materializes the full batch (k x definition^2 bytes);
+    only the primary uploads.
+
+    Returns the number of non-empty rounds (identical on every rank).
+    """
+    import time
+
+    from jax.experimental import multihost_utils
+
+    from distributedmandelbrot_tpu.core.geometry import level_chunk_range, \
+        MIN_AXIS
+    from distributedmandelbrot_tpu.core.workload import Workload
+
+    if mesh is None:
+        mesh = global_tile_mesh()
+    primary = is_primary()
+    n_proc = jax.process_count()
+    k_global = mesh.devices.size * batch_per_device
+    k_local = k_global // n_proc
+    if k_global % n_proc:
+        raise ValueError(f"global batch {k_global} must divide evenly "
+                         f"across {n_proc} processes")
+    client = None
+    if primary:
+        from distributedmandelbrot_tpu.worker.client import DistributerClient
+        client = DistributerClient(host, port)
+
+    rounds = 0
+    pending_err: Optional[BaseException] = None
+    while True:
+        rows = np.zeros((k_global, 5), np.int64)  # level, mrd, i, j, real
+        if primary:
+            # SPMD anti-hang discipline (cf. the allgather note in
+            # batched_escape_pixels_multihost): a primary-only
+            # lease/upload failure must NOT kill rank 0 before the
+            # broadcast — the other ranks would block in the collective
+            # until the distributed heartbeat hard-kills them.  Failures
+            # ride the broadcast as a sentinel so every rank raises
+            # together.
+            if pending_err is None:
+                try:
+                    grants = client.request_batch(k_global)
+                    for r, w in enumerate(grants):
+                        rows[r] = (w.level, w.max_iter, w.index_real,
+                                   w.index_imag, 1)
+                except Exception as e:
+                    pending_err = e
+            if pending_err is not None:
+                rows[:, 4] = -1  # abort sentinel
+        rows = multihost_utils.broadcast_one_to_all(rows)
+        if (rows[:, 4] < 0).any():
+            if primary:
+                raise RuntimeError(
+                    "multihost worker aborting: coordinator I/O failed "
+                    "on the primary") from pending_err
+            raise RuntimeError(
+                "multihost worker aborting: the primary reported a "
+                "coordinator I/O failure")
+        n_real = int(rows[:, 4].sum())
+        if n_real == 0:
+            if poll <= 0:
+                return rounds
+            time.sleep(poll)  # every rank saw the same empty broadcast
+            continue
+        rounds += 1
+        params = np.empty((k_global, 3))
+        for r in range(k_global):
+            level, mrd, i, j, real = rows[r]
+            if not real:  # trivial pad: level-1 tile at budget 1
+                level, mrd, i, j = 1, 1, 0, 0
+            rng = level_chunk_range(int(level))
+            params[r] = (MIN_AXIS + rng * int(i), MIN_AXIS + rng * int(j),
+                         rng / (definition - 1))
+        lo = jax.process_index() * k_local
+        out_local = batched_escape_pixels_multihost(
+            mesh, params[lo:lo + k_local],
+            np.maximum(rows[lo:lo + k_local, 1], 1),
+            definition=definition, dtype=dtype, clamp=clamp)
+        gathered = multihost_utils.process_allgather(out_local)
+        if primary:
+            full = gathered.reshape(k_global, definition, definition)
+            if np.dtype(dtype) == np.float32:
+                # Sub-f32-resolution tiles would upload banded; the
+                # primary recomputes those few in f64 locally (no
+                # collectives involved, so ranks stay in lockstep —
+                # same policy as the single-host backends).
+                from distributedmandelbrot_tpu.core.geometry import (
+                    TileSpec, spec_f32_resolvable)
+                from distributedmandelbrot_tpu.ops.escape_time import (
+                    compute_tile)
+                for r in range(k_global):
+                    if not rows[r, 4]:
+                        continue
+                    spec = TileSpec.for_chunk(int(rows[r, 0]),
+                                              int(rows[r, 2]),
+                                              int(rows[r, 3]),
+                                              definition=definition)
+                    if not spec_f32_resolvable(spec):
+                        full[r] = compute_tile(
+                            spec, int(rows[r, 1]), clamp=clamp,
+                            dtype=np.float64).reshape(definition,
+                                                      definition)
+            results = [
+                (Workload(int(rows[r, 0]), int(rows[r, 1]), int(rows[r, 2]),
+                          int(rows[r, 3])), full[r].ravel())
+                for r in range(k_global) if rows[r, 4]]
+            try:
+                client.submit_batch(results)
+            except Exception as e:
+                pending_err = e  # abort sentinel on the next broadcast

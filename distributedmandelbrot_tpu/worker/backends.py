@@ -24,7 +24,9 @@ from typing import Protocol, Sequence
 
 import numpy as np
 
-from distributedmandelbrot_tpu.core.geometry import CHUNK_WIDTH, TileSpec
+from distributedmandelbrot_tpu.core.geometry import (CHUNK_WIDTH,
+                                                     TileSpec,
+                                                     spec_f32_resolvable)
 from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.ops import escape_time
 from distributedmandelbrot_tpu.ops import reference as ref_ops
@@ -139,13 +141,8 @@ class PallasBackend:
                 # pitch the kernel declined would alias identically on
                 # the XLA f32 path, so those tiles fall back to f64 —
                 # honoring the rejection's point, not just re-routing it.
-                from distributedmandelbrot_tpu.core.geometry import (
-                    f32_pitch_adequate)
-                dt = np.float32 if (
-                    f32_pitch_adequate(spec.start_real, spec.range_real,
-                                       spec.width)
-                    and f32_pitch_adequate(spec.start_imag, spec.range_imag,
-                                           spec.height)) else np.float64
+                dt = (np.float32 if spec_f32_resolvable(spec)
+                      else np.float64)
                 pending.append(escape_time.compute_tile(spec, w.max_iter,
                                                         clamp=self.clamp,
                                                         dtype=dt))
