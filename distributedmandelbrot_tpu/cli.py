@@ -64,8 +64,33 @@ def _add_common(parser: argparse.ArgumentParser) -> None:
 _NP_DTYPES = {"f32": np.float32, "f64": np.float64}
 
 
+def _span_f32_resolvable(cx: float, cy: float, span: float,
+                         definition: int) -> bool:
+    """One view -> one resolvability verdict: the single copy of the
+    center/span -> TileSpec convention, so the dtype default and the
+    deep auto-select can never disagree."""
+    from distributedmandelbrot_tpu.core.geometry import (TileSpec,
+                                                         spec_f32_resolvable)
+    return spec_f32_resolvable(TileSpec(cx - span / 2, cy - span / 2,
+                                        span, span, width=definition,
+                                        height=definition))
+
+
+def _view_f32_resolvable(args: argparse.Namespace,
+                         center: tuple[float, float]) -> bool:
+    """Whether the request's finest view resolves in f32 (min over both
+    sweep ends: a zoom-OUT run starts at the small span — same rule as
+    cmd_animate's family guard)."""
+    span = min(getattr(args, "span", 4.0),
+               getattr(args, "span_start", 4.0),
+               getattr(args, "span_end", 4.0))
+    return _span_f32_resolvable(center[0], center[1], span,
+                                getattr(args, "definition", 1024))
+
+
 def _resolve_dtype(args: argparse.Namespace,
-                   center: tuple[float, float] | None = None):
+                   center: tuple[float, float] | None = None,
+                   can_perturb: bool = False):
     """--dtype default is mode-dependent: smooth rendering defaults to
     the f64 quality path, everything else to f32 (an explicit --dtype
     always wins — 'f32 --smooth' selects the fast smooth path).
@@ -78,10 +103,12 @@ def _resolve_dtype(args: argparse.Namespace,
     ``center`` (resolved view center) enables the f32-resolution check:
     spans between the perturbation threshold and f32's pixel resolution
     (~1e-4 at 1024^2 near |c|=1) would render banded in f32 — adjacent
-    pixel coordinates collapse to the same float — so the default
-    silently upgrades to the f64 quality path there, matching the
-    reference worker's always-f64 output (its CUDA kernel computes
-    float64, DistributedMandelbrotWorkerCUDA.py:39)."""
+    pixel coordinates collapse to the same float.  Fractals with a
+    perturbation path (``can_perturb``: Mandelbrot/Julia) render such
+    views via f32 delta orbits — the TPU-native fast path — so the
+    default stays f32; families without one (Multibrot/ship) upgrade to
+    the f64 quality path, matching the reference worker's always-f64
+    output (``DistributedMandelbrotWorkerCUDA.py:39``)."""
     if args.dtype is not None:
         return _NP_DTYPES[args.dtype]
     touches_deep = (
@@ -91,20 +118,8 @@ def _resolve_dtype(args: argparse.Namespace,
         or getattr(args, "span_end", 1.0) < DEEP_SPAN_THRESHOLD)
     if touches_deep:
         return np.float32
-    if center is not None:
-        from distributedmandelbrot_tpu.core.geometry import (
-            TileSpec, spec_f32_resolvable)
-        definition = getattr(args, "definition", 1024)
-        # min over both sweep ends: a zoom-OUT run starts at the small
-        # span (same rule as cmd_animate's family guard).
-        span = min(getattr(args, "span", 4.0),
-                   getattr(args, "span_start", 4.0),
-                   getattr(args, "span_end", 4.0))
-        cx, cy = center
-        if not spec_f32_resolvable(TileSpec(cx - span / 2, cy - span / 2,
-                                            span, span, width=definition,
-                                            height=definition)):
-            return np.float64
+    if center is not None and not _view_f32_resolvable(args, center):
+        return np.float32 if can_perturb else np.float64
     return np.float64 if getattr(args, "smooth", False) else np.float32
 
 
@@ -157,6 +172,21 @@ def _add_no_pallas(parser: argparse.ArgumentParser) -> None:
                              "which can differ from the host-linspace grid "
                              "at the last ulp; use this to reproduce "
                              "host-grid renders exactly")
+
+
+def _auto_deep(span: float, cx: float, cy: float, definition: int,
+               np_dtype) -> bool:
+    """Whether a Mandelbrot/Julia view should render via perturbation:
+    below the f64 threshold, OR at an f32 dtype whose pixel pitch the
+    direct path cannot resolve (banded render) — delta orbits against
+    the bigint reference orbit render both exactly, at f32 speed, far
+    faster on TPU than the emulated-f64 direct path.  The single copy of
+    the decision: _render_view's auto-select and cmd_animate's per-frame
+    progress label must never disagree (families don't call this — they
+    have no perturbation path)."""
+    return span < DEEP_SPAN_THRESHOLD or (
+        np_dtype == np.float32
+        and not _span_f32_resolvable(cx, cy, span, definition))
 
 
 def _render_view(c_re: str, c_im: str, span: float, definition: int,
@@ -213,7 +243,8 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
                              colormap=colormap)
 
     if deep is None:
-        deep = span < DEEP_SPAN_THRESHOLD
+        deep = _auto_deep(span, float(c_re), float(c_im), definition,
+                          np_dtype)
     if deep:
         from distributedmandelbrot_tpu.ops import (DeepTileSpec,
                                                    compute_smooth_perturb,
@@ -649,7 +680,8 @@ def cmd_render(argv: Sequence[str]) -> int:
     rgba = _render_view(c_re, c_im, args.span, args.definition,
                         args.max_iter, smooth=args.smooth,
                         np_dtype=_resolve_dtype(
-                            args, center=(float(c_re), float(c_im))),
+                            args, center=(float(c_re), float(c_im)),
+                            can_perturb=family is None),
                         colormap=args.colormap,
                         deep=True if args.deep else None,
                         julia_c=julia_c, family=family,
@@ -717,7 +749,8 @@ def cmd_animate(argv: Sequence[str]) -> int:
     c_re, c_im = (s.strip() for s in args.center.split(","))
     julia_c = tuple(s.strip() for s in args.c.split(",")) \
         if args.fractal == "julia" else None
-    np_dtype = _resolve_dtype(args, center=(float(c_re), float(c_im)))
+    np_dtype = _resolve_dtype(args, center=(float(c_re), float(c_im)),
+                              can_perturb=family is None)
     ratio = (args.span_end / args.span_start) ** (
         1.0 / max(1, args.frames - 1))
 
@@ -726,7 +759,9 @@ def cmd_animate(argv: Sequence[str]) -> int:
         span = args.span_start * ratio ** f
         # The decision is made once and passed down, so the progress
         # label can never disagree with the path actually rendered.
-        deep = span < DEEP_SPAN_THRESHOLD
+        deep = family is None and _auto_deep(span, float(c_re),
+                                             float(c_im), args.definition,
+                                             np_dtype)
         rgba = _render_view(c_re, c_im, span, args.definition,
                             args.max_iter, smooth=args.smooth,
                             np_dtype=np_dtype, colormap=args.colormap,

@@ -86,18 +86,9 @@ class MeshBackend:
         if np.dtype(self.dtype) == np.float32:
             # Tiles whose pixel pitch aliases in f32 (levels beyond
             # ~1000 at 4096^2) would persist banded from the mesh path;
-            # recompute those few in f64 (same policy as PallasBackend's
-            # fall-back) so tile content never depends on which backend
-            # leased it.
-            from distributedmandelbrot_tpu.core.geometry import (
-                spec_f32_resolvable)
-            from distributedmandelbrot_tpu.ops.escape_time import (
-                compute_tile)
-            for i, w in enumerate(workloads):
-                spec = TileSpec.for_chunk(w.level, w.index_real,
-                                          w.index_imag,
-                                          definition=self.definition)
-                if not spec_f32_resolvable(spec):
-                    out[i] = compute_tile(spec, w.max_iter,
-                                          dtype=np.float64)
+            # recompute those few in f64 so tile content never depends
+            # on which backend leased it.
+            from distributedmandelbrot_tpu.worker.backends import (
+                recompute_unresolvable_f32)
+            recompute_unresolvable_f32(workloads, out, self.definition)
         return out

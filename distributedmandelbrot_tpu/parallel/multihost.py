@@ -121,7 +121,7 @@ def batched_escape_pixels_multihost(mesh: Mesh,
     return np.concatenate([np.asarray(s.data) for s in shards])
 
 
-def run_spmd_worker(host: str, port: int, *, definition: int = 4096,
+def run_spmd_worker(host: str, port: int, *, definition: int | None = None,
                     batch_per_device: int = 1, poll: float = 0.0,
                     dtype=np.float32, clamp: bool = False,
                     mesh: Optional[Mesh] = None) -> int:
@@ -155,6 +155,9 @@ def run_spmd_worker(host: str, port: int, *, definition: int = 4096,
         MIN_AXIS
     from distributedmandelbrot_tpu.core.workload import Workload
 
+    if definition is None:
+        from distributedmandelbrot_tpu.core.geometry import CHUNK_WIDTH
+        definition = CHUNK_WIDTH
     if mesh is None:
         mesh = global_tile_mesh()
     primary = is_primary()
@@ -223,32 +226,21 @@ def run_spmd_worker(host: str, port: int, *, definition: int = 4096,
         gathered = multihost_utils.process_allgather(out_local)
         if primary:
             full = gathered.reshape(k_global, definition, definition)
+            wls = [Workload(int(rows[r, 0]), int(rows[r, 1]),
+                            int(rows[r, 2]), int(rows[r, 3]))
+                   for r in range(k_global) if rows[r, 4]]
+            pix = [full[r].ravel() for r in range(k_global) if rows[r, 4]]
             if np.dtype(dtype) == np.float32:
                 # Sub-f32-resolution tiles would upload banded; the
                 # primary recomputes those few in f64 locally (no
-                # collectives involved, so ranks stay in lockstep —
-                # same policy as the single-host backends).
-                from distributedmandelbrot_tpu.core.geometry import (
-                    TileSpec, spec_f32_resolvable)
-                from distributedmandelbrot_tpu.ops.escape_time import (
-                    compute_tile)
-                for r in range(k_global):
-                    if not rows[r, 4]:
-                        continue
-                    spec = TileSpec.for_chunk(int(rows[r, 0]),
-                                              int(rows[r, 2]),
-                                              int(rows[r, 3]),
-                                              definition=definition)
-                    if not spec_f32_resolvable(spec):
-                        full[r] = compute_tile(
-                            spec, int(rows[r, 1]), clamp=clamp,
-                            dtype=np.float64).reshape(definition,
-                                                      definition)
-            results = [
-                (Workload(int(rows[r, 0]), int(rows[r, 1]), int(rows[r, 2]),
-                          int(rows[r, 3])), full[r].ravel())
-                for r in range(k_global) if rows[r, 4]]
+                # collectives involved, so ranks stay in lockstep).
+                # List-slot replacement, never in-place writes: the
+                # allgathered buffer is read-only.
+                from distributedmandelbrot_tpu.worker.backends import (
+                    recompute_unresolvable_f32)
+                recompute_unresolvable_f32(wls, pix, definition,
+                                           clamp=clamp)
             try:
-                client.submit_batch(results)
+                client.submit_batch(list(zip(wls, pix)))
             except Exception as e:
                 pending_err = e  # abort sentinel on the next broadcast

@@ -154,6 +154,23 @@ class PallasBackend:
         return out
 
 
+def recompute_unresolvable_f32(workloads: Sequence[Workload],
+                               out: list, definition: int, *,
+                               clamp: bool = False) -> list:
+    """Replace (by list-slot assignment, never in-place buffer writes —
+    gathered device arrays are read-only) the pixels of tiles whose
+    pitch aliases in f32 with f64 recomputes.  The single copy of the
+    recompute action shared by the mesh backend and the SPMD worker;
+    the threshold itself is geometry.spec_f32_resolvable."""
+    for i, w in enumerate(workloads):
+        spec = _spec_for(w, definition)
+        if not spec_f32_resolvable(spec):
+            out[i] = escape_time.compute_tile(spec, w.max_iter,
+                                              clamp=clamp,
+                                              dtype=np.float64)
+    return out
+
+
 def auto_backend(definition: int = CHUNK_WIDTH,
                  dtype: np.dtype = np.float32) -> ComputeBackend:
     """Best available single-device backend: Pallas on a live TPU (f32

@@ -131,9 +131,15 @@ def test_dtype_auto_upgrades_below_f32_resolution():
     # Shallow span: f32 fast path as before.
     assert _resolve_dtype(ns(span=0.01, definition=1024),
                           center=(-0.75, 0.1)) == np.float32
-    # Sub-resolution span near |c|~0.75: silently upgrade to f64.
+    # Sub-resolution span near |c|~0.75, no perturbation path (families):
+    # silently upgrade to f64.
     assert _resolve_dtype(ns(span=1e-5, definition=1024),
                           center=(-0.74529, 0.11307)) == np.float64
+    # With a perturbation path (Mandelbrot/Julia) the default stays f32 —
+    # the render routes through f32 delta orbits instead.
+    assert _resolve_dtype(ns(span=1e-5, definition=1024),
+                          center=(-0.74529, 0.11307),
+                          can_perturb=True) == np.float32
     # Explicit --dtype always wins.
     n = ns(span=1e-5, definition=1024)
     n.dtype = "f32"
