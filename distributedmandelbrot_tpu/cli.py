@@ -93,7 +93,11 @@ def _resolve_dtype(args: argparse.Namespace,
                    can_perturb: bool = False):
     """--dtype default is mode-dependent: smooth rendering defaults to
     the f64 quality path, everything else to f32 (an explicit --dtype
-    always wins — 'f32 --smooth' selects the fast smooth path).
+    always wins — 'f32 --smooth' selects the fast smooth path).  An
+    explicit --dtype selects the arithmetic WIDTH, not the algorithm:
+    f32 views whose pixel pitch f32 cannot resolve directly still
+    render through f32 *perturbation* (see _auto_deep) rather than
+    produce a banded direct render.
     Anything that renders deep — explicit --deep, a sub-threshold span,
     or an animation sweeping past the threshold — defaults to f32 even
     with --smooth: there the view's precision comes from the bigint
@@ -119,7 +123,12 @@ def _resolve_dtype(args: argparse.Namespace,
     if touches_deep:
         return np.float32
     if center is not None and not _view_f32_resolvable(args, center):
-        return np.float32 if can_perturb else np.float64
+        # Smooth keeps its f64 quality promise (f64 resolves every span
+        # above the perturbation threshold); integer renders take f32
+        # perturbation when available, f64 otherwise.
+        if getattr(args, "smooth", False) or not can_perturb:
+            return np.float64
+        return np.float32
     return np.float64 if getattr(args, "smooth", False) else np.float32
 
 
@@ -653,7 +662,7 @@ def cmd_render(argv: Sequence[str]) -> int:
                              "arbitrary decimal precision, valid at any "
                              "span (auto-selected below 1e-12)")
     parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
-                        help="default: f64 for --smooth, f32 otherwise")
+                        help="arithmetic width (the algorithm still auto-selects: sub-f32-resolution f32 renders use f32 perturbation); default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
     _add_no_pallas(parser)
     parser.add_argument("--out", required=True, help="output PNG path")
@@ -720,7 +729,7 @@ def cmd_animate(argv: Sequence[str]) -> int:
     parser.add_argument("--smooth", action="store_true",
                         help="band-free coloring on every frame")
     parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
-                        help="default: f64 for --smooth, f32 otherwise")
+                        help="arithmetic width (the algorithm still auto-selects: sub-f32-resolution f32 renders use f32 perturbation); default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
     _add_no_pallas(parser)
     parser.add_argument("--out-dir", required=True,

@@ -126,7 +126,8 @@ def test_dtype_auto_upgrades_below_f32_resolution():
     from distributedmandelbrot_tpu.cli import _resolve_dtype
 
     def ns(**kw):
-        return argparse.Namespace(dtype=None, deep=False, smooth=False, **kw)
+        kw.setdefault("smooth", False)
+        return argparse.Namespace(dtype=None, deep=False, **kw)
 
     # Shallow span: f32 fast path as before.
     assert _resolve_dtype(ns(span=0.01, definition=1024),
@@ -151,3 +152,8 @@ def test_dtype_auto_upgrades_below_f32_resolution():
     # Perturbation territory stays f32 (deltas are the designed path).
     assert _resolve_dtype(ns(span=1e-13, definition=1024),
                           center=(-0.75, 0.1)) == np.float32
+    # Smooth keeps its f64 quality promise even when sub-resolution and
+    # perturbation-capable: f64 resolves every span above the threshold.
+    assert _resolve_dtype(ns(span=1e-5, definition=1024, smooth=True),
+                          center=(-0.74529, 0.11307),
+                          can_perturb=True) == np.float64
