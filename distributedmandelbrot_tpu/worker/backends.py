@@ -173,9 +173,13 @@ def recompute_unresolvable_f32(workloads: Sequence[Workload],
 
 def auto_backend(definition: int = CHUNK_WIDTH,
                  dtype: np.dtype = np.float32) -> ComputeBackend:
-    """Best available single-device backend: Pallas on a live TPU (f32
-    fast path), JAX otherwise (and always for f64 — the Pallas kernel is
-    f32-only — or for tiles below the kernel's 128-lane block floor)."""
+    """Best available single-device backend.
+
+    Pallas on a live TPU (f32 fast path; f64 and sub-granule tiles fall
+    through); otherwise the native C++ kernel when it builds — faster
+    than JAX-on-CPU *and* bit-exact f64, the reference worker's own
+    precision (``DistributedMandelbrotWorkerCUDA.py:39``) — with the
+    portable JAX path as the last resort."""
     if np.dtype(dtype) == np.float32 and definition >= 128:
         try:
             from distributedmandelbrot_tpu.ops.pallas_escape import (
@@ -184,4 +188,10 @@ def auto_backend(definition: int = CHUNK_WIDTH,
                 return PallasBackend(definition=definition)
         except Exception:
             pass
+    try:
+        from distributedmandelbrot_tpu import native as native_mod
+        if native_mod.native_supported():
+            return NativeBackend(definition=definition)
+    except Exception:
+        pass
     return JaxBackend(definition=definition, dtype=dtype)
