@@ -409,9 +409,11 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
     return 0
 
 
-def _make_backend(name: str, dtype: str, kernel: str = "auto",
+def _make_backend(name: str, dtype: str | None, kernel: str = "auto",
                   definition: int | None = None):
-    np_dtype = _NP_DTYPES[dtype]
+    # dtype None = unpinned: auto picks per platform (native f64 on CPU,
+    # Pallas f32 on TPU); the explicit backends keep their f32 default.
+    np_dtype = _NP_DTYPES[dtype] if dtype is not None else np.float32
     kw = {} if definition is None else {"definition": definition}
     if name == "numpy":
         from distributedmandelbrot_tpu.worker import NumpyBackend
@@ -431,7 +433,8 @@ def _make_backend(name: str, dtype: str, kernel: str = "auto",
         return PallasBackend(**kw)
     if name == "auto":
         from distributedmandelbrot_tpu.worker import auto_backend
-        return auto_backend(dtype=np_dtype, **kw)
+        return auto_backend(
+            dtype=None if dtype is None else np_dtype, **kw)
     if name == "mesh":
         from distributedmandelbrot_tpu.parallel import MeshBackend
         return MeshBackend(dtype=np_dtype, kernel=kernel, **kw)
@@ -451,7 +454,10 @@ def cmd_worker(argv: Sequence[str]) -> int:
                         default="auto",
                         help="auto = Pallas TPU kernel when a TPU is live, "
                              "else the portable JAX path")
-    parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
+    parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
+                        help="pin output precision (f32 fast paths / f64 "
+                             "bit-exact paths); default: best per "
+                             "platform for --backend auto, f32 otherwise")
     parser.add_argument("--batch-size", type=int, default=0,
                         help="tiles leased per exchange "
                              "(default: device count for mesh, else 1)")
@@ -510,7 +516,8 @@ def cmd_worker(argv: Sequence[str]) -> int:
         try:
             rounds = multihost.run_spmd_worker(
                 args.host, args.port, batch_per_device=per_dev,
-                poll=args.poll, dtype=_NP_DTYPES[args.dtype])
+                poll=args.poll,
+                dtype=_NP_DTYPES[args.dtype or "f32"])
         finally:
             if profiling:
                 jax.profiler.stop_trace()

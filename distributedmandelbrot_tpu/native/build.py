@@ -53,31 +53,41 @@ def _build() -> None:
 
 
 def load() -> Optional[ctypes.CDLL]:
-    """The native library, building it on demand; None when unavailable."""
+    """The native library, building it on demand; None when unavailable.
+
+    Thread-safe for concurrent FIRST use: ``_tried`` is set only after
+    the build/load attempt fully concludes, so a caller racing the
+    builder blocks on the lock and gets the finished library — it must
+    never see a half-done attempt as "unavailable" (that made two of
+    three concurrently-constructed NativeBackends fall back to Python
+    while the third compiled the library).
+    """
     global _lib, _tried
-    if _lib is not None:
-        return _lib
     if _tried:
-        return None
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if os.environ.get("DMTPU_NATIVE", "1") == "0":
-            logger.info("native library disabled via DMTPU_NATIVE=0")
-            return None
-        try:
-            if _needs_build():
-                _build()
-            lib = ctypes.CDLL(_LIB_PATH)
-        except (OSError, subprocess.CalledProcessError) as e:
-            detail = getattr(e, "stderr", "") or str(e)
-            logger.warning("native library unavailable, using pure-Python "
-                           "paths: %s", detail.strip()[:500])
-            return None
-        _configure(lib)
-        _lib = lib
+        # Attempt concluded: _lib is final (library or None-forever).
         return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        try:
+            if os.environ.get("DMTPU_NATIVE", "1") == "0":
+                logger.info("native library disabled via DMTPU_NATIVE=0")
+                return None
+            try:
+                if _needs_build():
+                    _build()
+                lib = ctypes.CDLL(_LIB_PATH)
+            except (OSError, subprocess.CalledProcessError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                logger.warning("native library unavailable, using "
+                               "pure-Python paths: %s",
+                               detail.strip()[:500])
+                return None
+            _configure(lib)
+            _lib = lib
+            return _lib
+        finally:
+            _tried = True
 
 
 def _configure(lib: ctypes.CDLL) -> None:

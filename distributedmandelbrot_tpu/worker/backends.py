@@ -172,15 +172,21 @@ def recompute_unresolvable_f32(workloads: Sequence[Workload],
 
 
 def auto_backend(definition: int = CHUNK_WIDTH,
-                 dtype: np.dtype = np.float32) -> ComputeBackend:
+                 dtype: np.dtype | None = None) -> ComputeBackend:
     """Best available single-device backend.
 
-    Pallas on a live TPU (f32 fast path; f64 and sub-granule tiles fall
-    through); otherwise the native C++ kernel when it builds — faster
-    than JAX-on-CPU *and* bit-exact f64, the reference worker's own
-    precision (``DistributedMandelbrotWorkerCUDA.py:39``) — with the
-    portable JAX path as the last resort."""
-    if np.dtype(dtype) == np.float32 and definition >= 128:
+    ``dtype=None`` (the default) picks the best precision/speed trade
+    per platform: Pallas f32 on a live TPU, else the native C++ kernel
+    when it builds — faster than JAX-on-CPU *and* bit-exact f64, the
+    reference worker's own precision
+    (``DistributedMandelbrotWorkerCUDA.py:39``) — else portable JAX.
+
+    An EXPLICIT dtype pins the output semantics (a farm of
+    heterogeneous hosts must not mix f32 and f64 tiles because only
+    some of them have g++): f32 selects the f32 fast paths
+    (Pallas/JAX), f64 the bit-exact paths (native/JAX)."""
+    want = None if dtype is None else np.dtype(dtype)
+    if want in (None, np.dtype(np.float32)) and definition >= 128:
         try:
             from distributedmandelbrot_tpu.ops.pallas_escape import (
                 pallas_available)
@@ -188,10 +194,12 @@ def auto_backend(definition: int = CHUNK_WIDTH,
                 return PallasBackend(definition=definition)
         except Exception:
             pass
-    try:
-        from distributedmandelbrot_tpu import native as native_mod
-        if native_mod.native_supported():
-            return NativeBackend(definition=definition)
-    except Exception:
-        pass
-    return JaxBackend(definition=definition, dtype=dtype)
+    if want in (None, np.dtype(np.float64)):
+        try:
+            from distributedmandelbrot_tpu import native as native_mod
+            if native_mod.native_supported():
+                return NativeBackend(definition=definition)
+        except Exception:
+            pass
+    return JaxBackend(definition=definition,
+                      dtype=np.float32 if want is None else dtype)

@@ -1,0 +1,97 @@
+"""Randomized worker-churn stress: sustained crash/join cycles.
+
+The single-crash redistribution path is covered in test_e2e; this drives
+the failure-detection machinery (leases + lazy expiry + sweep + stale
+rejection, survey §5.3) under *sustained* churn: several concurrent
+workers that randomly abandon leased batches mid-round (the over-the-wire
+shape of a worker crash — work leased, never submitted) and keep pulling.
+The farm must still complete every tile exactly once on disk, with the
+abandoned leases re-granted and any straggler submissions rejected, and
+the persisted tiles must match the numpy golden.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu import native as _native
+
+pytestmark = pytest.mark.skipif(not _native.native_supported(),
+                                reason="native toolchain unavailable")
+
+from distributedmandelbrot_tpu.core import LevelSetting, TileSpec
+from distributedmandelbrot_tpu.ops import reference as ref
+from distributedmandelbrot_tpu.worker import (DistributerClient,
+                                              NativeBackend, Worker)
+
+from harness import CoordinatorHarness
+
+LEVEL, MAX_ITER = 3, 16  # 9 full-size tiles, shallow budget
+
+
+def test_randomized_worker_churn_completes_exactly(tmp_path):
+    rng = random.Random(1234)
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(LEVEL, MAX_ITER)],
+                            lease_timeout=1.5, sweep_period=0.3) as farm:
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def churn_worker(seed: int) -> None:
+            wrng = random.Random(seed)
+            try:
+                # Constructed INSIDE the try: concurrent first
+                # construction is part of what this test exercises (it
+                # caught the native build's first-use race), and any
+                # failure must surface through `errors`, not vanish as
+                # an unhandled thread exception.
+                client = DistributerClient("127.0.0.1",
+                                           farm.distributer_port)
+                backend = NativeBackend()
+                while not stop.is_set():
+                    grants = client.request_batch(2)
+                    if not grants:
+                        if farm.scheduler.is_complete():
+                            return
+                        stop.wait(0.2)  # leases pending elsewhere
+                        continue
+                    if wrng.random() < 0.4:
+                        # Simulated crash: abandon the leased batch.  The
+                        # lease expires and the tiles are re-granted.
+                        continue
+                    pixels = backend.compute_batch(grants)
+                    client.submit_batch(list(zip(grants, pixels)))
+            except BaseException as e:  # surfaced by the main thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn_worker, args=(100 + i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        stop.set()
+        assert not any(t.is_alive() for t in threads), "worker thread hung"
+        assert not errors, errors
+        assert farm.scheduler.is_complete()
+        farm.wait_saves_settled(expected_accepted=LEVEL * LEVEL, timeout=300)
+
+        snap = farm.counters.snapshot()
+        # Abandonment forces re-grants beyond the tile count (the first
+        # abandon decision is deterministic under the seeded RNGs)...
+        assert snap["workloads_granted"] > LEVEL * LEVEL, snap
+        # ...but exactly one accepted result per tile reaches disk.
+        assert snap["results_accepted"] == LEVEL * LEVEL, snap
+
+        # Every persisted tile is golden (exactly-once, uncorrupted).
+        i, j = rng.randrange(LEVEL), rng.randrange(LEVEL)
+        chunk = farm.coordinator.store.load(LEVEL, i, j)
+        spec = TileSpec.for_chunk(LEVEL, i, j)
+        cr, ci = spec.grid_2d()
+        want = ref.scale_counts_to_uint8(
+            ref.escape_counts(cr, ci, MAX_ITER), MAX_ITER).ravel()
+        got = np.asarray(chunk.data, np.uint8).ravel()
+        np.testing.assert_array_equal(got, want)

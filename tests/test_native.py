@@ -95,3 +95,41 @@ def test_scaling_wrap_parity_native():
     np.testing.assert_array_equal(got, golden.ravel())
     clamped = native.escape_pixels(cr, ci, 1000, clamp=True)
     assert (clamped >= got).all()
+
+
+def test_concurrent_first_load_is_thread_safe(monkeypatch, tmp_path):
+    """Concurrent first use must never observe a half-done build attempt
+    as 'unavailable' (regression: _tried was set before the build, so
+    racing threads fell back to Python while one thread compiled)."""
+    import threading
+
+    from distributedmandelbrot_tpu.native import build
+
+    # Fresh module state + an empty build dir so a real (cheap) build
+    # races for real; restore globals afterwards via monkeypatch.
+    monkeypatch.setattr(build, "_lib", None)
+    monkeypatch.setattr(build, "_tried", False)
+    monkeypatch.setattr(build, "_BUILD_DIR", str(tmp_path))
+    monkeypatch.setattr(build, "_LIB_PATH",
+                        str(tmp_path / "libdmtpu_native.so"))
+
+    results = [None] * 8
+    barrier = threading.Barrier(len(results))
+
+    def probe(i: int) -> None:
+        barrier.wait()
+        results[i] = build.load()
+
+    threads = [threading.Thread(target=probe, args=(i,))
+               for i in range(len(results))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), (
+        "builder thread hung; results below would mislead and teardown "
+        "would restore globals under a live loader")
+    assert all(r is not None for r in results), (
+        f"{sum(r is None for r in results)} of {len(results)} concurrent "
+        "first loads saw the library as unavailable")
+    assert len({id(r) for r in results}) == 1  # one shared CDLL
