@@ -185,8 +185,12 @@ def auto_backend(definition: int = CHUNK_WIDTH,
     heterogeneous hosts must not mix f32 and f64 tiles because only
     some of them have g++): f32 selects the f32 fast paths
     (Pallas/JAX), f64 the bit-exact paths (native/JAX)."""
+    # Identity checks against None, never `in`/`==`: numpy treats None
+    # as "the default dtype" so np.dtype(float64) == None is True(!) and
+    # a membership test would route an explicit f64 to the f32 paths.
     want = None if dtype is None else np.dtype(dtype)
-    if want in (None, np.dtype(np.float32)) and definition >= 128:
+    if (want is None or want == np.dtype(np.float32)) \
+            and definition >= 128:
         try:
             from distributedmandelbrot_tpu.ops.pallas_escape import (
                 pallas_available)
@@ -194,7 +198,7 @@ def auto_backend(definition: int = CHUNK_WIDTH,
                 return PallasBackend(definition=definition)
         except Exception:
             pass
-    if want in (None, np.dtype(np.float64)):
+    if want is None or want == np.dtype(np.float64):
         try:
             from distributedmandelbrot_tpu import native as native_mod
             if native_mod.native_supported():

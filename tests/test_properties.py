@@ -1,0 +1,88 @@
+"""Property-based tests (hypothesis) for the pure data-plane invariants.
+
+The seeded-random tests elsewhere pin known shapes; these let hypothesis
+hunt the edges (empty runs, 255-valued bytes, run lengths crossing the
+u32 record boundary, wire values at the uint32 extremes, ASCII-filename
+edge cases) for the contracts third parties depend on: codec round-trip
+identity, pick-min optimality, wire/index byte-format round-trips.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from distributedmandelbrot_tpu import codecs
+from distributedmandelbrot_tpu.codecs import RAW, RLE
+from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.storage.index import (EntryType, IndexEntry,
+                                                     read_entry)
+
+# Byte arrays: mix run-heavy (RLE-friendly) and noisy shapes.
+_raw_bytes = st.binary(min_size=1, max_size=4096)
+_run_heavy = st.lists(
+    st.tuples(st.integers(1, 300), st.integers(0, 255)),
+    min_size=1, max_size=64,
+).map(lambda runs: np.repeat(
+    np.array([v for _, v in runs], np.uint8),
+    np.array([n for n, _ in runs])))
+_arrays = st.one_of(
+    _raw_bytes.map(lambda b: np.frombuffer(b, np.uint8)),
+    _run_heavy)
+
+
+@settings(max_examples=200, deadline=None)
+@given(_arrays)
+def test_codec_roundtrip_identity(data):
+    payload = codecs.serialize(data)
+    out = codecs.deserialize(payload, data.size)
+    np.testing.assert_array_equal(out, data)
+
+
+@settings(max_examples=200, deadline=None)
+@given(_arrays)
+def test_pick_min_is_optimal_and_sizes_are_truthful(data):
+    """serialize() must pick the smallest codec, and each codec's
+    encoded_size must equal its actual encoding's size (the costing that
+    replaces the reference's SizeCountStream dry-run)."""
+    payload = codecs.serialize(data)
+    sizes = {}
+    for codec in (RAW, RLE):
+        body = codec.encode(data)
+        assert codec.encoded_size(data) == len(body)
+        sizes[codec.code] = 1 + len(body)
+    assert len(payload) == min(sizes.values())
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.integers(1, 2**32 - 1), st.integers(0, 2**32 - 1),
+       st.integers(0, 2**32 - 1), st.integers(0, 2**32 - 1))
+def test_workload_wire_roundtrip(level, mrd, i, j):
+    """16-byte LE wire format round-trips across the full uint32 range
+    (reference format: DistributerWorkload.cs:53-100)."""
+    w = Workload(level, mrd, i % max(level, 1), j % max(level, 1))
+    again = Workload.from_wire(w.to_wire())
+    assert again == w and len(w.to_wire()) == 16
+
+
+_names = st.text(
+    alphabet=st.characters(min_codepoint=33, max_codepoint=126,
+                           exclude_characters="/\\"),
+    min_size=1, max_size=64)
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.integers(1, 2**31 - 1), st.integers(0, 2**31 - 1),
+       st.integers(0, 2**31 - 1),
+       st.sampled_from(list(EntryType)), _names)
+def test_index_entry_roundtrip(level, i, j, etype, name):
+    """Index entries round-trip through the reference's byte format
+    (int32 LE type field; ASCII filename for Regular entries only)."""
+    filename = name if etype == EntryType.REGULAR else None
+    entry = IndexEntry(level, i % level, j % level, etype, filename)
+    buf = io.BytesIO(entry.to_bytes())
+    again = read_entry(buf)
+    assert again == entry
+    assert buf.read() == b""  # no trailing bytes

@@ -225,3 +225,72 @@ def test_stalled_upload_times_out_and_regrants(tmp_path):
             (w.level, w.index_real, w.index_imag)
         assert h.coordinator.counters.get("read_timeouts") >= 1
         assert h.coordinator.counters.get("results_dropped") >= 1
+
+
+def test_servers_survive_malformed_batch_clients(tmp_path):
+    """Hostile clients on the batch extension opcodes (0x02/0x03): huge
+    counts, zero counts, truncated batch frames, claim-less echoes, and
+    mid-payload disconnects must not take down the accept loop or wedge
+    scheduler state — after lease expiry (a hostile client's absurd-count
+    lease grab holds real leases, by design) a well-behaved batch client
+    still drains the farm."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, 64)],
+                            lease_timeout=1.0, sweep_period=30.0) as farm:
+        _malformed_batch_attack_rounds(farm)
+
+
+def _malformed_batch_attack_rounds(farm) -> None:
+    attacks = [
+        b"\x02",                              # batch request, no count
+        b"\x02" + struct.pack("<I", 0),       # batch request, count 0
+        b"\x02" + struct.pack("<I", 2**32 - 1),  # absurd count (clamped)
+        b"\x03",                              # batch response, no count
+        b"\x03" + struct.pack("<I", 3),       # count, then nothing
+        # count 1, then a truncated workload echo
+        b"\x03" + struct.pack("<I", 1) + b"\x00" * 7,
+        # count 1, never-leased workload echo (rejected, not fatal)
+        b"\x03" + struct.pack("<I", 1)
+        + Workload(2, 64, 1, 1).to_wire(),
+    ]
+    for payload in attacks:
+        with raw_conn(farm.distributer_port) as s:
+            s.sendall(payload)
+            s.settimeout(2)
+            try:
+                s.recv(64)
+            except (socket.timeout, ConnectionError, OSError):
+                pass
+
+    # A leased-then-abandoned batch from a hostile client must not leave
+    # permanently claimed tiles: disconnect mid-upload after ACCEPT.
+    with raw_conn(farm.distributer_port) as s:
+        s.sendall(b"\x02" + struct.pack("<I", 1))
+        assert framing.recv_byte(s) == proto.WORKLOAD_AVAILABLE
+        n = struct.unpack("<I", framing.recv_exact(s, 4))[0]
+        leased = [Workload.from_wire(framing.recv_exact(s, 16))
+                  for _ in range(n)]
+        s.sendall(b"\x03" + struct.pack("<I", 1) + leased[0].to_wire())
+        # server replies per-item accept; then we vanish mid-payload
+        framing.recv_byte(s)
+        s.sendall(b"\x00" * 1024)  # a fraction of the 16 MiB payload
+
+    # The hostile clients' grabbed leases release at expiry (the 1 s
+    # lease above; lazy expiry makes the sweep call optional) — then a
+    # legitimate batch client must be able to drain the whole farm.
+    import time
+    time.sleep(1.2)
+    farm.scheduler.sweep()
+    deadline = time.monotonic() + 15
+    client = DistributerClient("127.0.0.1", farm.distributer_port)
+    done = 0
+    while done < 4 and time.monotonic() < deadline:
+        grants = client.request_batch(4)
+        if not grants:
+            time.sleep(0.3)
+            farm.scheduler.sweep()
+            continue
+        results = [(w, np.zeros(CHUNK_PIXELS, np.uint8))
+                   for w in grants]
+        done += sum(client.submit_batch(results))
+    assert done == 4, f"farm wedged after batch attacks ({done}/4)"
+    farm.wait_saves_settled(expected_accepted=4)
