@@ -42,6 +42,13 @@ class RleCodec:
         native = _native()
         if native is not None:
             return native.rle_encode(data)
+        return self._encode_py(data)
+
+    @staticmethod
+    def _encode_py(data: np.ndarray) -> bytes:
+        """The pure-Python encoder (also exercised directly by the
+        native-parity property test: both implementations must emit the
+        same bytes, since a farm may mix hosts with and without g++)."""
         counts, values = find_runs(data)
         records = np.empty(counts.size, dtype=_REC_DTYPE)
         records["count"] = counts
