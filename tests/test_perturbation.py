@@ -192,3 +192,115 @@ def test_julia_perturb_matches_direct_at_boundary():
         complex(-0.8, 0.156), max_iter=800))
     assert float((counts != want).mean()) <= 0.02
     assert len(np.unique(counts)) > 10
+
+
+def test_segmented_scan_is_output_identical_to_full_scan():
+    """The early-exit segmented driver must match a pure lax.scan
+    bit-for-bit (stickiness argument: once no lane is live every further
+    step is a no-op), across segment sizes that divide the orbit, leave
+    ragged tails, or exceed it entirely — driven with the real delta
+    step on a window of fast sky, deep pixels, and glitch candidates."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from distributedmandelbrot_tpu.ops import perturbation as pt
+
+    z_re, z_im, valid = pt.reference_orbit("-0.7436447", "0.1318252", 1500)
+    zr = jnp.asarray(z_re[:valid])
+    zi = jnp.asarray(z_im[:valid])
+    spec = pt.DeepTileSpec("-0.7436447", "0.1318252", 1e-4,
+                           width=48, height=48)
+    dre, dim = spec.delta_grids(np.float64)
+    dre, dim = jnp.asarray(dre), jnp.asarray(dim)
+
+    four = jnp.asarray(4.0, jnp.float64)
+    tol = jnp.asarray(pt.GLITCH_TOL, jnp.float64)
+
+    def step(carry, zs):
+        # The real integer delta step (mirrors _perturb_scan.step).
+        dzr, dzi, active, n, glitched = carry
+        zrk, zik = zs
+        fr, fi = zrk + dzr, zik + dzi
+        mag2 = fr * fr + fi * fi
+        zmag2 = zrk * zrk + zik * zik
+        glitched = glitched | (active & (mag2 < tol * zmag2))
+        active = active & (mag2 < four)
+        n = n + active.astype(jnp.int32)
+        ndzr = (zrk + zrk) * dzr - (zik + zik) * dzi \
+            + (dzr * dzr - dzi * dzi) + dre
+        ndzi = (zrk + zrk) * dzi + (zik + zik) * dzr \
+            + 2 * dzr * dzi + dim
+        return (ndzr, ndzi, active, n, glitched), None
+
+    init = (dre, dim, jnp.ones(dre.shape, jnp.bool_),
+            jnp.zeros(dre.shape, jnp.int32),
+            jnp.zeros(dre.shape, jnp.bool_))
+    want, _ = lax.scan(step, init, (zr, zi))
+    for segment in (64, 100, len(z_re[:valid]), 10_000):
+        got = pt._segmented_orbit_scan(step, init, zr, zi,
+                                       lambda c: jnp.any(c[2]),
+                                       segment=segment)
+        for g, w in zip(got[2:], want[2:]):  # active, n, glitched
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_segmented_scan_actually_exits_early():
+    """The while_loop must actually stop at the first all-dead segment:
+    compare against a driver whose live signal is pinned True (early
+    exit disabled).  With every lane escaping within a few steps of a
+    50k-entry orbit, the real driver must be dramatically cheaper — a
+    wall-clock ratio with a wide margin, since outputs alone cannot
+    distinguish a working exit from a dead one (all later segments are
+    semantic no-ops)."""
+    import time
+
+    import jax.numpy as jnp
+
+    from distributedmandelbrot_tpu.ops import perturbation as pt
+
+    # In-set center (the origin): its orbit covers the FULL budget, so
+    # the dead-signal variant really runs all ~50k steps.
+    z_re, z_im, valid = pt.reference_orbit("0", "0", 50_000)
+    assert valid == 50_000
+    zr, zi = jnp.asarray(z_re[:valid]), jnp.asarray(z_im[:valid])
+    spec = pt.DeepTileSpec("0", "0", 1e-4, width=32, height=32)
+    dre, dim = spec.delta_grids(np.float64)
+    # Far-exterior deltas: every lane escapes almost immediately.
+    dre, dim = jnp.asarray(dre + 3.0), jnp.asarray(dim)
+
+    four = jnp.asarray(4.0, jnp.float64)
+
+    def step(carry, zs):
+        dzr, dzi, active, n = carry
+        zrk, zik = zs
+        fr, fi = zrk + dzr, zik + dzi
+        active = active & (fr * fr + fi * fi < four)
+        n = n + active.astype(jnp.int32)
+        ndzr = (zrk + zrk) * dzr - (zik + zik) * dzi \
+            + (dzr * dzr - dzi * dzi) + dre
+        ndzi = (zrk + zrk) * dzi + (zik + zik) * dzr \
+            + 2 * dzr * dzi + dim
+        return (ndzr, ndzi, active, n), None
+
+    init = (dre, dim, jnp.ones(dre.shape, jnp.bool_),
+            jnp.zeros(dre.shape, jnp.int32))
+
+    import jax
+
+    def timed(live_of):
+        # jit so the timed call is pure execution: eager lax control
+        # flow re-traces per call, which would swamp both variants.
+        run = jax.jit(lambda: pt._segmented_orbit_scan(step, init, zr, zi,
+                                                       live_of))
+        np.asarray(run()[3])  # compile + warmup
+        t0 = time.perf_counter()
+        out = run()
+        np.asarray(out[3])
+        return time.perf_counter() - t0, out
+
+    t_real, real = timed(lambda c: jnp.any(c[2]))
+    t_dead, dead = timed(lambda c: jnp.asarray(True))
+    np.testing.assert_array_equal(np.asarray(real[3]), np.asarray(dead[3]))
+    assert np.asarray(real[3]).max() <= 4  # immediate escapes
+    # ~50k steps vs ~1 segment: demand only a wide, flake-proof margin.
+    assert t_dead > 3 * t_real, (t_dead, t_real)
