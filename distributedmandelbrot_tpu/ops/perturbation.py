@@ -454,15 +454,66 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     out = np.concatenate(vals).copy()
     glitched = np.concatenate(glitches)
     bad = np.argwhere(glitched)
+    n_flagged = len(bad)
+    step = spec.step
+    if len(bad) > 1:
+        # Secondary-reference pass (Pauldelbrot's standard fix): pick
+        # the glitched pixel nearest the view center as a new reference
+        # — ONE further bigint orbit, the same cost as exactly
+        # recomputing a single pixel — and re-run just the glitched
+        # pixels' deltas against it on device.  Pixels that glitch
+        # against BOTH references fall through to the exact loop.
+        mid = np.array([(spec.height - 1) / 2, (spec.width - 1) / 2])
+        r2, c2 = bad[np.argmin(np.abs(bad - mid).sum(axis=1))]
+        d2_re = float((c2 - (spec.width - 1) / 2) * step)
+        d2_im = float((r2 - (spec.height - 1) / 2) * step)
+        pa = za + _to_fixed(d2_re, bits)
+        pb = zb + _to_fixed(d2_im, bits)
+        if julia_c is None:
+            z2_re, z2_im, n2v = _orbit_fixed(pa, pb, pa, pb, max_iter,
+                                             bits)
+        else:
+            z2_re, z2_im, n2v = _orbit_fixed(pa, pb, ca, cb, max_iter,
+                                             bits)
+        if n2v >= max_iter:
+            # Engage only when the secondary orbit covers the FULL
+            # budget: an early-escaping secondary would scan bounded
+            # lanes against its diverging post-escape extension, and
+            # while the cancellation tolerance flags them in practice,
+            # the budget-covering condition removes the hazard outright
+            # (glitches cluster around bounded structure, so the
+            # nearest-center glitched pixel is usually in-set and the
+            # pass engages).  Skipping costs one wasted orbit — the
+            # price of exactly one pixel of the fallback loop.
+            #
+            # Deltas relative to the secondary reference: exact in f64 —
+            # they are index differences at pixel scale.  Padded to a
+            # power-of-two length with far-exterior deltas so the jitted
+            # scans see stable shapes (a zoom animation's per-frame
+            # glitch count varies; each distinct shape would recompile).
+            k = len(bad)
+            k_pad = max(16, 1 << (k - 1).bit_length())
+            dre2 = np.full(k_pad, 3.0)
+            dim2 = np.zeros(k_pad)
+            dre2[:k] = (bad[:, 1] - c2).astype(np.float64) * step
+            dim2[:k] = (bad[:, 0] - r2).astype(np.float64) * step
+            v2, g2 = scan_fn(jnp.asarray(z2_re), jnp.asarray(z2_im),
+                             jnp.asarray(dre2.astype(dtype)),
+                             jnp.asarray(dim2.astype(dtype)))
+            v2 = np.asarray(v2)[:k]
+            g2 = np.asarray(g2)[:k]
+            fixed = bad[~g2]
+            out[fixed[:, 0], fixed[:, 1]] = v2[~g2]
+            bad = bad[g2]
     if len(bad) > max_glitch_fix:
         raise ValueError(
-            f"{len(bad)} glitched pixels (> {max_glitch_fix}); reference "
-            f"orbit unsuitable for this view")
-    # Exact per-pixel recompute in fixed point.  Pixel coordinates are
-    # center + delta, formed in fixed point so no precision is lost.
-    # (On the smooth plane this patches an *integer* count — a one-level
-    # banding artifact on isolated pixels.)
-    step = spec.step
+            f"{len(bad)} doubly-glitched pixels (> {max_glitch_fix}); "
+            f"no reference orbit suits this view")
+    # Exact per-pixel recompute in fixed point for the remainder.  Pixel
+    # coordinates are center + delta, formed in fixed point so no
+    # precision is lost.  (On the smooth plane this patches an *integer*
+    # count — a one-level banding artifact on isolated pixels; the
+    # second-reference pass above patches with true smooth values.)
     for r, c in bad:
         d_re = float((c - (spec.width - 1) / 2) * step)
         d_im = float((r - (spec.height - 1) / 2) * step)
@@ -472,7 +523,7 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
             pa, pb, max_iter, bits,
             ca=None if julia_c is None else ca,
             cb=None if julia_c is None else cb)
-    return out, len(bad)
+    return out, n_flagged
 
 
 def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
@@ -483,11 +534,12 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
                            ) -> tuple[np.ndarray, int]:
     """Escape counts for a deep-zoom tile via perturbation.
 
-    Returns ``(counts, n_glitched)``: int32 (height, width) counts in the
-    reference convention, and how many pixels needed the exact fixed-
-    point fallback.  Raises if more than ``max_glitch_fix`` pixels
-    glitch even with the auto-selected reference — exact recompute
-    would be quadratic; raise the probe density instead.
+    Returns ``(counts, n_glitched)``: int32 (height, width) counts in
+    the reference convention, and how many pixels the primary reference
+    FLAGGED as glitched (most are repaired on device by the secondary-
+    reference pass; only the doubly-glitched remainder pays the exact
+    fixed-point fallback).  Raises if more than ``max_glitch_fix``
+    pixels remain glitched against both references.
 
     ``julia_c=(re, im)`` (decimal strings) renders the Julia set for
     that constant instead — the spec's center then names a z-plane
@@ -637,10 +689,13 @@ def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
     """Smooth (band-free) deep-zoom values via perturbation.
 
     Returns ``(nu, n_glitched)``: float (height, width) renormalized
-    counts (0 = in-set), and the number of glitched pixels patched with
-    their *integer* count from the exact fixed-point fallback (a one-
-    level banding artifact on those isolated pixels — acceptable, since
-    the alternative is arbitrary-precision log arithmetic).
+    counts (0 = in-set), and how many pixels the primary reference
+    flagged as glitched.  Most are repaired on device with TRUE smooth
+    values by the secondary-reference pass; only pixels glitched
+    against both references are patched with their *integer* count from
+    the exact fixed-point fallback (a one-level banding artifact on
+    those isolated pixels — acceptable, since the alternative is
+    arbitrary-precision log arithmetic).
     """
     if max_iter <= 1:
         return np.zeros((spec.height, spec.width), dtype), 0
