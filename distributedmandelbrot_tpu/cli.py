@@ -203,7 +203,7 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
                  deep: bool | None = None,
                  julia_c: tuple[str, str] | None = None,
                  family: tuple[int, bool] | None = None,
-                 no_pallas: bool = False):
+                 no_pallas: bool = False, normalize: bool = False):
     """One view -> RGBA (Mandelbrot, or Julia when ``julia_c`` is set, or
     a Multibrot/Burning-Ship view when ``family=(power, burning)``),
     choosing direct vs perturbation rendering.  Shared by the render and
@@ -240,7 +240,8 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
                 nu = compute_tile_smooth_family(spec, max_iter, power=power,
                                                 burning=burning,
                                                 dtype=np_dtype)
-            return smooth_to_rgba(nu, max_iter, colormap=colormap)
+            return smooth_to_rgba(nu, max_iter, colormap=colormap,
+                              normalize=normalize)
         values = pallas_first("compute_tile_family_pallas", spec, max_iter,
                               power=power, burning=burning) \
             if np_dtype == np.float32 else None
@@ -265,7 +266,8 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
         if smooth:
             nu, _ = compute_smooth_perturb(dspec, max_iter, dtype=np_dtype,
                                            julia_c=julia_c)
-            return smooth_to_rgba(nu, max_iter, colormap=colormap)
+            return smooth_to_rgba(nu, max_iter, colormap=colormap,
+                              normalize=normalize)
         values = compute_tile_perturb(dspec, max_iter, dtype=np_dtype,
                                       julia_c=julia_c)
         return value_to_rgba(values.reshape(definition, definition),
@@ -285,7 +287,8 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
             from distributedmandelbrot_tpu.ops import compute_tile_smooth
             nu = compute_tile_smooth(spec, max_iter, dtype=np_dtype,
                                      julia_c=jc)
-        return smooth_to_rgba(nu, max_iter, colormap=colormap)
+        return smooth_to_rgba(nu, max_iter, colormap=colormap,
+                              normalize=normalize)
     if np_dtype == np.float32:
         # Integer f32 fast path, same Pallas-first policy.
         values = (pallas_first("compute_tile_pallas", spec, max_iter)
@@ -671,6 +674,13 @@ def cmd_render(argv: Sequence[str]) -> int:
     parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
                         help="arithmetic width (the algorithm still auto-selects: sub-f32-resolution f32 renders use f32 perturbation); default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
+    parser.add_argument("--normalize", action="store_true",
+                        help="stretch the view's own escaped-value range "
+                             "over the full colormap (--smooth only): "
+                             "deep windows occupy a sliver of the "
+                             "absolute scale and render near-flat "
+                             "without it; not offered for animate, "
+                             "where a per-frame stretch would flicker")
     _add_no_pallas(parser)
     parser.add_argument("--out", required=True, help="output PNG path")
     _add_common(parser)
@@ -680,6 +690,9 @@ def cmd_render(argv: Sequence[str]) -> int:
     _configure_logging(args)
 
     family = _resolve_family(args.fractal, args.power)
+    if args.normalize and not args.smooth:
+        raise SystemExit("--normalize applies to --smooth renders only "
+                         "(integer output is already quantized upstream)")
     if family is not None:
         if args.deep:
             raise SystemExit(f"--fractal {args.fractal} has no perturbation "
@@ -701,7 +714,8 @@ def cmd_render(argv: Sequence[str]) -> int:
                         colormap=args.colormap,
                         deep=True if args.deep else None,
                         julia_c=julia_c, family=family,
-                        no_pallas=args.no_pallas)
+                        no_pallas=args.no_pallas,
+                        normalize=args.normalize)
     _save_png(args.out, rgba)
     return 0
 

@@ -41,16 +41,31 @@ def value_to_rgba(values: np.ndarray, colormap: str = "jet") -> np.ndarray:
 
 
 def smooth_to_rgba(nu: np.ndarray, max_iter: int,
-                   colormap: str = "jet") -> np.ndarray:
+                   colormap: str = "jet",
+                   normalize: bool = False) -> np.ndarray:
     """Continuous escape values (:func:`...ops.escape_smooth`) -> RGBA.
 
     Same visual convention as :func:`value_to_rgba` — in-set (0) pixels
     black, others through the inverted colormap — but band-free: the
     fractional part of ``nu`` varies continuously across iteration
     boundaries.  Log-scaled so deep zooms (large max_iter) keep contrast.
+
+    ``normalize`` stretches the view's OWN escaped-value range over the
+    full colormap (log-domain min-max): deep windows occupy a sliver of
+    the absolute scale (a span-1e-10 view at budget 50000 spans ~6% of
+    it — near-flat color), and auto-contrast is what makes them
+    readable.  View-dependent by construction, so animations must NOT
+    use it per-frame (the stretch would flicker as ranges drift).
     """
     nu = np.asarray(nu, float)
-    vs = np.log1p(np.maximum(nu, 0.0)) / np.log1p(float(max_iter))
+    logs = np.log1p(np.maximum(nu, 0.0))
+    escaped = nu > 0.0
+    if normalize and escaped.any():
+        sel = logs[escaped]
+        lo, hi = float(sel.min()), float(sel.max())
+        vs = (logs - lo) / max(hi - lo, 1e-12)
+    else:
+        vs = logs / np.log1p(float(max_iter))
     return _masked_colormap(1.0 - np.clip(vs, 0.0, 1.0), nu <= 0.0, colormap)
 
 

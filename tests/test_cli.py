@@ -157,3 +157,31 @@ def test_dtype_auto_upgrades_below_f32_resolution():
     assert _resolve_dtype(ns(span=1e-5, definition=1024, smooth=True),
                           center=(-0.74529, 0.11307),
                           can_perturb=True) == np.float64
+
+
+def test_render_normalize_flag(tmp_path):
+    """--normalize stretches a deep window's sliver of the absolute
+    scale over the full colormap; rejected without --smooth."""
+    import numpy as np
+
+    from distributedmandelbrot_tpu.viewer import smooth_to_rgba
+
+    # Narrow band of values: absolute scaling is near-flat, normalized
+    # spans the map.
+    nu = np.linspace(300.0, 567.0, 64 * 64).reshape(64, 64)
+    nu[0, 0] = 0.0  # one in-set pixel stays black either way
+    flat = smooth_to_rgba(nu, 50_000)
+    stretched = smooth_to_rgba(nu, 50_000, normalize=True)
+    def n_colors(img):
+        return len(np.unique(img.reshape(-1, img.shape[-1]), axis=0))
+    assert n_colors(stretched) > 4 * n_colors(flat)
+    assert (stretched[0, 0] == flat[0, 0]).all()  # in-set convention kept
+
+    out = tmp_path / "n.png"
+    rc = cli.main(["render", "--smooth", "--normalize", "--definition",
+                   "48", "--max-iter", "64", "--span", "3.0",
+                   "--out", str(out)])
+    assert rc == 0 and out.exists()
+    with pytest.raises(SystemExit, match="--smooth renders only"):
+        cli.main(["render", "--normalize", "--definition", "48",
+                  "--out", str(tmp_path / "x.png")])
