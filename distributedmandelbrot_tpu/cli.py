@@ -808,16 +808,51 @@ def cmd_animate(argv: Sequence[str]) -> int:
     return 0
 
 
+def cmd_compact(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu compact",
+        description="Offline maintenance: rewrite the append-only tile "
+                    "index with one (last-wins) entry per tile and delete "
+                    "chunk files nothing references.  Claims every level "
+                    "in the index, so it fails loudly if a coordinator is "
+                    "running on the same data directory.")
+    parser.add_argument("-o", "--data-dir", default="",
+                        help="parent directory of Data/ (default: cwd)")
+    parser.add_argument("--keep-orphans", action="store_true",
+                        help="only rewrite the index; leave unreferenced "
+                             "chunk files in place")
+    _add_common(parser)
+    args = parser.parse_args(argv)
+    _configure_logging(args)
+
+    from distributedmandelbrot_tpu.storage.index import CorruptIndexError
+    from distributedmandelbrot_tpu.storage.ownership import LevelOwnedError
+    from distributedmandelbrot_tpu.storage.store import DataDirError, compact
+
+    try:
+        stats = compact(args.data_dir,
+                        remove_orphans=not args.keep_orphans)
+    except (DataDirError, LevelOwnedError, CorruptIndexError,
+            RuntimeError) as e:
+        raise SystemExit(f"dmtpu compact: {e}")
+    print(f"compacted: {stats['entries_before']} -> "
+          f"{stats['entries_after']} entries, "
+          f"{stats['orphans_removed']} orphan file(s) removed, "
+          f"index now {stats['index_bytes']} bytes", flush=True)
+    return 0
+
+
 COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
             "viewer": cmd_viewer, "render": cmd_render,
-            "animate": cmd_animate}
+            "animate": cmd_animate, "compact": cmd_compact}
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
-              "{coordinator|worker|viewer|render|animate} [options]\n"
+              "{coordinator|worker|viewer|render|animate|compact} "
+              "[options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

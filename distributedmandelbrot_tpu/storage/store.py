@@ -219,3 +219,107 @@ class ChunkStore:
             raise ValueError(
                 f"chunk file {entry.filename} decodes to {data.size} pixels")
         return Chunk(*entry.key, data)
+
+
+def compact(parent_dir: str = "", *, remove_orphans: bool = True,
+            fsync: bool = True) -> dict:
+    """Rewrite ``Data/_index.dat`` with one (last-wins) entry per tile
+    and optionally delete chunk files no surviving entry references.
+
+    The reference's index is append-only by design (``DataStorage.cs``
+    has no compaction; duplicate entries accumulate on re-saves and old
+    chunk-file versions linger via collision suffixing) — fine for a
+    run, unbounded for a long-lived farm.  Offline maintenance:
+
+    - claims EVERY level present in the index via the flock ownership
+      locks, so running against a live coordinator fails loudly instead
+      of racing its appends;
+    - last entry per tile key wins (the store's own read rule);
+    - the new index is written to a temp file and atomically renamed,
+      with the directory fsynced, so a crash leaves either the old or
+      the new index — never a torn one;
+    - orphan removal only touches files matching the chunk-name pattern
+      ``level;re;im[suffix]`` for tiles the index knows, never foreign
+      files.
+
+    Returns a stats dict: entries before/after, orphans removed, bytes
+    reclaimed from the index.
+    """
+    import re as _re
+
+    from distributedmandelbrot_tpu.storage.ownership import LevelClaims
+
+    probe = os.path.join(parent_dir, DATA_DIR_NAME, INDEX_FILENAME)
+    if not os.path.exists(probe):
+        # A maintenance command must not scaffold a farm out of a typo'd
+        # path (ChunkStore.setup would create Data/ and an empty index,
+        # masking the mistake as 'compacted: 0 -> 0').
+        raise DataDirError(f"no tile index at {probe!r}; nothing to "
+                           "compact (check -o)")
+    store = ChunkStore(parent_dir)
+    # Size BEFORE reading entries: an append landing between the two is
+    # then included in the final-size comparison (conservative abort),
+    # never silently dropped by the rewrite.
+    size_at_read = os.path.getsize(store.index_path)
+    entries = store.entries()
+    if not entries:
+        # Nothing to compact — and rewriting an empty index beside a
+        # just-started coordinator (no entries yet, so no levels to
+        # claim) could drop its first concurrent append.
+        return {"entries_before": 0, "entries_after": 0,
+                "orphans_removed": 0,
+                "index_bytes": os.path.getsize(store.index_path)}
+    levels = sorted({e.level for e in entries})
+    claims = LevelClaims(store.data_dir, levels)
+    try:
+        last: dict[tuple[int, int, int], IndexEntry] = {}
+        for e in entries:
+            last[e.key] = e
+        kept = [last[k] for k in sorted(last)]
+        tmp = store.index_path + ".compact"
+        with open(tmp, "wb") as f:
+            for e in kept:
+                f.write(e.to_bytes())
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        # The level claims exclude coordinators serving the levels we
+        # read; a coordinator serving a level NOT yet in the index could
+        # still append concurrently.  Last-moment growth check narrows
+        # that window to microseconds and fails loudly instead of
+        # silently dropping the newcomer's entries.
+        if os.path.getsize(store.index_path) != size_at_read:
+            os.unlink(tmp)
+            raise RuntimeError(
+                "index grew during compaction; a coordinator appears to "
+                "be running on this data directory — stop it first")
+        os.replace(tmp, store.index_path)
+        if fsync:
+            dir_fd = os.open(store.data_dir, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+
+        removed = 0
+        if remove_orphans:
+            referenced = {e.filename for e in kept if e.filename}
+            # Chunk files are all-digit 'level;re;im[suffix]' names (the
+            # suffix is indistinguishable from trailing index digits);
+            # '.tmp' leftovers are saves that crashed before their
+            # rename — safe to sweep under the level claims.
+            pat = _re.compile(r"^\d+;\d+;\d+(\.tmp)?$")
+            for name in os.listdir(store.data_dir):
+                if name in referenced or not pat.match(name):
+                    continue
+                try:
+                    os.unlink(os.path.join(store.data_dir, name))
+                    removed += 1
+                except OSError:
+                    pass
+        before = len(entries)
+        return {"entries_before": before, "entries_after": len(kept),
+                "orphans_removed": removed,
+                "index_bytes": os.path.getsize(store.index_path)}
+    finally:
+        claims.release()

@@ -254,3 +254,57 @@ def test_level_claims_released_on_failed_startup(tmp_path):
     from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
     with EmbeddedCoordinator(str(tmp_path), [LevelSetting(2, 16)]):
         pass
+
+
+def test_compact_rewrites_index_and_removes_orphans(tmp_path):
+    """compact(): one last-wins entry per tile, orphaned chunk-file
+    versions removed, loads identical before/after, coordinator lock
+    respected."""
+    import os
+
+    import numpy as np
+    import pytest
+
+    from distributedmandelbrot_tpu.core.chunk import Chunk
+    from distributedmandelbrot_tpu.storage.ownership import LevelClaims, \
+        LevelOwnedError
+    from distributedmandelbrot_tpu.storage.store import ChunkStore, compact
+
+    store = ChunkStore(str(tmp_path))
+    rng = np.random.default_rng(11)
+
+    def chunk(level, i, j, fill=None):
+        data = (np.full(CHUNK_PIXELS, fill, np.uint8) if fill is not None
+                else rng.integers(0, 255, CHUNK_PIXELS, np.uint8))
+        return Chunk(level, i, j, data)
+
+    c1 = chunk(2, 0, 0)
+    c1b = chunk(2, 0, 0)   # re-save: duplicate entry + suffixed file
+    c2 = chunk(2, 1, 1, fill=0)   # Never (tag-only)
+    c3 = chunk(3, 2, 2)
+    for c in (c1, c1b, c2, c3):
+        store.save(c)
+    assert len(store.entries()) == 4
+    files_before = [n for n in os.listdir(store.data_dir)
+                    if not n.startswith("_")]
+    assert len(files_before) == 3  # base, suffixed dupe, c3
+
+    # A live coordinator (level claim held) blocks compaction.
+    claims = LevelClaims(store.data_dir, [2])
+    with pytest.raises(LevelOwnedError):
+        compact(str(tmp_path))
+    claims.release()
+
+    want = {k: store.load(*k).data.tobytes()
+            for k in [(2, 0, 0), (2, 1, 1), (3, 2, 2)]}
+    stats = compact(str(tmp_path))
+    assert stats["entries_before"] == 4 and stats["entries_after"] == 3
+    assert stats["orphans_removed"] == 1  # c1's superseded file version
+
+    store2 = ChunkStore(str(tmp_path))
+    assert len(store2.entries()) == 3
+    for k, data in want.items():
+        assert store2.load(*k).data.tobytes() == data
+    # Idempotent.
+    stats2 = compact(str(tmp_path))
+    assert stats2["entries_before"] == 3 and stats2["orphans_removed"] == 0
