@@ -65,6 +65,9 @@ def main() -> int:
     parser.add_argument("--deep", action="store_true",
                         help="add a depth-5000 seahorse config (cycle-probe "
                              "scratch in play)")
+    parser.add_argument("--xla", action="store_true",
+                        help="also sweep the XLA path's segment size "
+                             "(escape_loop's early-exit granularity)")
     parser.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "sweep_results.jsonl"))
     args = parser.parse_args()
@@ -94,6 +97,12 @@ def main() -> int:
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
     best: dict[str, tuple[float, dict]] = {}
+
+    def emit(out_f, rec):
+        out_f.write(json.dumps(rec) + "\n")
+        out_f.flush()
+        print(json.dumps(rec), flush=True)
+
     with open(args.out, "a") as out_f:
         for (name, center, span, depth, burning) in views:
             params = _grid_params(center, span, tile, k)
@@ -118,12 +127,35 @@ def main() -> int:
                     rec = {"ts": stamp, "view": name, "depth": depth,
                            "tile": tile, "k": k, **kw,
                            "mpix_s": round(rate, 2)}
-                    out_f.write(json.dumps(rec) + "\n")
-                    out_f.flush()
-                    print(json.dumps(rec), flush=True)
+                    emit(out_f, rec)
                     key = f"{name}{'' if interior else ':raw'}"
                     if rate > best.get(key, (0.0, {}))[0]:
                         best[key] = (rate, rec)
+
+    if args.xla:
+        from bench import _xla_chain
+        from distributedmandelbrot_tpu.parallel import tile_mesh
+        mesh = tile_mesh()
+        print("\n=== XLA segment sweep ===", flush=True)
+        with open(args.out, "a") as out_f:
+            for (name, center, span, depth, burning) in views:
+                if burning:
+                    continue  # the sharded XLA chain is Mandelbrot-only
+                params = _grid_params(center, span, tile, k)
+                mrds = np.full(k, depth, np.int64)
+                for segment in (64, 128, 256, 512):
+                    try:  # one failing config must not kill the sweep
+                        t = _time_chain(
+                            _xla_chain(mesh, params, mrds, tile, segment,
+                                       np.float32), args.repeats)
+                    except Exception as e:
+                        print(f"xla {name} segment={segment}: FAILED "
+                              f"{type(e).__name__}: {e}", flush=True)
+                        continue
+                    emit(out_f, {"ts": stamp, "view": name, "depth": depth,
+                                 "tile": tile, "k": k, "path": "xla",
+                                 "segment": segment,
+                                 "mpix_s": round(pixels / t / 1e6, 2)})
 
     print("\n=== best per view ===")
     for key in sorted(best):
