@@ -1,0 +1,168 @@
+"""Stateful property test of the TileScheduler (hypothesis).
+
+The unit tests pin known interleavings; this machine explores random
+sequences of the real farm operations — grants, claims, finishes,
+releases, abandonments, time advance, sweeps, save-failure reopens —
+against a model, checking after every step the invariants the
+at-least-once/dedup design promises (survey §5.2/§5.3):
+
+- a completed tile is never granted again (unless explicitly reopened)
+- a tile never completes twice (claim tokens dedup late submissions)
+- grants never exceed one live lease/claim per tile
+- whenever work remains and no lease blocks it, acquire() makes progress
+- after quiescence (expire + drain), every tile is completed exactly once
+"""
+
+from __future__ import annotations
+
+import hypothesis.strategies as st
+from hypothesis import settings
+from hypothesis.stateful import (RuleBasedStateMachine, invariant,
+                                 precondition, rule)
+
+from distributedmandelbrot_tpu.coordinator.clock import ManualClock
+from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
+from distributedmandelbrot_tpu.core.workload import LevelSetting
+
+LEASE = 10.0
+
+
+class SchedulerMachine(RuleBasedStateMachine):
+    def __init__(self):
+        super().__init__()
+        self.clock = ManualClock()
+        self.sched = TileScheduler([LevelSetting(2, 50), LevelSetting(3, 70)],
+                                   lease_timeout=LEASE, clock=self.clock)
+        self.total = self.sched.total_tiles
+        self.leased: dict = {}   # key -> workload ("worker holds lease")
+        self.claims: dict = {}   # key -> (workload, token): echo accepted,
+        #                          payload in flight (may expire mid-flight)
+        self.completed: set = set()
+
+    # -- worker-side operations -------------------------------------------
+
+    @rule()
+    def acquire(self):
+        w = self.sched.acquire()
+        if w is not None:
+            assert w.key not in self.completed, \
+                "completed tile granted again"
+            self.leased[w.key] = w
+
+    @precondition(lambda self: self.leased)
+    @rule(data=st.data())
+    def claim_result(self, data):
+        """The 16-byte echo arrives: lease -> claim (payload in flight).
+        While claimed, no second claim for the tile may exist."""
+        key = data.draw(st.sampled_from(sorted(self.leased)))
+        w = self.leased.pop(key)
+        token = self.sched.claim(w)
+        if token is None:
+            return  # lease expired under us — tile will be re-granted
+        assert self.sched.claim(w) is None  # lease consumed by the claim
+        self.claims[key] = (w, token)
+
+    @precondition(lambda self: self.claims)
+    @rule(data=st.data())
+    def finish_claimed(self, data):
+        """The payload lands; expired-claim finishes must requeue, not
+        complete."""
+        key = data.draw(st.sampled_from(sorted(self.claims)))
+        w, token = self.claims.pop(key)
+        ok = self.sched.finish_claim(w, token)
+        if ok:
+            assert key not in self.completed, "tile completed twice"
+            self.completed.add(key)
+
+    @precondition(lambda self: self.claims)
+    @rule(data=st.data())
+    def finish_with_stale_token(self, data):
+        """A dawdler's finish with a WRONG token must be rejected and
+        must not consume the live claim."""
+        key = data.draw(st.sampled_from(sorted(self.claims)))
+        w, token = self.claims[key]
+        assert self.sched.finish_claim(w, token + 1_000_000) is False
+        # The live claim is untouched: the real token still works later.
+
+    @precondition(lambda self: self.claims)
+    @rule(data=st.data())
+    def release_claimed(self, data):
+        """Upload aborts; the tile must become grantable again."""
+        key = data.draw(st.sampled_from(sorted(self.claims)))
+        w, token = self.claims.pop(key)
+        self.sched.release_claim(w, token)
+
+    @precondition(lambda self: self.leased)
+    @rule(data=st.data())
+    def abandon(self, data):
+        # Worker crash: drop the lease on the floor (expiry reclaims it).
+        key = data.draw(st.sampled_from(sorted(self.leased)))
+        del self.leased[key]
+
+    # -- coordinator-side operations --------------------------------------
+
+    @rule()
+    def advance_past_expiry(self):
+        self.clock.advance(LEASE + 1.0)
+        # Everything outstanding just expired; workers' in-hand leases
+        # and claims are now stale (their finishes must requeue/reject —
+        # exercised by finish_claimed drawing an expired claim).
+        self.leased.clear()
+
+    @rule()
+    def small_advance(self):
+        self.clock.advance(1.0)
+
+    @rule()
+    def sweep(self):
+        self.sched.sweep()
+
+    @precondition(lambda self: self.completed)
+    @rule(data=st.data())
+    def reopen_failed_save(self, data):
+        key = data.draw(st.sampled_from(sorted(self.completed)))
+        from distributedmandelbrot_tpu.core.workload import Workload
+        # None mrd: the null-wildcard identity disk-seeded entries use.
+        self.sched.reopen(Workload(key[0], None, key[1], key[2]))
+        self.completed.discard(key)
+
+    # -- invariants --------------------------------------------------------
+
+    @invariant()
+    def counts_agree(self):
+        assert self.sched.completed_count == len(self.completed)
+        assert self.sched.completed_count <= self.total
+        assert self.sched.is_complete() == (len(self.completed)
+                                            == self.total)
+
+    @invariant()
+    def progress_is_possible(self):
+        """If nothing is leased/claimed and work remains, acquire() must
+        grant (no lost tiles)."""
+        if (not self.leased and not self.claims
+                and self.sched.outstanding_leases == 0
+                and len(self.completed) < self.total):
+            w = self.sched.acquire()
+            assert w is not None, "work remains but nothing grantable"
+            self.leased[w.key] = w
+
+    def teardown(self):
+        """Drive to quiescence: every tile must complete exactly once."""
+        guard = 0
+        while not self.sched.is_complete():
+            w = self.sched.acquire()
+            if w is None:
+                self.clock.advance(LEASE + 1.0)
+                self.sched.sweep()
+                guard += 1
+                assert guard < 1000, "farm cannot drain"
+                continue
+            assert w.key not in self.completed
+            assert self.sched.complete(w)
+            self.completed.add(w.key)
+        assert len(self.completed) == self.total
+
+
+TestSchedulerProperties = SchedulerMachine.TestCase
+TestSchedulerProperties.settings = settings(
+    max_examples=60, stateful_step_count=60, deadline=None)

@@ -137,6 +137,7 @@ def main() -> int:
         from distributedmandelbrot_tpu.parallel import tile_mesh
         mesh = tile_mesh()
         print("\n=== XLA segment sweep ===", flush=True)
+        xla_best: dict[str, tuple[float, int]] = {}
         with open(args.out, "a") as out_f:
             for (name, center, span, depth, burning) in views:
                 if burning:
@@ -152,17 +153,25 @@ def main() -> int:
                         print(f"xla {name} segment={segment}: FAILED "
                               f"{type(e).__name__}: {e}", flush=True)
                         continue
+                    rate = pixels / t / 1e6
                     emit(out_f, {"ts": stamp, "view": name, "depth": depth,
                                  "tile": tile, "k": k, "path": "xla",
                                  "segment": segment,
-                                 "mpix_s": round(pixels / t / 1e6, 2)})
+                                 "mpix_s": round(rate, 2)})
+                    if rate > xla_best.get(name, (0.0, 0))[0]:
+                        xla_best[name] = (rate, segment)
 
-    print("\n=== best per view ===")
+    print("\n=== best per view (pallas) ===")
     for key in sorted(best):
         rate, rec = best[key]
         print(f"{key:24s} {rate:8.1f} Mpix/s  "
               f"bh={rec['block_h']} bw={rec['block_w']} "
               f"unroll={rec['unroll']}")
+    if args.xla:
+        print("\n=== best per view (xla segment) ===")
+        for name in sorted(xla_best):
+            rate, segment = xla_best[name]
+            print(f"{name:24s} {rate:8.1f} Mpix/s  segment={segment}")
     return 0
 
 
