@@ -755,6 +755,12 @@ def cmd_animate(argv: Sequence[str]) -> int:
     _add_no_pallas(parser)
     parser.add_argument("--out-dir", required=True,
                         help="directory for frame_NNNN.png files")
+    parser.add_argument("--gif", metavar="PATH", default=None,
+                        help="additionally assemble the frames into an "
+                             "animated GIF at PATH (PIL; no ffmpeg "
+                             "needed)")
+    parser.add_argument("--frame-ms", type=int, default=80,
+                        help="GIF frame duration in milliseconds")
     _add_common(parser)
     args = parser.parse_args(
         _join_negative_values(argv, ("--center", "--c")))
@@ -805,6 +811,23 @@ def cmd_animate(argv: Sequence[str]) -> int:
     pixels = args.frames * args.definition * args.definition
     print(f"animation done: {args.frames} frames, "
           f"{pixels / dt / 1e6:.1f} Mpix/s end-to-end", flush=True)
+    if args.gif:
+        from PIL import Image
+
+        def frame(f):
+            return Image.open(
+                os.path.join(args.out_dir, f"frame_{f:04d}.png")).convert(
+                    "P", palette=Image.Palette.ADAPTIVE)
+
+        # Stream the tail frames through a generator: a deep zoom runs to
+        # hundreds of frames, and materializing them all (this command's
+        # own use case) would hold gigabytes before the save.
+        frame(0).save(args.gif, save_all=True,
+                      append_images=(frame(f) for f in
+                                     range(1, args.frames)),
+                      duration=args.frame_ms, loop=0)
+        print(f"wrote {args.gif} ({args.frames} frames @ "
+              f"{args.frame_ms}ms)", flush=True)
     return 0
 
 

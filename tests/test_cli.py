@@ -185,3 +185,18 @@ def test_render_normalize_flag(tmp_path):
     with pytest.raises(SystemExit, match="--smooth renders only"):
         cli.main(["render", "--normalize", "--definition", "48",
                   "--out", str(tmp_path / "x.png")])
+
+
+def test_animate_gif_assembly(tmp_path):
+    """--gif assembles the rendered frames into an animated GIF."""
+    from PIL import Image
+
+    out_dir = tmp_path / "frames"
+    gif = tmp_path / "zoom.gif"
+    rc = cli.main(["animate", "--center=-0.745,0.11", "--span-start", "2.0",
+                   "--span-end", "0.5", "--frames", "3", "--definition",
+                   "48", "--max-iter", "32", "--out-dir", str(out_dir),
+                   "--gif", str(gif), "-q"])
+    assert rc == 0 and gif.exists()
+    with Image.open(gif) as img:
+        assert getattr(img, "n_frames", 1) == 3

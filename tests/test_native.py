@@ -31,6 +31,7 @@ def test_rle_native_and_python_agree_bytewise_property():
     must produce the SAME bytes and decode each other's output (a farm
     may mix hosts with and without the toolchain; stored payloads must
     interop).  Exercises the real shipped encoders on both sides."""
+    pytest.importorskip("hypothesis")
     from hypothesis import given, settings, strategies as st
 
     from distributedmandelbrot_tpu.codecs.rle import RleCodec

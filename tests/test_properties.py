@@ -12,6 +12,10 @@ from __future__ import annotations
 import io
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from distributedmandelbrot_tpu import codecs

@@ -15,6 +15,10 @@ at-least-once/dedup design promises (survey §5.2/§5.3):
 
 from __future__ import annotations
 
+import pytest
+
+pytest.importorskip("hypothesis")
+
 import hypothesis.strategies as st
 from hypothesis import settings
 from hypothesis.stateful import (RuleBasedStateMachine, invariant,
