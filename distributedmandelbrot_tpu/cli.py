@@ -491,12 +491,13 @@ def cmd_worker(argv: Sequence[str]) -> int:
     from distributedmandelbrot_tpu.worker import DistributerClient, Worker
 
     if args.multihost:
-        # The SPMD worker computes through the sharded XLA path on the
-        # global mesh; per-tile backend/kernel selection does not apply.
-        if args.backend != "auto" or args.kernel != "auto":
-            raise SystemExit("--multihost ignores --backend/--kernel "
-                             "(it always computes on the global mesh); "
-                             "drop those flags")
+        # The SPMD worker always computes on the global mesh; --kernel
+        # picks the per-device compute (auto = Pallas when every rank
+        # can run it, else XLA), but per-tile --backend does not apply.
+        if args.backend != "auto":
+            raise SystemExit("--multihost ignores --backend (it always "
+                             "computes on the global mesh); use --kernel "
+                             "to pick the device kernel")
         import jax
 
         from distributedmandelbrot_tpu.parallel import multihost
@@ -520,7 +521,8 @@ def cmd_worker(argv: Sequence[str]) -> int:
             rounds = multihost.run_spmd_worker(
                 args.host, args.port, batch_per_device=per_dev,
                 poll=args.poll,
-                dtype=_NP_DTYPES[args.dtype or "f32"])
+                dtype=_NP_DTYPES[args.dtype or "f32"],
+                kernel=args.kernel)
         finally:
             if profiling:
                 jax.profiler.stop_trace()

@@ -59,12 +59,18 @@ def batched_escape_pixels_multihost(mesh: Mesh,
                                     definition: int,
                                     dtype=np.float32,
                                     segment: Optional[int] = None,
-                                    clamp: bool = False) -> np.ndarray:
+                                    clamp: bool = False,
+                                    kernel: str = "auto",
+                                    interpret: Optional[bool] = None
+                                    ) -> np.ndarray:
     """SPMD tile batch over a multi-host mesh.
 
     Every process calls this with its *own* tiles (the global batch is the
     concatenation in process order); each gets back its local results as
-    uint8 ``(k_local, definition, definition)``.  Compilation is a
+    uint8 ``(k_local, definition, definition)``.  ``segment`` tunes the
+    XLA path's escape-check granularity only — when the Pallas kernel is
+    selected (``kernel='auto'`` on an all-TPU slice) the analogous knob
+    is the kernel's unroll, and ``segment`` is not consulted.  Compilation is a
     collective — all processes must make the same call with the same
     static shapes, the SPMD contract of ``jax.distributed``.  The local
     tile count must be identical on every process and a multiple of the
@@ -78,6 +84,8 @@ def batched_escape_pixels_multihost(mesh: Mesh,
     from distributedmandelbrot_tpu.parallel.sharding import (
         INT32_SCALE_LIMIT, _batched_escape_sharded)
 
+    if kernel not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown kernel {kernel!r}")
     if segment is None:
         segment = DEFAULT_SEGMENT
     k_local = starts_steps_local.shape[0]
@@ -93,8 +101,23 @@ def batched_escape_pixels_multihost(mesh: Mesh,
     # counts, validating k_local % n_local process-locally would raise on
     # one host while the rest proceed into the sharded collective (hang).
     ok_local = int(k_local > 0 and k_local % n_local == 0)
+    # Kernel eligibility is part of the SPMD agreement: compilation is a
+    # collective, so EVERY rank must take the same kernel branch (a host
+    # missing the Pallas backend must demote the whole slice to XLA).
+    if kernel == "xla":
+        pallas_local = 0
+    else:
+        from distributedmandelbrot_tpu.ops.pallas_escape import (
+            PallasUnsupported, fit_blocks, pallas_available)
+        try:
+            fit_blocks(definition, definition)
+            pallas_local = int((pallas_available() or interpret is True)
+                               and np.dtype(dtype) == np.float32)
+        except PallasUnsupported:
+            pallas_local = 0
     gathered = multihost_utils.process_allgather(
-        np.asarray([k_local, cap_local, ok_local], np.int64)).reshape(-1, 3)
+        np.asarray([k_local, cap_local, ok_local, pallas_local],
+                   np.int64)).reshape(-1, 4)
     ks = gathered[:, 0]
     cap = int(gathered[:, 1].max())
     if (ks != k_local).any() or not gathered[:, 2].all():
@@ -102,6 +125,10 @@ def batched_escape_pixels_multihost(mesh: Mesh,
             f"every process must contribute the same non-zero multiple of "
             f"its local device count; local batches were {ks.tolist()}, "
             f"alignment flags {gathered[:, 2].tolist()}")
+    use_pallas = bool(gathered[:, 3].all()) and cap - 1 < INT32_SCALE_LIMIT
+    if kernel == "pallas" and not use_pallas:
+        raise ValueError("kernel='pallas' requested but not every rank "
+                         "can run it (availability/dtype/cap)")
     # Same widening policy as the single-host batched_escape_pixels
     # (sharding.py): counts*256 must not overflow int32.
     if cap - 1 >= INT32_SCALE_LIMIT or np.dtype(dtype) == np.float64:
@@ -110,13 +137,33 @@ def batched_escape_pixels_multihost(mesh: Mesh,
     mrd_dtype = np.int64 if cap - 1 >= INT32_SCALE_LIMIT else np.int32
 
     sharding = NamedSharding(mesh, P(TILE_AXIS))
-    params = jax.make_array_from_process_local_data(
-        sharding, np.asarray(starts_steps_local, dtype))
-    mrd_arr = jax.make_array_from_process_local_data(
-        sharding, np.asarray(mrds_local, mrd_dtype))
-    out = _batched_escape_sharded(params, mrd_arr, mesh=mesh,
-                                  definition=definition, max_iter_cap=cap,
-                                  segment=segment, clamp=clamp)
+    if use_pallas:
+        from distributedmandelbrot_tpu.parallel.sharding import (
+            _batched_pallas_sharded, pallas_batch_config,
+            widen_square_pitch)
+        # One shared static-dispatch policy with the single-host path
+        # (bucketed cap, TRUE-budget probe resolution, block shape) —
+        # computed from the globally-agreed cap so every rank compiles
+        # the identical executable.
+        cfg = pallas_batch_config(definition, cap, interpret=interpret)
+        params = jax.make_array_from_process_local_data(
+            sharding, widen_square_pitch(
+                np.asarray(starts_steps_local, np.float64)).astype(
+                    np.float32))
+        mrd_arr = jax.make_array_from_process_local_data(
+            sharding, np.asarray(mrds_local, np.int32))
+        out = _batched_pallas_sharded(
+            params, mrd_arr, mesh=mesh, definition=definition,
+            clamp=clamp, **cfg)
+    else:
+        params = jax.make_array_from_process_local_data(
+            sharding, np.asarray(starts_steps_local, dtype))
+        mrd_arr = jax.make_array_from_process_local_data(
+            sharding, np.asarray(mrds_local, mrd_dtype))
+        out = _batched_escape_sharded(params, mrd_arr, mesh=mesh,
+                                      definition=definition,
+                                      max_iter_cap=cap,
+                                      segment=segment, clamp=clamp)
     shards = sorted(out.addressable_shards, key=lambda s: s.index[0].start)
     return np.concatenate([np.asarray(s.data) for s in shards])
 
@@ -124,7 +171,8 @@ def batched_escape_pixels_multihost(mesh: Mesh,
 def run_spmd_worker(host: str, port: int, *, definition: int | None = None,
                     batch_per_device: int = 1, poll: float = 0.0,
                     dtype=np.float32, clamp: bool = False,
-                    mesh: Optional[Mesh] = None) -> int:
+                    mesh: Optional[Mesh] = None,
+                    kernel: str = "auto") -> int:
     """The multi-host farm worker: one slice-spanning SPMD pull loop.
 
     Run the same invocation on every process of the slice (after
@@ -222,7 +270,8 @@ def run_spmd_worker(host: str, port: int, *, definition: int | None = None,
         out_local = batched_escape_pixels_multihost(
             mesh, params[lo:lo + k_local],
             np.maximum(rows[lo:lo + k_local, 1], 1),
-            definition=definition, dtype=dtype, clamp=clamp)
+            definition=definition, dtype=dtype, clamp=clamp,
+            kernel=kernel)
         gathered = multihost_utils.process_allgather(out_local)
         if primary:
             full = gathered.reshape(k_global, definition, definition)

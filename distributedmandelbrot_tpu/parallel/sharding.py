@@ -227,6 +227,30 @@ def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
                      out_specs=P(TILE_AXIS), check_vma=False)(params, mrds)
 
 
+def pallas_batch_config(definition: int, cap: int,
+                        interpret: bool | None = None) -> dict:
+    """The shared static-dispatch policy for a Pallas tile batch —
+    bucketed compile cap, block shape, probe resolution from the TRUE
+    deepest budget (not the padded cap), interpret auto-selection — used
+    by both the single-host and the multihost sharded paths so the two
+    can never drift.  Raises PallasUnsupported for int64 caps and
+    unsupported tile extents."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        DEFAULT_UNROLL, PallasUnsupported, bucket_cap, fit_blocks,
+        pallas_available)
+
+    if cap - 1 >= INT32_SCALE_LIMIT:
+        raise PallasUnsupported(
+            "pallas path is int32-only; cap needs the XLA path")
+    block_h, block_w = fit_blocks(definition, definition)
+    return {"max_iter_cap": bucket_cap(cap),
+            "cycle_check": resolve_cycle_check(None, cap),
+            "block_h": block_h, "block_w": block_w,
+            "unroll": DEFAULT_UNROLL,
+            "interpret": (not pallas_available() if interpret is None
+                          else interpret)}
+
+
 def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
                                  mrds: np.ndarray, *, definition: int,
                                  clamp: bool = False,
@@ -240,35 +264,21 @@ def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
     needs int64 — callers fall back to the XLA path (see
     :meth:`MeshBackend.compute_batch`).
     """
-    from distributedmandelbrot_tpu.ops.pallas_escape import (
-        PallasUnsupported, fit_blocks, pallas_available, DEFAULT_UNROLL)
-
     k = starts_steps.shape[0]
     if k == 0:
         return np.zeros((0, definition, definition), np.uint8)
-    cap = int(mrds.max())
-    if cap - 1 >= INT32_SCALE_LIMIT:
-        raise PallasUnsupported(
-            "pallas path is int32-only; cap needs the XLA path")
-    from distributedmandelbrot_tpu.ops.pallas_escape import bucket_cap
-    # Probe policy from the batch's true deepest budget, not the padded
-    # compile cap (same policy as compute_tile_pallas_device).
-    cycle_check = resolve_cycle_check(cycle_check, cap)
-    cap = bucket_cap(cap)
-    block_h, block_w = fit_blocks(definition, definition)
-    if interpret is None:
-        interpret = not pallas_available()
+    cfg = pallas_batch_config(definition, int(mrds.max()),
+                              interpret=interpret)
+    if cycle_check is not None:
+        cfg["cycle_check"] = cycle_check
     starts_steps, mrds = pad_to_mesh(starts_steps, mrds, mesh.devices.size)
     starts_steps = widen_square_pitch(starts_steps)
     sharding = NamedSharding(mesh, P(TILE_AXIS))
     params = jax.device_put(jnp.asarray(starts_steps, jnp.float32), sharding)
     mrd_arr = jax.device_put(jnp.asarray(mrds, jnp.int32), sharding)
     out = _batched_pallas_sharded(params, mrd_arr, mesh=mesh,
-                                  definition=definition, max_iter_cap=cap,
-                                  unroll=DEFAULT_UNROLL, block_h=block_h,
-                                  block_w=block_w, clamp=clamp,
-                                  interpret=interpret,
-                                  cycle_check=cycle_check)
+                                  definition=definition, clamp=clamp,
+                                  **cfg)
     return np.asarray(out)[:k]
 
 

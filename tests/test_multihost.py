@@ -69,6 +69,23 @@ local = multihost.batched_escape_pixels_multihost(
 assert local.shape == (2, definition, definition), local.shape
 assert local.dtype == np.uint8
 
+# Pallas leg: the same batch through the sharded Pallas kernel across
+# both processes (interpreter off-TPU), within the f32 statistical band
+# of the XLA f32 result.  definition 128 = the kernel's lane granule.
+pdef = 128
+pparams = np.empty((2, 3))
+for i in range(2):
+    pspec = TileSpec.for_chunk(level, i, pid, definition=pdef)
+    pparams[i] = (pspec.start_real, pspec.start_imag,
+                  pspec.range_real / (pdef - 1))
+pal = multihost.batched_escape_pixels_multihost(
+    mesh, pparams, mrds, definition=pdef, dtype=np.float32,
+    kernel="pallas", interpret=True)
+xla32 = multihost.batched_escape_pixels_multihost(
+    mesh, pparams, mrds, definition=pdef, dtype=np.float32)
+mism = float((pal != xla32).mean())
+assert mism <= 0.001, f"multihost pallas vs xla: {mism:.2%}"
+
 for i, spec in enumerate(specs):
     # Device grids are start + k*step (not linspace), so compare against
     # the golden on the same grid: exact in f64 up to FMA contraction.
