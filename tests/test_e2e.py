@@ -175,3 +175,65 @@ def test_coordinator_stats_reporting(tmp_path, caplog):
     stats_lines = [r for r in caplog.records if "stats:" in r.message]
     assert stats_lines, "no stats line logged within 5s"
     assert "0/1 tiles complete" in stats_lines[0].message
+
+
+def test_concurrent_fetch_burst_during_writes(tmp_path):
+    """8 viewer threads hammer the DataServer while the worker is still
+    uploading: every fetch must return either NOT_AVAILABLE or the
+    exact golden bytes — never a torn/corrupted payload — and the
+    server must stay healthy for a final full sweep."""
+    import threading
+
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, MAX_ITER)]) as farm:
+        # The two j-halves are exact mirrors across the real axis (the
+        # symmetry test_batched_farm asserts) — compute 2, flip for 4.
+        goldens = {(i, 0): golden_tile(2, i, 0) for i in range(2)}
+        for i in range(2):
+            goldens[(i, 1)] = goldens[(i, 0)].reshape(
+                CHUNK_WIDTH, CHUNK_WIDTH)[::-1].ravel()
+        errors: list = []
+        stop = threading.Event()
+
+        def reader(seed: int) -> None:
+            import random
+            rng = random.Random(seed)
+            client = DataClient("127.0.0.1", farm.dataserver_port)
+            try:
+                while not stop.is_set():
+                    i, j = rng.randrange(2), rng.randrange(2)
+                    pixels, status = client.fetch(2, i, j)
+                    if status is FetchStatus.OK:
+                        mism = (pixels != goldens[(i, j)]).mean()
+                        assert mism <= 5e-4, \
+                            f"torn/corrupt read of ({i},{j}): {mism:.2%}"
+                    else:
+                        assert status is FetchStatus.NOT_AVAILABLE
+                        # Back off while nothing exists yet: unthrottled
+                        # NOT_AVAILABLE spin would contend with the
+                        # compile/compute window and flake slow hosts.
+                        stop.wait(0.005)
+            except BaseException as e:
+                errors.append(e)
+
+        readers = [threading.Thread(target=reader, args=(50 + t,))
+                   for t in range(8)]
+        for t in readers:
+            t.start()
+        try:
+            worker = Worker(
+                DistributerClient("127.0.0.1", farm.distributer_port),
+                JaxBackend(dtype=np.float32), batch_size=2)
+            worker.run_until_drained()
+            farm.wait_saves_settled(expected_accepted=4, timeout=300)
+        finally:
+            stop.set()
+            for t in readers:
+                t.join(timeout=60)
+        assert not any(t.is_alive() for t in readers)
+        assert not errors, errors[:2]
+        # Server healthy after the burst: every tile fetches golden.
+        client = DataClient("127.0.0.1", farm.dataserver_port)
+        for (i, j), want in goldens.items():
+            pixels, status = client.fetch(2, i, j)
+            assert status is FetchStatus.OK
+            assert (pixels != want).mean() <= 5e-4
