@@ -97,3 +97,67 @@ def escape_counts(c_real: np.ndarray, c_imag: np.ndarray, max_iter: int, *,
         c_real.ctypes.data_as(_F64P), c_imag.ctypes.data_as(_F64P),
         c_real.size, max_iter, out.ctypes.data_as(_I32P), n_threads)
     return out
+
+
+# -- arbitrary-precision fixed-point kernels (fixed.cc) --------------------
+
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+
+
+def _limbs(value: int, n_limbs: int) -> np.ndarray:
+    """|value| as n_limbs little-endian uint64 magnitudes."""
+    return np.frombuffer(abs(value).to_bytes(n_limbs * 8, "little"),
+                         dtype="<u8")
+
+
+def _u64ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(_U64P)
+
+
+def fixed_escape(za: int, zb: int, ca: int, cb: int, max_iter: int,
+                 bits: int) -> int:
+    """Exact-parity native replacement for the Python-bigint escape loop
+    (ops/perturbation.py:_escape_count_fixed)."""
+    lib = _lib()
+    # Pre-escape magnitudes stay under 2^(bits+4); one guard limb
+    # suffices (see fixed.cc bound analysis).
+    n = (bits + 63) // 64 + 1
+    four = _limbs(4 << (2 * bits), 2 * n + 1)
+    args = [_limbs(za, n), 1 if za < 0 else 0,
+            _limbs(zb, n), 1 if zb < 0 else 0,
+            _limbs(ca, n), 1 if ca < 0 else 0,
+            _limbs(cb, n), 1 if cb < 0 else 0]
+    return int(lib.dmtpu_fixed_escape(
+        _u64ptr(args[0]), args[1], _u64ptr(args[2]), args[3],
+        _u64ptr(args[4]), args[5], _u64ptr(args[6]), args[7],
+        _u64ptr(four), n, bits, max_iter))
+
+
+def fixed_orbit(za: int, zb: int, ca: int, cb: int, max_iter: int,
+                bits: int, extra: int
+                ) -> tuple[np.ndarray, np.ndarray, int]:
+    """Exact-parity native replacement for the Python-bigint orbit loop
+    (ops/perturbation.py:_orbit_fixed): float64 orbit arrays plus the
+    tested-orbit length."""
+    lib = _lib()
+    # The post-escape extension runs values up to ~10^100 * 2^bits
+    # before the huge-threshold stop; six guard limbs (384 bits) cover
+    # the ~333-bit growth with headroom (see fixed.cc).
+    n = (bits + 63) // 64 + 6
+    four = _limbs(4 << (2 * bits), 2 * n + 1)
+    huge = _limbs((10 ** 100) << (2 * bits), 2 * n + 1)
+    steps = max(1, max_iter)
+    z_re = np.empty(steps + extra, np.float64)
+    z_im = np.empty(steps + extra, np.float64)
+    valid = ctypes.c_int32(0)
+    args = [_limbs(za, n), 1 if za < 0 else 0,
+            _limbs(zb, n), 1 if zb < 0 else 0,
+            _limbs(ca, n), 1 if ca < 0 else 0,
+            _limbs(cb, n), 1 if cb < 0 else 0]
+    written = int(lib.dmtpu_fixed_orbit(
+        _u64ptr(args[0]), args[1], _u64ptr(args[2]), args[3],
+        _u64ptr(args[4]), args[5], _u64ptr(args[6]), args[7],
+        _u64ptr(four), _u64ptr(huge), n, bits, max_iter, extra,
+        z_re.ctypes.data_as(_F64P), z_im.ctypes.data_as(_F64P),
+        ctypes.byref(valid)))
+    return z_re[:written], z_im[:written], int(valid.value)

@@ -24,7 +24,7 @@ logger = logging.getLogger("dmtpu.native")
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libdmtpu_native.so")
-_SOURCES = ("rle.cc", "escape.cc")
+_SOURCES = ("rle.cc", "escape.cc", "fixed.cc")
 
 _CXXFLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17", "-ffp-contract=off",
              "-pthread"]

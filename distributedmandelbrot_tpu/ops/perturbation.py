@@ -55,6 +55,12 @@ DEFAULT_PREC_BITS = 256
 # glitched (cancellation ate the significand).
 GLITCH_TOL = 1e-6
 
+# How many glitched pixels to try as the secondary reference before
+# giving up on the device repair pass.  A candidate whose orbit escapes
+# early costs only that escape-length bigint orbit, so misses are far
+# cheaper than the per-pixel exact loop the pass replaces.
+SECONDARY_REFERENCE_TRIES = 8
+
 
 # -- host-side exact arithmetic (stdlib bigints) --------------------------
 
@@ -134,7 +140,24 @@ def reference_orbit(center_re: str | float, center_im: str | float,
 from functools import lru_cache
 
 
-@lru_cache(maxsize=8)
+def _native_fixed(bits: int = 0, *vals: int) -> bool:
+    """Use the native fixed-point kernels for these inputs?  (Exact-
+    parity C++ limb loops, several times the CPython-bigint rate; tests
+    pin bytewise parity.)  The native buffers bound input magnitudes at
+    2^(bits+2) (|value| < 4 — anything beyond escapes at iteration 1
+    but must still count CORRECTLY); wilder inputs, which the fixed
+    buffers would overflow, stay on the unbounded Python path."""
+    if any(abs(v).bit_length() > bits + 2 for v in vals):
+        return False
+    try:
+        from distributedmandelbrot_tpu.native import bindings
+
+        return bindings.native_supported()
+    except Exception:
+        return False
+
+
+@lru_cache(maxsize=64)
 def _orbit_fixed(za: int, zb: int, ca: int, cb: int, max_iter: int,
                  bits: int, extra: int = 12
                  ) -> tuple[np.ndarray, np.ndarray, int]:
@@ -148,7 +171,14 @@ def _orbit_fixed(za: int, zb: int, ca: int, cb: int, max_iter: int,
     LRU-cached (treat the returned arrays as immutable): a zoom
     animation re-renders the same center at every frame, and the orbit
     depends only on (center, budget, precision) — with precision
-    quantized by the caller, frames share one bigint computation."""
+    quantized by the caller, frames share one bigint computation.  The
+    cache must hold at least 1 primary + SECONDARY_REFERENCE_TRIES
+    candidate orbits per view or a single tile's repair pass evicts its
+    own entries (64 covers several views; orbits are ~1 MB each)."""
+    if _native_fixed(bits, za, zb, ca, cb):
+        from distributedmandelbrot_tpu.native import bindings
+
+        return bindings.fixed_orbit(za, zb, ca, cb, max_iter, bits, extra)
     one = 1 << bits
     four = 4 * one * one  # |z|^2 comparisons happen at 2*bits scale
     huge = (10 ** 100) * one * one
@@ -389,6 +419,19 @@ def _find_reference(za: int, zb: int, ca: int, cb: int, span: float,
     return z_re, z_im, n, off_re, off_im
 
 
+def _secondary_candidates(bad: np.ndarray, scanned: np.ndarray,
+                          height: int, width: int) -> np.ndarray:
+    """Order glitched pixels by how likely their exact orbit is to cover
+    the full budget: scanned value 0 first (the pixel stayed bounded
+    through the whole scan, however unreliably — 0 means in-set on both
+    the integer and smooth planes), then deeper escape values, ties
+    broken toward the view center (glitches cluster around the bounded
+    structure causing them, so central pixels are likelier in-set)."""
+    mid = np.array([(height - 1) / 2, (width - 1) / 2])
+    center_dist = np.abs(bad - mid).sum(axis=1)
+    return np.lexsort((center_dist, -scanned, scanned != 0))
+
+
 def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
                      dtype, prec_bits: int, max_glitch_fix: int,
                      julia_c: tuple[str, str] | None = None
@@ -445,46 +488,66 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     chunk = max(1, min(spec.height, (1 << 17) // max(1, spec.width)))
     vals, glitches = [], []
     for r0 in range(0, spec.height, chunk):
-        v_part, g_part = scan_fn(
+        # device_get on the pair fetches both planes concurrently — two
+        # sequential np.asarray calls pay the host link's round trip
+        # twice (measured 2x on the dev rig's tunnel).
+        v_part, g_part = jax.device_get(scan_fn(
             zr, zi,
             jnp.asarray(dre[r0:r0 + chunk].astype(dtype)),
-            jnp.asarray(dim[r0:r0 + chunk].astype(dtype)))
-        vals.append(np.asarray(v_part))
-        glitches.append(np.asarray(g_part))
+            jnp.asarray(dim[r0:r0 + chunk].astype(dtype))))
+        vals.append(v_part)
+        glitches.append(g_part)
     out = np.concatenate(vals).copy()
     glitched = np.concatenate(glitches)
     bad = np.argwhere(glitched)
     n_flagged = len(bad)
     step = spec.step
     if len(bad) > 1:
-        # Secondary-reference pass (Pauldelbrot's standard fix): pick
-        # the glitched pixel nearest the view center as a new reference
-        # — ONE further bigint orbit, the same cost as exactly
-        # recomputing a single pixel — and re-run just the glitched
-        # pixels' deltas against it on device.  Pixels that glitch
-        # against BOTH references fall through to the exact loop.
-        mid = np.array([(spec.height - 1) / 2, (spec.width - 1) / 2])
-        r2, c2 = bad[np.argmin(np.abs(bad - mid).sum(axis=1))]
-        d2_re = float((c2 - (spec.width - 1) / 2) * step)
-        d2_im = float((r2 - (spec.height - 1) / 2) * step)
-        pa = za + _to_fixed(d2_re, bits)
-        pb = zb + _to_fixed(d2_im, bits)
-        if julia_c is None:
-            z2_re, z2_im, n2v = _orbit_fixed(pa, pb, pa, pb, max_iter,
-                                             bits)
-        else:
-            z2_re, z2_im, n2v = _orbit_fixed(pa, pb, ca, cb, max_iter,
-                                             bits)
-        if n2v >= max_iter:
+        # Secondary-reference pass (Pauldelbrot's standard fix): pick a
+        # glitched pixel as a new reference — one further bigint orbit,
+        # the same cost as exactly recomputing a single pixel — and
+        # re-run just the glitched pixels' deltas against it on device.
+        # Pixels that glitch against BOTH references fall through to the
+        # exact loop.
+        #
+        # The pass engages only when the secondary orbit covers the FULL
+        # budget (see below), so candidates are tried in in-set-
+        # likelihood order until one does.  A failed candidate's orbit
+        # stops at its escape, so misses are cheap (and LRU-cached for
+        # the next frame); a cluster around bounded structure engages at
+        # the first genuinely in-set pixel instead of giving up when the
+        # single nearest-center pick happens to be exterior.
+        z2 = None
+        for ci in _secondary_candidates(bad, out[bad[:, 0], bad[:, 1]],
+                                        spec.height, spec.width)[
+                                            :SECONDARY_REFERENCE_TRIES]:
+            r2, c2 = bad[ci]
+            d2_re = float((c2 - (spec.width - 1) / 2) * step)
+            d2_im = float((r2 - (spec.height - 1) / 2) * step)
+            pa = za + _to_fixed(d2_re, bits)
+            pb = zb + _to_fixed(d2_im, bits)
+            if julia_c is None:
+                z2_re, z2_im, n2v = _orbit_fixed(pa, pb, pa, pb,
+                                                 max_iter, bits)
+            else:
+                z2_re, z2_im, n2v = _orbit_fixed(pa, pb, ca, cb,
+                                                 max_iter, bits)
+            if n2v >= max_iter:
+                z2 = (z2_re, z2_im)
+                break
+        if z2 is not None:
+            z2_re, z2_im = z2
             # Engage only when the secondary orbit covers the FULL
             # budget: an early-escaping secondary would scan bounded
             # lanes against its diverging post-escape extension, and
-            # while the cancellation tolerance flags them in practice,
-            # the budget-covering condition removes the hazard outright
-            # (glitches cluster around bounded structure, so the
-            # nearest-center glitched pixel is usually in-set and the
-            # pass engages).  Skipping costs one wasted orbit — the
-            # price of exactly one pixel of the fallback loop.
+            # the scan values it produces for delicate pixels are not
+            # reliably exact even when unflagged (measured on the
+            # seahorse span-1e-10 window: a truncated-prefix repair
+            # left a pixel at 3294 vs 3247 exact, and an f64 rescan
+            # still mis-repaired 1 of 8 — the 1e-6 cancellation
+            # tolerance cannot certify exactness near a minibrot).
+            # All-exterior glitch clusters therefore take the exact
+            # loop, which the native fixed-point kernel keeps cheap.
             #
             # Deltas relative to the secondary reference: exact in f64 —
             # they are index differences at pixel scale.  Padded to a
@@ -497,11 +560,12 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
             dim2 = np.zeros(k_pad)
             dre2[:k] = (bad[:, 1] - c2).astype(np.float64) * step
             dim2[:k] = (bad[:, 0] - r2).astype(np.float64) * step
-            v2, g2 = scan_fn(jnp.asarray(z2_re), jnp.asarray(z2_im),
-                             jnp.asarray(dre2.astype(dtype)),
-                             jnp.asarray(dim2.astype(dtype)))
-            v2 = np.asarray(v2)[:k]
-            g2 = np.asarray(g2)[:k]
+            v2, g2 = jax.device_get(scan_fn(
+                jnp.asarray(z2_re), jnp.asarray(z2_im),
+                jnp.asarray(dre2.astype(dtype)),
+                jnp.asarray(dim2.astype(dtype))))
+            v2 = v2[:k]
+            g2 = g2[:k]
             fixed = bad[~g2]
             out[fixed[:, 0], fixed[:, 1]] = v2[~g2]
             bad = bad[g2]
@@ -576,6 +640,10 @@ def _escape_count_fixed(za: int, zb: int, max_iter: int, bits: int,
     it separately for the Julia family."""
     if ca is None:
         ca, cb = za, zb
+    if _native_fixed(bits, za, zb, ca, cb):
+        from distributedmandelbrot_tpu.native import bindings
+
+        return bindings.fixed_escape(za, zb, ca, cb, max_iter, bits)
     one = 1 << bits
     four = 4 * one * one
     a, b = za, zb

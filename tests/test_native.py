@@ -167,3 +167,83 @@ def test_concurrent_first_load_is_thread_safe(monkeypatch, tmp_path):
         f"{sum(r is None for r in results)} of {len(results)} concurrent "
         "first loads saw the library as unavailable")
     assert len({id(r) for r in results}) == 1  # one shared CDLL
+
+
+def test_fixed_escape_parity_with_python_bigint(monkeypatch):
+    """The native limb kernel must match the Python-bigint loop exactly
+    on every point class: escaping, in-set, boundary-delicate, Julia."""
+    import random
+
+    from distributedmandelbrot_tpu.native import bindings
+    from distributedmandelbrot_tpu.ops import perturbation as P
+
+    rng = random.Random(1234)
+    for trial in range(40):
+        bits = rng.choice([128, 192, 256, 384, 512])
+        kind = trial % 4
+        if kind == 0:
+            cr, ci = rng.uniform(-2, 0.5), rng.uniform(-1.2, 1.2)
+        elif kind == 1:  # Misiurewicz-adjacent boundary band
+            cr = -0.7435 + rng.uniform(-1e-3, 1e-3)
+            ci = 0.1318 + rng.uniform(-1e-3, 1e-3)
+        elif kind == 2:  # deep interior (runs the full budget)
+            cr, ci = rng.uniform(-0.2, 0.2), rng.uniform(-0.2, 0.2)
+        else:  # wild, incl. immediate escapes
+            cr, ci = rng.uniform(-2.5, 2.5), rng.uniform(-2.5, 2.5)
+        mi = rng.choice([1, 2, 17, 300, 1500])
+        za, zb = P._to_fixed(cr, bits), P._to_fixed(ci, bits)
+        if kind == 3:  # julia: independent constant
+            ca = P._to_fixed(rng.uniform(-1, 1), bits)
+            cb = P._to_fixed(rng.uniform(-1, 1), bits)
+        else:
+            ca, cb = za, zb
+        monkeypatch.setattr(P, "_native_fixed", lambda *a: False)
+        want = P._escape_count_fixed(za, zb, mi, bits, ca=ca, cb=cb)
+        monkeypatch.undo()
+        got = bindings.fixed_escape(za, zb, ca, cb, mi, bits)
+        assert got == want, (bits, cr, ci, mi, got, want)
+
+
+def test_fixed_orbit_parity_with_python_bigint(monkeypatch):
+    """Orbit arrays (float64 conversions incl. the round-to-nearest
+    truncation and the post-escape huge-threshold extension) and the
+    valid length must be bitwise identical to the Python loop."""
+    import random
+
+    from distributedmandelbrot_tpu.native import bindings
+    from distributedmandelbrot_tpu.ops import perturbation as P
+
+    rng = random.Random(99)
+    cases = [("-0.743643887037158704752191506114774",
+              "0.131825904205311970493132056385139", 256, 3000),
+             ("-0.77568377", "0.13646737", 128, 2000),
+             ("0.3", "0.5", 192, 500),  # escapes quickly -> extension
+             ("0.0", "0.0", 512, 64)]   # superattracting fixed point
+    for _ in range(8):
+        cases.append((str(rng.uniform(-2, 0.5)), str(rng.uniform(-1, 1)),
+                      rng.choice([128, 256]), rng.choice([1, 2, 400])))
+    for cre, cim, bits, mi in cases:
+        za, zb = P._to_fixed(cre, bits), P._to_fixed(cim, bits)
+        monkeypatch.setattr(P, "_native_fixed", lambda *a: False)
+        w_re, w_im, w_v = P._orbit_fixed.__wrapped__(za, zb, za, zb, mi,
+                                                     bits)
+        monkeypatch.undo()
+        g_re, g_im, g_v = bindings.fixed_orbit(za, zb, za, zb, mi, bits,
+                                               12)
+        assert g_v == w_v, (cre, cim, bits, mi, g_v, w_v)
+        np.testing.assert_array_equal(g_re, w_re)
+        np.testing.assert_array_equal(g_im, w_im)
+
+
+def test_fixed_kernels_reject_wild_inputs_to_python_path():
+    """|c| >= 4 exceeds the native limb buffers' input bound; the
+    wrapper must route such calls to the unbounded Python path, where
+    they return correct counts instead of overflowing (regression:
+    escape_counts_exact("2e19", "0", 100) raised OverflowError on the
+    native path)."""
+    from distributedmandelbrot_tpu.ops import perturbation as P
+
+    assert P.escape_counts_exact("2e19", "0", 100) == 1
+    assert P.escape_counts_exact("5.0", "0", 100) == 1
+    # Near the bound, the native path still engages and agrees.
+    assert P.escape_counts_exact("3.9", "0", 100) == 1

@@ -335,3 +335,45 @@ def test_second_reference_pass_fixes_glitches_exactly():
         dim = Decimal(cim) + (Decimal(r) - Decimal(n - 1) / 2) * step
         want = pt.escape_counts_exact(str(dre), str(dim), 50_000)
         assert int(c[r, col]) == want, (r, col, int(c[r, col]), want)
+
+
+def test_all_exterior_glitch_cluster_repairs_exactly(monkeypatch):
+    """Seahorse-valley deep window (the bench headline center at span
+    1e-10): its glitch cluster is ALL-exterior — every secondary-
+    reference candidate's orbit escapes early, so the device repair
+    pass must NOT engage (scan repairs against a truncated or exterior
+    reference are not reliably exact here — measured: a truncated-
+    prefix repair left 3294 vs 3247 exact, and even an f64 rescan
+    mis-repaired 1 of 8).  Every flagged pixel takes the exact
+    fixed-point loop and must equal infinite-precision truth."""
+    flagged = {}
+    orig_cand = P._secondary_candidates
+    def spy_cand(bad, scanned, height, width):
+        flagged["bad"] = bad.copy()
+        return orig_cand(bad, scanned, height, width)
+    monkeypatch.setattr(P, "_secondary_candidates", spy_cand)
+    orbit_lens = []
+    orig_orbit = P._orbit_fixed.__wrapped__
+    def spy_orbit(*a, **k):
+        r = orig_orbit(*a, **k)
+        orbit_lens.append(r[2])
+        return r
+    monkeypatch.setattr(P, "_orbit_fixed", spy_orbit)
+
+    cre = "-0.743643887037158704752191506114774"
+    cim = "0.131825904205311970493132056385139"
+    n = 48
+    spec = P.DeepTileSpec(cre, cim, 1e-10, width=n, height=n)
+    counts, n_flagged = P.compute_counts_perturb(spec, 50_000,
+                                                 dtype=np.float32)
+    # The scenario holds: a real glitch cluster whose candidates (every
+    # orbit after the full-budget primary) all escape early.
+    assert n_flagged > 4
+    assert max(orbit_lens[1:]) < 50_000
+    # Exactness: every flagged pixel equals fixed-point truth.
+    c = np.asarray(counts)
+    bad = flagged["bad"]
+    assert len(bad) == n_flagged
+    for r, col in bad[:: max(1, len(bad) // 6)]:
+        want = exact_count(spec, r, col, 50_000)
+        assert int(c[r, col]) == want, (r, col, int(c[r, col]), want)
