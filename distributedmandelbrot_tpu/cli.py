@@ -198,6 +198,23 @@ def _auto_deep(span: float, cx: float, cy: float, definition: int,
         and not _span_f32_resolvable(cx, cy, span, definition))
 
 
+def _warn_if_deep_all_inset(plane, max_iter: int, span: float) -> None:
+    """A deep view where EVERY pixel classifies in-set (value 0) is
+    almost always an under-budgeted render, not a discovery: escape
+    depths grow with zoom (measured at the seahorse Misiurewicz point:
+    minimum escape ~3250 at span 1e-10, ~7060 at 1e-16), so a budget
+    that resolved a shallow frame silently produces a uniform tile a
+    few octaves deeper.  Say so instead of writing a flat image with no
+    hint.  (Shallow interior views are legitimately all-in-set, hence
+    deep-path only.)"""
+    if not np.any(np.asarray(plane)):
+        logger.warning(
+            "deep view at span %g: no pixel escaped within max_iter=%d — "
+            "the output is a uniform in-set tile.  Deep zooms need "
+            "budgets that grow with depth; retry with a larger "
+            "--max-iter.", span, max_iter)
+
+
 def _render_view(c_re: str, c_im: str, span: float, definition: int,
                  max_iter: int, *, smooth: bool, np_dtype, colormap: str,
                  deep: bool | None = None,
@@ -257,8 +274,7 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
                           np_dtype)
     if deep:
         from distributedmandelbrot_tpu.ops import (DeepTileSpec,
-                                                   compute_smooth_perturb,
-                                                   compute_tile_perturb)
+                                                   compute_smooth_perturb)
         # Center strings pass through verbatim: their precision is NOT
         # bounded by float64 (that's the point of the deep path).
         dspec = DeepTileSpec(c_re, c_im, span, width=definition,
@@ -266,10 +282,23 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
         if smooth:
             nu, _ = compute_smooth_perturb(dspec, max_iter, dtype=np_dtype,
                                            julia_c=julia_c)
+            _warn_if_deep_all_inset(nu, max_iter, span)
             return smooth_to_rgba(nu, max_iter, colormap=colormap,
                               normalize=normalize)
-        values = compute_tile_perturb(dspec, max_iter, dtype=np_dtype,
-                                      julia_c=julia_c)
+        # Warn on the RAW counts, not the scaled pixels: the uint8
+        # encoding deliberately wraps counts in the top 1/256 band of
+        # the budget to 0 (reference parity), which would read as
+        # "in-set" here exactly in the near-under-budget regime the
+        # warning targets.
+        from distributedmandelbrot_tpu.ops import compute_counts_perturb
+        from distributedmandelbrot_tpu.ops.escape_time import (
+            scale_counts_to_uint8)
+        counts, _ = compute_counts_perturb(dspec, max_iter,
+                                           dtype=np_dtype,
+                                           julia_c=julia_c)
+        _warn_if_deep_all_inset(counts, max_iter, span)
+        values = np.asarray(scale_counts_to_uint8(
+            counts, max_iter=max_iter)).ravel()
         return value_to_rgba(values.reshape(definition, definition),
                              colormap=colormap)
 

@@ -25,6 +25,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -256,6 +257,58 @@ std::int32_t dmtpu_fixed_escape(
         if (s.mag2_at_least(four)) return it;
     }
     return 0;
+}
+
+// Batch of escape counts: k points, each with its own start (za, zb)
+// packed as k consecutive n_limbs-limb magnitudes (+ per-point sign
+// bytes).  `ca/cb` follow the same layout when julia == 0 is not what
+// you want — for the Mandelbrot family pass julia == 0 and the start
+// point doubles as the constant (the packed ca/cb are ignored); for
+// Julia pass julia == 1 and a SINGLE shared n_limbs-limb ca/cb.
+// Parallelized over n_threads (<= 0 means hardware concurrency) — the
+// glitch-repair exact loop hands over thousands of independent pixels
+// at production tile sizes.
+void dmtpu_fixed_escape_batch(
+    const u64* za, const std::uint8_t* za_neg,
+    const u64* zb, const std::uint8_t* zb_neg,
+    const u64* ca, std::int32_t ca_neg,
+    const u64* cb, std::int32_t cb_neg, std::int32_t julia,
+    const u64* four, std::int32_t n_limbs, std::int32_t bits,
+    std::int32_t max_iter, std::int32_t k, std::int32_t* out,
+    std::int32_t n_threads) {
+    unsigned hw = std::thread::hardware_concurrency();
+    unsigned workers = n_threads > 0 ? static_cast<unsigned>(n_threads)
+                                     : (hw ? hw : 1);
+    if (k > 0 && workers > static_cast<unsigned>(k))
+        workers = static_cast<unsigned>(k);
+    auto run = [=](std::int32_t lo, std::int32_t hi) {
+        for (std::int32_t i = lo; i < hi; ++i) {
+            const u64* zai = za + (std::size_t)i * n_limbs;
+            const u64* zbi = zb + (std::size_t)i * n_limbs;
+            const u64* cai = julia ? ca : zai;
+            const u64* cbi = julia ? cb : zbi;
+            const std::int32_t cani = julia ? ca_neg : za_neg[i];
+            const std::int32_t cbni = julia ? cb_neg : zb_neg[i];
+            out[i] = dmtpu_fixed_escape(zai, za_neg[i], zbi, zb_neg[i],
+                                        cai, cani, cbi, cbni, four,
+                                        n_limbs, bits, max_iter);
+        }
+    };
+    if (workers <= 1) {
+        run(0, k);
+        return;
+    }
+    std::vector<std::thread> threads;
+    threads.reserve(workers);
+    const std::int32_t stride = (k + (std::int32_t)workers - 1)
+                                / (std::int32_t)workers;
+    for (unsigned t = 0; t < workers; ++t) {
+        const std::int32_t lo = (std::int32_t)t * stride;
+        const std::int32_t hi = lo + stride < k ? lo + stride : k;
+        if (lo >= hi) break;
+        threads.emplace_back([=] { run(lo, hi); });
+    }
+    for (auto& th : threads) th.join();
 }
 
 // _orbit_fixed parity: emits float64 orbit entries z_1.. into z_re/z_im

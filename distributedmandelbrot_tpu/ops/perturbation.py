@@ -202,6 +202,27 @@ def _orbit_fixed(za: int, zb: int, ca: int, cb: int, max_iter: int,
     return z_re[:n], z_im[:n], valid if valid is not None else n
 
 
+def _escape_counts_exact_batch(points: list[tuple[int, int]],
+                               max_iter: int, bits: int,
+                               julia_c: tuple[int, int] | None
+                               ) -> np.ndarray:
+    """Exact escape counts for a batch of fixed-point points — the
+    glitch-repair remainder.  Native path: one C++ call, threaded over
+    cores.  Fallback: the per-point loop."""
+    flat = [v for p in points for v in p]
+    if julia_c is not None:
+        flat += list(julia_c)
+    if _native_fixed(bits, *flat):
+        from distributedmandelbrot_tpu.native import bindings
+
+        return bindings.fixed_escape_batch(points, max_iter, bits,
+                                           julia_c=julia_c)
+    ca, cb = julia_c if julia_c is not None else (None, None)
+    return np.array([_escape_count_fixed(pa, pb, max_iter, bits,
+                                         ca=ca, cb=cb)
+                     for pa, pb in points], np.int32)
+
+
 def escape_counts_exact(c_re: str | float, c_im: str | float, max_iter: int,
                         *, prec_bits: int = DEFAULT_PREC_BITS) -> int:
     """Reference-convention escape count of one point in fixed point
@@ -443,7 +464,7 @@ def _secondary_candidates(bad: np.ndarray, scanned: np.ndarray,
 
 
 def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
-                     dtype, prec_bits: int, max_glitch_fix: int,
+                     dtype, prec_bits: int, max_glitch_fix: int | None,
                      julia_c: tuple[str, str] | None = None
                      ) -> tuple[np.ndarray, int]:
     """Shared perturbation driver: validates the span/dtype combination,
@@ -579,31 +600,42 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
             fixed = bad[~g2]
             out[fixed[:, 0], fixed[:, 1]] = v2[~g2]
             bad = bad[g2]
-    if len(bad) > max_glitch_fix:
+    # Cap on the exact-repair remainder: a FRACTION of the tile, not a
+    # flat count — a 256^2 frame at a deep Misiurewicz span legitimately
+    # leaves ~10% of its pixels doubly-glitched (measured: 6272/65536 at
+    # span ~1e-13, budget 20000, every candidate exterior), and the old
+    # flat 4096 cap killed such renders outright.  Beyond a quarter of
+    # the tile, perturbation genuinely isn't working for this view.
+    cap = (max_glitch_fix if max_glitch_fix is not None
+           else max(4096, (spec.width * spec.height) // 4))
+    if len(bad) > cap:
         raise ValueError(
-            f"{len(bad)} doubly-glitched pixels (> {max_glitch_fix}); "
+            f"{len(bad)} doubly-glitched pixels (> {cap}); "
             f"no reference orbit suits this view")
-    # Exact per-pixel recompute in fixed point for the remainder.  Pixel
-    # coordinates are center + delta, formed in fixed point so no
-    # precision is lost.  (On the smooth plane this patches an *integer*
-    # count — a one-level banding artifact on isolated pixels; the
-    # second-reference pass above patches with true smooth values.)
-    for r, c in bad:
-        d_re = float((c - (spec.width - 1) / 2) * step)
-        d_im = float((r - (spec.height - 1) / 2) * step)
-        pa = za + _to_fixed(d_re, bits)
-        pb = zb + _to_fixed(d_im, bits)
-        out[r, c] = _escape_count_fixed(
-            pa, pb, max_iter, bits,
-            ca=None if julia_c is None else ca,
-            cb=None if julia_c is None else cb)
+    # Exact per-pixel recompute in fixed point for the remainder —
+    # batched through the native kernel (threaded in C++) when
+    # available.  Pixel coordinates are center + delta, formed in fixed
+    # point so no precision is lost.  (On the smooth plane this patches
+    # an *integer* count — a one-level banding artifact on isolated
+    # pixels; the second-reference pass above patches with true smooth
+    # values.)
+    if len(bad):
+        pts = []
+        for r, c in bad:
+            d_re = float((c - (spec.width - 1) / 2) * step)
+            d_im = float((r - (spec.height - 1) / 2) * step)
+            pts.append((za + _to_fixed(d_re, bits),
+                        zb + _to_fixed(d_im, bits)))
+        jc = None if julia_c is None else (ca, cb)
+        out[bad[:, 0], bad[:, 1]] = _escape_counts_exact_batch(
+            pts, max_iter, bits, jc)
     return out, n_flagged
 
 
 def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
                            dtype=np.float32,
                            prec_bits: int = DEFAULT_PREC_BITS,
-                           max_glitch_fix: int = 4096,
+                           max_glitch_fix: int | None = None,
                            julia_c: tuple[str, str] | None = None
                            ) -> tuple[np.ndarray, int]:
     """Escape counts for a deep-zoom tile via perturbation.
@@ -613,7 +645,9 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
     FLAGGED as glitched (most are repaired on device by the secondary-
     reference pass; only the doubly-glitched remainder pays the exact
     fixed-point fallback).  Raises if more than ``max_glitch_fix``
-    pixels remain glitched against both references.
+    pixels remain glitched against both references — default: a quarter
+    of the tile (deep boundary views legitimately leave ~10% doubly
+    glitched; beyond 25% perturbation is not working for the view).
 
     ``julia_c=(re, im)`` (decimal strings) renders the Julia set for
     that constant instead — the spec's center then names a z-plane
@@ -761,7 +795,7 @@ def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
                            dtype=np.float32,
                            prec_bits: int = DEFAULT_PREC_BITS,
                            bailout: float = 256.0,
-                           max_glitch_fix: int = 4096,
+                           max_glitch_fix: int | None = None,
                            julia_c: tuple[str, str] | None = None
                            ) -> tuple[np.ndarray, int]:
     """Smooth (band-free) deep-zoom values via perturbation.

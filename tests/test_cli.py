@@ -200,3 +200,33 @@ def test_animate_gif_assembly(tmp_path):
     assert rc == 0 and gif.exists()
     with Image.open(gif) as img:
         assert getattr(img, "n_frames", 1) == 3
+
+
+def test_render_deep_all_inset_warns(tmp_path, caplog):
+    """A deep render whose every pixel exhausts the budget (value 0)
+    must warn that the flat output means an under-budgeted zoom —
+    escape depths grow with depth (seahorse Misiurewicz: min escape
+    ~3250 at span 1e-10), so a shallow frame's budget silently flattens
+    a few octaves deeper."""
+    import logging
+
+    out = tmp_path / "flat.png"
+    with caplog.at_level(logging.WARNING, logger="dmtpu.cli"):
+        rc = cli.main(["render", "--deep", "--definition", "32",
+                       "--max-iter", "300", "--span", "1e-14",
+                       "--center",
+                       "-0.743643887037158704752191506114774,"
+                       "0.131825904205311970493132056385139",
+                       "--out", str(out)])
+    assert rc == 0
+    assert any("no pixel escaped" in r.message for r in caplog.records)
+    # An adequately budgeted shallow deep-render must NOT warn.
+    caplog.clear()
+    out2 = tmp_path / "ok.png"
+    with caplog.at_level(logging.WARNING, logger="dmtpu.cli"):
+        rc = cli.main(["render", "--deep", "--definition", "32",
+                       "--max-iter", "300", "--span", "1e-6",
+                       "--center", "-0.74529,0.11307", "--out", str(out2)])
+    assert rc == 0
+    assert not any("no pixel escaped" in r.message
+                   for r in caplog.records)

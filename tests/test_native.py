@@ -247,3 +247,28 @@ def test_fixed_kernels_reject_wild_inputs_to_python_path():
     assert P.escape_counts_exact("5.0", "0", 100) == 1
     # Near the bound, the native path still engages and agrees.
     assert P.escape_counts_exact("3.9", "0", 100) == 1
+
+
+def test_fixed_escape_batch_parity_and_julia():
+    """The threaded batch entry must agree pointwise with the scalar
+    kernel in both families."""
+    import random
+
+    from distributedmandelbrot_tpu.native import bindings
+    from distributedmandelbrot_tpu.ops import perturbation as P
+
+    rng = random.Random(7)
+    bits = 192
+    pts = [(P._to_fixed(rng.uniform(-2, 0.6), bits),
+            P._to_fixed(rng.uniform(-1.3, 1.3), bits)) for _ in range(32)]
+    got = bindings.fixed_escape_batch(pts, 600, bits)
+    want = [P._escape_count_fixed(a, b, 600, bits) for a, b in pts]
+    assert list(got) == want
+    jc = (P._to_fixed(-0.4, bits), P._to_fixed(0.6, bits))
+    gotj = bindings.fixed_escape_batch(pts, 600, bits, julia_c=jc)
+    wantj = [P._escape_count_fixed(a, b, 600, bits, ca=jc[0], cb=jc[1])
+             for a, b in pts]
+    assert list(gotj) == wantj
+    # Multithreaded result identical to single-threaded.
+    got4 = bindings.fixed_escape_batch(pts, 600, bits, n_threads=4)
+    assert list(got4) == want

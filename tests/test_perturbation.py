@@ -377,3 +377,39 @@ def test_all_exterior_glitch_cluster_repairs_exactly(monkeypatch):
     for r, col in bad[:: max(1, len(bad) // 6)]:
         want = exact_count(spec, r, col, 50_000)
         assert int(c[r, col]) == want, (r, col, int(c[r, col]), want)
+
+
+def test_deep_frame_mass_glitch_fraction_cap_and_exact_batch(monkeypatch):
+    """Frame-3 regime of a 1e-8 -> 1e-16 seahorse zoom (span ~1.6e-13,
+    budget 20000): a large FRACTION of the tile legitimately ends up
+    doubly-glitched (every secondary candidate exterior).  The old flat
+    4096-pixel cap killed the render at 256^2; the cap now scales with
+    the tile and the remainder goes through the (native-batched) exact
+    loop — and the FLAGGED pixels stay exact.  (Unflagged pixels are
+    statistically accurate f32 scan values, as everywhere else.)"""
+    flagged = {}
+    orig_cand = P._secondary_candidates
+    def spy_cand(bad, scanned, height, width):
+        flagged["bad"] = bad.copy()
+        return orig_cand(bad, scanned, height, width)
+    monkeypatch.setattr(P, "_secondary_candidates", spy_cand)
+
+    cre = "-0.743643887037158704752191506114774"
+    cim = "0.131825904205311970493132056385139"
+    n = 48
+    spec = P.DeepTileSpec(cre, cim, 1.6e-13, width=n, height=n)
+    counts, n_flagged = P.compute_counts_perturb(spec, 20_000,
+                                                 dtype=np.float32)
+    assert n_flagged > n  # a mass-glitch view, not a few strays
+    c = np.asarray(counts)
+    assert (c > 0).all()  # every pixel escapes at this span/budget
+    # Exactness of the flagged set (the repair contract).
+    bad = flagged["bad"]
+    assert len(bad) > n
+    for r, col in bad[:: max(1, len(bad) // 6)]:
+        want = exact_count(spec, r, col, 20_000)
+        assert int(c[r, col]) == want, (r, col, int(c[r, col]), want)
+    # An explicit cap is still enforced.
+    with pytest.raises(ValueError, match="doubly-glitched"):
+        P.compute_counts_perturb(spec, 20_000, dtype=np.float32,
+                                 max_glitch_fix=3)
