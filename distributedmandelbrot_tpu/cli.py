@@ -778,6 +778,14 @@ def cmd_animate(argv: Sequence[str]) -> int:
     parser.add_argument("--frames", type=int, default=60)
     parser.add_argument("--definition", type=int, default=512)
     parser.add_argument("--max-iter", type=int, default=1000)
+    parser.add_argument("--max-iter-end", type=int, default=None,
+                        help="budget for the LAST frame; per-frame "
+                             "budgets interpolate geometrically from "
+                             "--max-iter, matching the span sweep — "
+                             "escape depths grow with zoom, so a flat "
+                             "budget either starves the deep frames "
+                             "(uniform in-set tiles) or overpays on "
+                             "the shallow ones")
     parser.add_argument("--smooth", action="store_true",
                         help="band-free coloring on every frame")
     parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
@@ -820,24 +828,33 @@ def cmd_animate(argv: Sequence[str]) -> int:
                               can_perturb=family is None)
     ratio = (args.span_end / args.span_start) ** (
         1.0 / max(1, args.frames - 1))
+    if args.max_iter < 1:
+        raise SystemExit("--max-iter must be >= 1")
+    if args.max_iter_end is not None and args.max_iter_end < 1:
+        raise SystemExit("--max-iter-end must be >= 1")
+    mi_ratio = ((args.max_iter_end / args.max_iter) ** (
+        1.0 / max(1, args.frames - 1))
+        if args.max_iter_end is not None else 1.0)
 
     t0 = time.monotonic()
     for f in range(args.frames):
         span = args.span_start * ratio ** f
+        max_iter = max(1, round(args.max_iter * mi_ratio ** f))
         # The decision is made once and passed down, so the progress
         # label can never disagree with the path actually rendered.
         deep = family is None and _auto_deep(span, float(c_re),
                                              float(c_im), args.definition,
                                              np_dtype)
         rgba = _render_view(c_re, c_im, span, args.definition,
-                            args.max_iter, smooth=args.smooth,
+                            max_iter, smooth=args.smooth,
                             np_dtype=np_dtype, colormap=args.colormap,
                             deep=deep, julia_c=julia_c, family=family,
                             no_pallas=args.no_pallas)
         path = os.path.join(args.out_dir, f"frame_{f:04d}.png")
         _save_png(path, rgba)
-        print(f"frame {f + 1}/{args.frames} span {span:.3g}"
-              f"{' (deep)' if deep else ''} -> {path}", flush=True)
+        print(f"frame {f + 1}/{args.frames} span {span:.3g} "
+              f"mi {max_iter}{' (deep)' if deep else ''} -> {path}",
+              flush=True)
     dt = time.monotonic() - t0
     pixels = args.frames * args.definition * args.definition
     print(f"animation done: {args.frames} frames, "

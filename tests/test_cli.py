@@ -230,3 +230,18 @@ def test_render_deep_all_inset_warns(tmp_path, caplog):
     assert rc == 0
     assert not any("no pixel escaped" in r.message
                    for r in caplog.records)
+
+
+def test_animate_max_iter_end_interpolates(tmp_path, capsys):
+    """--max-iter-end sweeps the budget geometrically alongside the
+    span: shallow frames stop overpaying for the deep frames' needs."""
+    rc = cli.main(["animate", "--center", "-0.74529,0.11307",
+                   "--span-start", "1e-2", "--span-end", "1e-4",
+                   "--frames", "3", "--definition", "32",
+                   "--max-iter", "100", "--max-iter-end", "400",
+                   "--out-dir", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mi 100" in out and "mi 200" in out and "mi 400" in out
+    for f in range(3):
+        assert (tmp_path / f"frame_{f:04d}.png").exists()
