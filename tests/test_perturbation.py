@@ -73,11 +73,16 @@ def test_perturb_matches_direct_f64_at_moderate_zoom():
     assert n_fixed < 96 * 96 * 0.05
 
 
-@pytest.mark.parametrize("span,max_iter", [(1e-10, 3000), (1e-18, 4000)])
-def test_perturb_sampled_exact(span, max_iter):
-    """Spot-check against exact fixed point — works beyond f64's floor."""
+@pytest.mark.parametrize("span,max_iter,dtype", [
+    (1e-10, 3000, np.float32), (1e-18, 4000, np.float32),
+    (1e-50, 4000, np.float64)])  # below the 1e-30 f32 delta floor
+def test_perturb_sampled_exact(span, max_iter, dtype):
+    """Spot-check against exact fixed point — works beyond f64's floor
+    (1e-50 exercises the auto-widened orbit precision via f64 deltas;
+    the window is a single escape band at that budget, and its count
+    must be EXACT)."""
     spec = P.DeepTileSpec(M_RE, M_IM, span, width=64, height=64)
-    counts, _ = P.compute_counts_perturb(spec, max_iter)
+    counts, _ = P.compute_counts_perturb(spec, max_iter, dtype=dtype)
     rng = np.random.default_rng(1)
     bad = 0
     for _ in range(12):
