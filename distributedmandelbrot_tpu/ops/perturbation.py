@@ -157,7 +157,16 @@ def _native_fixed(bits: int = 0, *vals: int) -> bool:
         return False
 
 
-@lru_cache(maxsize=64)
+# Budgets whose orbit arrays stay worth caching at depth 64: above
+# this, 64 cached entries of (max_iter+12) * 16 B each could hold
+# gigabytes.  Giant orbits keep a 2-deep LRU instead of bypassing
+# entirely — a zoom animation still reuses its center's orbit across
+# frames (on the pure-Python fallback path a 200k+-step bigint
+# recompute per frame would cost minutes), with memory bounded at two
+# orbits' worth.
+ORBIT_CACHE_MAX_STEPS = 200_000
+
+
 def _orbit_fixed(za: int, zb: int, ca: int, cb: int, max_iter: int,
                  bits: int, extra: int = 12
                  ) -> tuple[np.ndarray, np.ndarray, int]:
@@ -168,13 +177,36 @@ def _orbit_fixed(za: int, zb: int, ca: int, cb: int, max_iter: int,
     arrays may be longer.  Post-escape values square each step, so the
     extension stops before float64 overflow (~1e100).
 
-    LRU-cached (treat the returned arrays as immutable): a zoom
-    animation re-renders the same center at every frame, and the orbit
-    depends only on (center, budget, precision) — with precision
-    quantized by the caller, frames share one bigint computation.  The
-    cache must hold at least 1 primary + SECONDARY_REFERENCE_TRIES
-    candidate orbits per view or a single tile's repair pass evicts its
-    own entries (64 covers several views; orbits are ~1 MB each)."""
+    LRU-cached below :data:`ORBIT_CACHE_MAX_STEPS` (treat the returned
+    arrays as immutable): a zoom animation re-renders the same center
+    at every frame, and the orbit depends only on (center, budget,
+    precision) — with precision quantized by the caller, frames share
+    one bigint computation.  The cache must hold at least 1 primary +
+    SECONDARY_REFERENCE_TRIES candidate orbits per view or a single
+    tile's repair pass evicts its own entries (64 covers several views;
+    arrays are 16 B per orbit step, ~1 MB at the 50k BASELINE budget)."""
+    if max_iter > ORBIT_CACHE_MAX_STEPS:
+        return _orbit_cached_giant(za, zb, ca, cb, max_iter, bits, extra)
+    return _orbit_cached(za, zb, ca, cb, max_iter, bits, extra)
+
+
+@lru_cache(maxsize=64)
+def _orbit_cached(za: int, zb: int, ca: int, cb: int, max_iter: int,
+                  bits: int, extra: int
+                  ) -> tuple[np.ndarray, np.ndarray, int]:
+    return _orbit_fixed_impl(za, zb, ca, cb, max_iter, bits, extra)
+
+
+@lru_cache(maxsize=2)
+def _orbit_cached_giant(za: int, zb: int, ca: int, cb: int,
+                        max_iter: int, bits: int, extra: int
+                        ) -> tuple[np.ndarray, np.ndarray, int]:
+    return _orbit_fixed_impl(za, zb, ca, cb, max_iter, bits, extra)
+
+
+def _orbit_fixed_impl(za: int, zb: int, ca: int, cb: int, max_iter: int,
+                      bits: int, extra: int = 12
+                      ) -> tuple[np.ndarray, np.ndarray, int]:
     if _native_fixed(bits, za, zb, ca, cb):
         from distributedmandelbrot_tpu.native import bindings
 
@@ -200,6 +232,12 @@ def _orbit_fixed(za: int, zb: int, ca: int, cb: int, max_iter: int,
             break
         a, b = (a2 - b2 >> bits) + ca, ((a * b) >> (bits - 1)) + cb
     return z_re[:n], z_im[:n], valid if valid is not None else n
+
+
+# The uncached implementation under the same attribute functools exposed
+# before the size guard split the cache out (tests and instrumentation
+# reach the raw loop this way).
+_orbit_fixed.__wrapped__ = _orbit_fixed_impl  # type: ignore[attr-defined]
 
 
 def _escape_counts_exact_batch(points: list[tuple[int, int]],

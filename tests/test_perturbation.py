@@ -418,3 +418,22 @@ def test_deep_frame_mass_glitch_fraction_cap_and_exact_batch(monkeypatch):
     with pytest.raises(ValueError, match="doubly-glitched"):
         P.compute_counts_perturb(spec, 20_000, dtype=np.float32,
                                  max_glitch_fix=3)
+
+
+def test_giant_budget_orbits_use_the_small_cache():
+    """Budgets past ORBIT_CACHE_MAX_STEPS must not enter the 64-deep
+    LRU (budget-proportional arrays would hold gigabytes) but still
+    keep a 2-deep cache — an animation reuses its center's orbit across
+    frames even on the pure-Python fallback path."""
+    P._orbit_cached.cache_clear()
+    P._orbit_cached_giant.cache_clear()
+    za = P._to_fixed("-0.5", 128)
+    zb = P._to_fixed("0.1", 128)
+    big = P.ORBIT_CACHE_MAX_STEPS + 1
+    r1 = P._orbit_fixed(za, zb, za, zb, big, 128)
+    assert P._orbit_cached.cache_info().currsize == 0
+    assert P._orbit_cached_giant.cache_info().currsize == 1
+    assert P._orbit_fixed(za, zb, za, zb, big, 128)[0] is r1[0]
+    r2 = P._orbit_fixed(za, zb, za, zb, 500, 128)
+    assert P._orbit_cached.cache_info().currsize == 1
+    assert P._orbit_fixed(za, zb, za, zb, 500, 128)[0] is r2[0]
