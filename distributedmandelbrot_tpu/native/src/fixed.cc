@@ -261,13 +261,12 @@ std::int32_t dmtpu_fixed_escape(
 
 // Batch of escape counts: k points, each with its own start (za, zb)
 // packed as k consecutive n_limbs-limb magnitudes (+ per-point sign
-// bytes).  `ca/cb` follow the same layout when julia == 0 is not what
-// you want — for the Mandelbrot family pass julia == 0 and the start
-// point doubles as the constant (the packed ca/cb are ignored); for
-// Julia pass julia == 1 and a SINGLE shared n_limbs-limb ca/cb.
-// Parallelized over n_threads (<= 0 means hardware concurrency) — the
-// glitch-repair exact loop hands over thousands of independent pixels
-// at production tile sizes.
+// bytes).  Family selection: julia == 0 means Mandelbrot — each point's
+// start doubles as its constant and the ca/cb arguments are ignored;
+// julia == 1 means Julia — ca/cb is a SINGLE shared n_limbs-limb
+// constant applied to every point.  Parallelized over n_threads (<= 0
+// means hardware concurrency) — the glitch-repair exact loop hands over
+// thousands of independent pixels at production tile sizes.
 void dmtpu_fixed_escape_batch(
     const u64* za, const std::uint8_t* za_neg,
     const u64* zb, const std::uint8_t* zb_neg,
