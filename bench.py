@@ -96,9 +96,9 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
     import jax.numpy as jnp
     from jax import lax
 
-    from distributedmandelbrot_tpu.ops.pallas_escape import (_pallas_escape,
-                                                             fit_blocks,
-                                                             DEFAULT_BLOCK_H)
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        BATCH_GRID_MIN_ITER, _pallas_escape, _pallas_escape_batch,
+        fit_blocks, DEFAULT_BLOCK_H)
 
     from distributedmandelbrot_tpu.parallel.sharding import widen_square_pitch
 
@@ -106,6 +106,22 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
         tile, tile, block_h=kernel_kw.pop("block_h", DEFAULT_BLOCK_H),
         block_w=kernel_kw.pop("block_w", None))
     params = jnp.asarray(widen_square_pitch(params_np), jnp.float32)
+    k = params.shape[0]
+
+    if max_iter >= BATCH_GRID_MIN_ITER and k > 1:
+        # Deep budgets: one batch-grid launch (same dispatch policy as
+        # the production sharded path, sharding._batched_pallas_sharded).
+        mrds = jnp.full((k, 1), max_iter, jnp.int32)
+
+        @jax.jit
+        def run_batch(params):
+            out = _pallas_escape_batch(params, mrds, k=k, height=tile,
+                                       width=tile, max_iter=max_iter,
+                                       block_h=block_h, block_w=block_w,
+                                       **kernel_kw)
+            return jnp.sum(out.astype(jnp.int32), dtype=jnp.int32)
+
+        return lambda: run_batch(params)
 
     @jax.jit
     def run(params):
@@ -131,19 +147,17 @@ def _pallas_sharded_chain(mesh, params_np: np.ndarray, mrds: np.ndarray,
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from distributedmandelbrot_tpu.ops.pallas_escape import (fit_blocks,
-                                                             pallas_available,
-                                                             DEFAULT_UNROLL)
     from distributedmandelbrot_tpu.parallel.mesh import TILE_AXIS
     from distributedmandelbrot_tpu.parallel.sharding import (
-        _batched_pallas_sharded, pad_to_mesh, widen_square_pitch)
+        _batched_pallas_sharded, pad_to_mesh, pallas_batch_config,
+        widen_square_pitch)
 
-    cap = int(mrds.max())
-    block_h, block_w = fit_blocks(tile, tile)
+    # The production dispatch policy verbatim (bucketed cap, TRUE-budget
+    # probe + batch-grid resolution) so this chain measures exactly what
+    # sharding.batched_escape_pixels_pallas would run.
+    cfg = pallas_batch_config(tile, int(mrds.max()), interpret=interpret)
     params_np, mrds = pad_to_mesh(params_np, mrds, mesh.devices.size)
     params_np = widen_square_pitch(params_np)
-    if interpret is None:
-        interpret = not pallas_available()
     sharding = NamedSharding(mesh, P(TILE_AXIS))
     params = jax.device_put(jnp.asarray(params_np, jnp.float32), sharding)
     mrd_arr = jax.device_put(jnp.asarray(mrds, jnp.int32), sharding)
@@ -151,10 +165,7 @@ def _pallas_sharded_chain(mesh, params_np: np.ndarray, mrds: np.ndarray,
     @jax.jit
     def run(params, mrd_arr):
         out = _batched_pallas_sharded(params, mrd_arr, mesh=mesh,
-                                      definition=tile, max_iter_cap=cap,
-                                      unroll=DEFAULT_UNROLL, block_h=block_h,
-                                      block_w=block_w, clamp=False,
-                                      interpret=interpret)
+                                      definition=tile, clamp=False, **cfg)
         return jnp.sum(out.astype(jnp.int32), dtype=jnp.int32)
 
     return lambda: run(params, mrd_arr)

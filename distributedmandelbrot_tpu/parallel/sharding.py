@@ -197,16 +197,24 @@ def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
 @partial(jax.jit,
          static_argnames=("mesh", "definition", "max_iter_cap", "unroll",
                           "block_h", "block_w", "clamp", "interpret",
-                          "cycle_check"))
+                          "cycle_check", "batch_grid"))
 def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
                             max_iter_cap: int, unroll: int, block_h: int,
                             block_w: int, clamp: bool,
                             interpret: bool = False,
-                            cycle_check: bool | None = None):
-    """The Pallas kernel under shard_map: each device walks its tile shard
-    sequentially, every tile running the block-early-exit kernel with its
-    own traced budget (static cap = the batch max)."""
-    from distributedmandelbrot_tpu.ops.pallas_escape import _pallas_escape
+                            cycle_check: bool | None = None,
+                            batch_grid: bool = False):
+    """The Pallas kernel under shard_map: each device runs its tile shard
+    with its own traced per-tile budget (static cap = the batch max).
+
+    Deep budgets (``batch_grid=True``, decided by pallas_batch_config
+    from the TRUE deepest budget — not the padded compile cap) dispatch
+    the whole shard as ONE batch-grid kernel launch — consecutive deep
+    grid programs pipeline ~2x better (see the batch-grid note in
+    ops/pallas_escape.py); shallow budgets keep the per-tile ``lax.map``
+    chain, whose early-exit views measure a few percent faster."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        _pallas_escape, _pallas_escape_batch)
 
     def one_tile(p, m):
         return _pallas_escape(p[None, :], m[None, None].astype(jnp.int32),
@@ -216,6 +224,13 @@ def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
                               interpret=interpret, cycle_check=cycle_check)
 
     def shard_fn(p_shard, m_shard):
+        k_loc = p_shard.shape[0]
+        if batch_grid and k_loc > 1:
+            return _pallas_escape_batch(
+                p_shard, m_shard[:, None].astype(jnp.int32), k=k_loc,
+                height=definition, width=definition, max_iter=max_iter_cap,
+                unroll=unroll, block_h=block_h, block_w=block_w,
+                clamp=clamp, interpret=interpret, cycle_check=cycle_check)
         return lax.map(lambda args: one_tile(*args), (p_shard, m_shard))
 
     # check_vma off: pallas_call's out_shape is a plain ShapeDtypeStruct
@@ -236,8 +251,8 @@ def pallas_batch_config(definition: int, cap: int,
     can never drift.  Raises PallasUnsupported for int64 caps and
     unsupported tile extents."""
     from distributedmandelbrot_tpu.ops.pallas_escape import (
-        DEFAULT_UNROLL, PallasUnsupported, bucket_cap, fit_blocks,
-        pallas_available)
+        BATCH_GRID_MIN_ITER, DEFAULT_UNROLL, PallasUnsupported, bucket_cap,
+        fit_blocks, pallas_available)
 
     if cap - 1 >= INT32_SCALE_LIMIT:
         raise PallasUnsupported(
@@ -245,6 +260,11 @@ def pallas_batch_config(definition: int, cap: int,
     block_h, block_w = fit_blocks(definition, definition)
     return {"max_iter_cap": bucket_cap(cap),
             "cycle_check": resolve_cycle_check(None, cap),
+            # Depth-class policy follows the TRUE deepest budget, not the
+            # padded compile cap (same principle as the cycle probe —
+            # round-2 advisor finding): budgets 2049-4095 bucket to 4096
+            # but stay on the shallow per-tile chain.
+            "batch_grid": cap >= BATCH_GRID_MIN_ITER,
             "block_h": block_h, "block_w": block_w,
             "unroll": DEFAULT_UNROLL,
             "interpret": (not pallas_available() if interpret is None

@@ -467,3 +467,163 @@ def test_pallas_declines_sub_f32_resolution_views():
     spec = TileSpec(-0.74529, 0.11307, 1e-5, 1e-5, width=1024, height=1024)
     with pytest.raises(PallasUnsupported, match="f32 resolution"):
         compute_tile_pallas_device(spec, 100, interpret=True)
+
+
+# --- Batch-grid kernel (tiles as leading grid axis) -------------------------
+
+
+def test_batch_grid_matches_single_tile_kernel():
+    """_pallas_escape_batch must be bit-identical to k single-tile calls:
+    mixed windows (boundary, interior, sky), mixed budgets under one
+    bucketed cap, cycle probe armed (deep bucket)."""
+    import jax.numpy as jnp
+
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        _pallas_escape, _pallas_escape_batch, bucket_cap)
+
+    tile = 128
+    rows = [[-0.7436, 0.1317, 2e-3 / (tile - 1), 2e-3 / (tile - 1)],
+            [-0.2, -0.05, 0.1 / (tile - 1), 0.1 / (tile - 1)],
+            [1.5, 1.5, 0.1 / (tile - 1), 0.1 / (tile - 1)]]
+    mis = [5000, 4500, 4200]
+    cap = bucket_cap(max(mis))
+    params = jnp.asarray(rows, jnp.float32)
+    mrds = jnp.asarray([[m] for m in mis], jnp.int32)
+    out = _pallas_escape_batch(params, mrds, k=3, height=tile, width=tile,
+                               block_h=32, max_iter=cap, interpret=True)
+    for t in range(3):
+        ref = _pallas_escape(params[t][None, :],
+                             jnp.asarray([[mis[t]]], jnp.int32),
+                             height=tile, width=tile, block_h=32,
+                             max_iter=cap, interpret=True)
+        assert np.array_equal(np.asarray(out[t]), np.asarray(ref)), \
+            f"tile {t} diverged from the single-tile kernel"
+
+
+@pytest.mark.parametrize("mode", ["ship", "julia"])
+def test_batch_grid_families(mode):
+    """Batch-grid parity for the non-default families (the ship's abs
+    fold; julia's SMEM constant)."""
+    import jax.numpy as jnp
+
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        _pallas_escape, _pallas_escape_batch, bucket_cap)
+
+    tile = 128
+    kw = ({"burning": True} if mode == "ship"
+          else {"julia": True})
+    rows = [[-1.75, -0.04, 0.01 / (tile - 1), 0.01 / (tile - 1)],
+            [-1.76, -0.03, 0.02 / (tile - 1), 0.02 / (tile - 1)]]
+    if mode == "julia":
+        rows = [[-1.5, -1.5, 3.0 / (tile - 1), 3.0 / (tile - 1),
+                 -0.8, 0.156],
+                [-1.5, -1.5, 3.0 / (tile - 1), 3.0 / (tile - 1),
+                 0.285, 0.01]]
+    mis = [300, 200]
+    cap = bucket_cap(max(mis))
+    params = jnp.asarray(rows, jnp.float32)
+    mrds = jnp.asarray([[m] for m in mis], jnp.int32)
+    out = _pallas_escape_batch(params, mrds, k=2, height=tile, width=tile,
+                               block_h=32, max_iter=cap, interpret=True,
+                               interior_check=False, **kw)
+    for t in range(2):
+        ref = _pallas_escape(params[t][None, :],
+                             jnp.asarray([[mis[t]]], jnp.int32),
+                             height=tile, width=tile, block_h=32,
+                             max_iter=cap, interpret=True,
+                             interior_check=False, **kw)
+        assert np.array_equal(np.asarray(out[t]), np.asarray(ref))
+
+
+def test_batched_pallas_sharded_uses_batch_grid_for_deep_budgets():
+    """The sharded dispatch routes deep-budget shards through the
+    batch-grid kernel (k per device > 1 engages it); output must stay
+    identical to per-tile single-kernel calls.  The golden here is the
+    single-tile PALLAS kernel, not the XLA path: at depth >= 4096 a
+    last-ulp f32 difference between the two compilations (XLA-CPU fuses
+    FMAs; the kernel's op order is fixed) diverges on chaotic boundary
+    pixels, so cross-path equality is asserted at shallow budgets (and
+    on hardware by tools/tpu_revalidate.py), while THIS test pins the
+    dispatch/packing plumbing at the batch-grid depths."""
+    from distributedmandelbrot_tpu.core.geometry import TileSpec
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        BATCH_GRID_MIN_ITER, compute_tile_pallas_device)
+    from distributedmandelbrot_tpu.parallel.mesh import tile_mesh
+    from distributedmandelbrot_tpu.parallel.sharding import (
+        batched_escape_pixels_pallas)
+
+    tile = 128
+    mesh = tile_mesh()
+    n_dev = mesh.devices.size
+    k = 2 * n_dev  # two tiles per device: the batch-grid branch engages
+    rng_rows = [[-0.7436 + 1e-4 * t, 0.1317, 2e-3 / (tile - 1)]
+                for t in range(k)]
+    ss = np.array(rng_rows, np.float32)
+    mrds = np.array([BATCH_GRID_MIN_ITER + (t % 3) * 50 for t in range(k)],
+                    np.int64)
+    got = batched_escape_pixels_pallas(mesh, ss, mrds, definition=tile,
+                                       interpret=True)
+    for t in range(k):
+        spec = TileSpec(ss[t, 0], ss[t, 1], ss[t, 2] * (tile - 1),
+                        ss[t, 2] * (tile - 1), width=tile, height=tile)
+        want = np.asarray(compute_tile_pallas_device(
+            spec, int(mrds[t]), interpret=True))
+        assert np.array_equal(got[t], want), f"tile {t} diverged"
+
+
+# --- Packed multi-tile kernel (interleaved states) ---------------------------
+
+
+@pytest.mark.parametrize("cycle_check", [None, True])
+def test_packed_tiles_match_single_tile_kernel(cycle_check):
+    """compute_tiles_packed_pallas: byte-lane packing of 2..4 interleaved
+    tiles unpacks to exactly the single-tile kernel's planes (mixed
+    windows and budgets).  ``cycle_check=True`` forces the Brent probe —
+    the per-state snapshot refs and stride-6 scratch layout — which
+    budgets this small would otherwise never arm (it's the production
+    deep-view configuration, so it must not ship untested)."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_pallas_device, compute_tiles_packed_pallas)
+
+    tile = 128
+    specs = [TileSpec(-0.7436, 0.1317, 2e-3, 2e-3, width=tile, height=tile),
+             TileSpec(-0.2, -0.05, 0.1, 0.1, width=tile, height=tile),
+             TileSpec(1.5, 1.5, 0.1, 0.1, width=tile, height=tile),
+             TileSpec(-0.8, 0.1, 0.2, 0.2, width=tile, height=tile)]
+    mis = [300, 150, 80, 260]
+    for n in (2, 3, 4):
+        got = compute_tiles_packed_pallas(specs[:n], mis[:n], block_h=32,
+                                          interpret=True,
+                                          cycle_check=cycle_check)
+        assert len(got) == n
+        for s in range(n):
+            ref = compute_tile_pallas_device(specs[s], mis[s], block_h=32,
+                                             interpret=True,
+                                             cycle_check=cycle_check)
+            assert np.array_equal(np.asarray(got[s]), np.asarray(ref)), \
+                f"pack={n} state {s} diverged"
+
+
+def test_packed_tiles_julia_and_guards():
+    """Julia packing parity plus the dispatch guards: shape mismatch and
+    oversized packs raise PallasUnsupported."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        PallasUnsupported, compute_tile_pallas_device,
+        compute_tiles_packed_pallas)
+
+    tile = 128
+    spec = TileSpec(-1.5, -1.5, 3.0, 3.0, width=tile, height=tile)
+    cs = [-0.8 + 0.156j, 0.285 + 0.01j]
+    got = compute_tiles_packed_pallas([spec, spec], [200, 300], block_h=32,
+                                      interpret=True, julia_cs=cs)
+    for s, c in enumerate(cs):
+        ref = compute_tile_pallas_device(spec, [200, 300][s], block_h=32,
+                                         interpret=True, julia_c=c)
+        assert np.array_equal(np.asarray(got[s]), np.asarray(ref))
+
+    other = TileSpec(-1.5, -1.5, 3.0, 3.0, width=tile, height=64)
+    with pytest.raises(PallasUnsupported, match="share"):
+        compute_tiles_packed_pallas([spec, other], [100, 100],
+                                    interpret=True)
+    with pytest.raises(PallasUnsupported, match="pack"):
+        compute_tiles_packed_pallas([spec] * 5, [100] * 5, interpret=True)
