@@ -38,6 +38,7 @@ span and pixel offsets need floating point.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 from functools import partial
 
@@ -501,6 +502,50 @@ def _secondary_candidates(bad: np.ndarray, scanned: np.ndarray,
     return np.lexsort((center_dist, -scanned, scanned != 0))
 
 
+_DEVICE_ORBIT_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_DEVICE_ORBIT_MAX = 8
+# Byte bound: giant-budget orbits (the _orbit_cached_giant class, ~80 MB
+# for a 5M-step f64 orbit) must not pin hundreds of MB of HBM when the
+# upstream 2-deep host cache thrashes and strands stale ids here.
+_DEVICE_ORBIT_MAX_BYTES = 256 * 1024 * 1024
+
+
+def _device_orbit(z_re: np.ndarray, z_im: np.ndarray):
+    """Device-resident copy of a reference orbit, LRU-cached.
+
+    Re-uploading the orbit dominates deep-zoom wall time on tunneled dev
+    rigs (measured ~48 ms H2D for a 50000-step orbit vs ~40 ms of scan
+    compute on the config-4 view); repeated renders of a view and the
+    frames of an animation all reuse the same HOST orbit arrays (the
+    lru caches on _find_reference/_orbit_fixed), so the device copy is
+    keyed by host-array identity.  A content fingerprint guards against
+    id reuse after an upstream lru eviction frees the array; the x64
+    flag is part of the key because it changes the device dtype
+    jnp.asarray produces.  Entries: ~a few MB each at production
+    budgets, bounded at 8."""
+    key = (id(z_re), id(z_im), z_re.shape[0],
+           bool(jax.config.jax_enable_x64))
+    fp = (float(z_re[0]), float(z_re[-1]), float(z_im[0]),
+          float(z_im[-1]))
+    hit = _DEVICE_ORBIT_CACHE.get(key)
+    if hit is not None and hit[0] == fp:
+        _DEVICE_ORBIT_CACHE.move_to_end(key)
+        return hit[1], hit[2]
+    zr = jnp.asarray(z_re)
+    zi = jnp.asarray(z_im)
+    _DEVICE_ORBIT_CACHE[key] = (fp, zr, zi)
+
+    def total_bytes():
+        return sum(e[1].nbytes + e[2].nbytes
+                   for e in _DEVICE_ORBIT_CACHE.values())
+
+    while (len(_DEVICE_ORBIT_CACHE) > _DEVICE_ORBIT_MAX
+           or (len(_DEVICE_ORBIT_CACHE) > 1
+               and total_bytes() > _DEVICE_ORBIT_MAX_BYTES)):
+        _DEVICE_ORBIT_CACHE.popitem(last=False)
+    return zr, zi
+
+
 def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
                      dtype, prec_bits: int, max_glitch_fix: int | None,
                      julia_c: tuple[str, str] | None = None
@@ -549,12 +594,16 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     # Deltas are relative to the chosen reference, not the view center.
     dre -= off_re
     dim -= off_im
-    zr = jnp.asarray(z_re)
-    zi = jnp.asarray(z_im)
-    # Row-chunked: the scan carries its state through every step, so big
-    # tiles are walked in row bands to keep the carry VMEM-resident
-    # instead of thrashing HBM each iteration.
-    chunk = max(1, min(spec.height, (1 << 17) // max(1, spec.width)))
+    zr, zi = _device_orbit(z_re, z_im)
+    # Row-chunked: the scan carries its state through every step; big
+    # tiles are walked in row bands to bound the carry footprint.  The
+    # band size is a measured trade (dev v5e, config-4 view, mi=50000):
+    # each extra chunk pays a full dispatch + orbit re-walk, and raising
+    # the limit from 2^17 to 2^20 pixels was monotonically faster at
+    # every tile size tried (512^2: 0.47 -> 0.96 Mpix/s; 1024^2: 0.78 ->
+    # 1.39).  f64 carries twice the bytes, so its limit is halved.
+    limit = (1 << 20) if np.dtype(dtype) == np.float32 else (1 << 19)
+    chunk = max(1, min(spec.height, limit // max(1, spec.width)))
     vals, glitches = [], []
     for r0 in range(0, spec.height, chunk):
         # device_get on the pair fetches both planes concurrently — two
@@ -629,8 +678,9 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
             dim2 = np.zeros(k_pad)
             dre2[:k] = (bad[:, 1] - c2).astype(np.float64) * step
             dim2[:k] = (bad[:, 0] - r2).astype(np.float64) * step
+            zr2_dev, zi2_dev = _device_orbit(z2_re, z2_im)
             v2, g2 = jax.device_get(scan_fn(
-                jnp.asarray(z2_re), jnp.asarray(z2_im),
+                zr2_dev, zi2_dev,
                 jnp.asarray(dre2.astype(dtype)),
                 jnp.asarray(dim2.astype(dtype))))
             v2 = v2[:k]

@@ -437,3 +437,29 @@ def test_giant_budget_orbits_use_the_small_cache():
     r2 = P._orbit_fixed(za, zb, za, zb, 500, 128)
     assert P._orbit_cached.cache_info().currsize == 1
     assert P._orbit_fixed(za, zb, za, zb, 500, 128)[0] is r2[0]
+
+
+def test_device_orbit_cache_reuses_and_guards():
+    """_device_orbit returns the SAME device arrays for a repeated host
+    orbit (the upload dominated deep-zoom wall time on tunneled rigs)
+    and re-uploads when the identity key is stale (id reuse after an
+    upstream lru eviction — simulated by mutating the fingerprint)."""
+    import numpy as np
+
+    from distributedmandelbrot_tpu.ops import perturbation as pt
+
+    pt._DEVICE_ORBIT_CACHE.clear()
+    z_re = np.linspace(0.0, 1.0, 64)
+    z_im = np.linspace(1.0, 2.0, 64)
+    a1, b1 = pt._device_orbit(z_re, z_im)
+    a2, b2 = pt._device_orbit(z_re, z_im)
+    assert a1 is a2 and b1 is b2  # cache hit: no re-upload
+    assert np.allclose(np.asarray(a1), z_re.astype(np.asarray(a1).dtype))
+
+    # Same ids, different content (the id-reuse hazard): fingerprint
+    # mismatch must force a fresh upload, not serve the stale arrays.
+    z_re[-1] = 123.0
+    a3, _ = pt._device_orbit(z_re, z_im)
+    assert a3 is not a1
+    assert float(np.asarray(a3)[-1]) == 123.0
+    pt._DEVICE_ORBIT_CACHE.clear()
