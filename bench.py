@@ -97,8 +97,8 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
     from jax import lax
 
     from distributedmandelbrot_tpu.ops.pallas_escape import (
-        BATCH_GRID_MIN_ITER, _pallas_escape, _pallas_escape_batch,
-        fit_blocks, DEFAULT_BLOCK_H)
+        _pallas_escape, _pallas_escape_batch, fit_blocks, DEFAULT_BLOCK_H,
+        prefer_batch_grid)
 
     from distributedmandelbrot_tpu.parallel.sharding import widen_square_pitch
 
@@ -108,7 +108,7 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
     params = jnp.asarray(widen_square_pitch(params_np), jnp.float32)
     k = params.shape[0]
 
-    if max_iter >= BATCH_GRID_MIN_ITER and k > 1:
+    if k > 1 and prefer_batch_grid(max_iter, tile, tile, block_h, block_w):
         # Deep budgets: one batch-grid launch (same dispatch policy as
         # the production sharded path, sharding._batched_pallas_sharded).
         mrds = jnp.full((k, 1), max_iter, jnp.int32)

@@ -119,9 +119,19 @@ DEFAULT_BLOCK_W = 128
 
 # Escape-loop steps per while-iteration (between early-exit checks).
 # Each step is ~12 straight-line vector ops; the unroll amortizes the
-# scratch load/store and the live-count reduction.  32 and 64 measure
-# within noise of each other; 16 loses ~10% on deep views.
-DEFAULT_UNROLL = 32
+# scratch load/store and the live-count reduction.  Re-swept on live
+# hardware 2026-07-31 (tools/sweep_results.jsonl): a real trade, not a
+# uniform win — 64 gains +3-6% on boundary-dense views (seahorse
+# headline 569->590, filament raw 186->196 Mpix/s at 1024^2; same
+# pattern at 4096^2) but LOSES ~5-8% on sky-dominated full-domain
+# views (4096^2 full ic=true 457->434), whose blocks exit after a few
+# steps and pay the longer segment's overshoot.  64 ships because the
+# views it helps are the slow ones — the worst-case floor and the
+# conservative headline — while the views it costs are already the
+# fastest (full view benches ~2.4x the seahorse rate).  16 loses ~10%
+# on deep views.  Output-invariant regardless (overshoot cancels in
+# the count classification — equality-tested across unrolls).
+DEFAULT_UNROLL = 64
 
 
 def _interior_init(c_real, c_imag, dyn_steps, shape, interior_check: bool,
@@ -378,6 +388,25 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
 # arms), keep the per-tile chain below it.
 
 BATCH_GRID_MIN_ITER = 4096
+
+# Per-tile grids below this many programs can't amortize a launch on
+# their own — batch them regardless of depth (measured +7% on the
+# config-5 shape: 64 x 256^2 tiles = 8 programs each, mi=1000).
+BATCH_GRID_MIN_PROGRAMS = 64
+
+
+def prefer_batch_grid(budget: int, height: int, width: int,
+                      block_h: int, block_w: int) -> bool:
+    """The single copy of the batch-grid dispatch policy: one launch per
+    batch when deep budgets dominate (+17% measured at depth 5000 —
+    consecutive deep grid programs pipeline ~2x better) or when the
+    per-tile grid is too small to amortize a launch by itself; per-tile
+    chains otherwise (shallow early-exit views measure a few percent
+    faster there).  ``budget`` is the TRUE deepest budget, not the
+    padded compile cap (same principle as the cycle probe)."""
+    programs = (height // block_h) * (width // block_w)
+    return (budget >= BATCH_GRID_MIN_ITER
+            or programs < BATCH_GRID_MIN_PROGRAMS)
 
 
 def _escape_batch_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,

@@ -251,8 +251,8 @@ def pallas_batch_config(definition: int, cap: int,
     can never drift.  Raises PallasUnsupported for int64 caps and
     unsupported tile extents."""
     from distributedmandelbrot_tpu.ops.pallas_escape import (
-        BATCH_GRID_MIN_ITER, DEFAULT_UNROLL, PallasUnsupported, bucket_cap,
-        fit_blocks, pallas_available)
+        DEFAULT_UNROLL, PallasUnsupported, bucket_cap, fit_blocks,
+        pallas_available, prefer_batch_grid)
 
     if cap - 1 >= INT32_SCALE_LIMIT:
         raise PallasUnsupported(
@@ -260,11 +260,11 @@ def pallas_batch_config(definition: int, cap: int,
     block_h, block_w = fit_blocks(definition, definition)
     return {"max_iter_cap": bucket_cap(cap),
             "cycle_check": resolve_cycle_check(None, cap),
-            # Depth-class policy follows the TRUE deepest budget, not the
-            # padded compile cap (same principle as the cycle probe —
-            # round-2 advisor finding): budgets 2049-4095 bucket to 4096
-            # but stay on the shallow per-tile chain.
-            "batch_grid": cap >= BATCH_GRID_MIN_ITER,
+            # Policy from the TRUE deepest budget, not the padded
+            # compile cap (round-2 advisor principle): budgets
+            # 2049-4095 bucket to 4096 but stay on the per-tile chain.
+            "batch_grid": prefer_batch_grid(cap, definition, definition,
+                                            block_h, block_w),
             "block_h": block_h, "block_w": block_w,
             "unroll": DEFAULT_UNROLL,
             "interpret": (not pallas_available() if interpret is None

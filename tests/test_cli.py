@@ -316,3 +316,28 @@ def test_render_supersample_packed_matches_sequential(monkeypatch):
     assert returned["planes"] is not None and len(returned["planes"]) == 2, \
         "packed fast path did not engage (or declined the shape)"
     assert np.array_equal(np.asarray(seq), np.asarray(packed))
+
+
+def test_animate_supersample(tmp_path):
+    """animate --supersample threads through to every frame (the flag's
+    contract is shared with render via _render_view)."""
+    import numpy as np
+    from PIL import Image
+
+    out_dir = tmp_path / "frames"
+    rc = cli.main(["animate", "--center=-0.7436,0.1318",
+                   "--span-start", "0.01", "--span-end", "0.008",
+                   "--frames", "2", "--definition", "64",
+                   "--max-iter", "64", "--supersample", "2",
+                   "--out-dir", str(out_dir)])
+    assert rc == 0
+    plain_dir = tmp_path / "plain"
+    rc = cli.main(["animate", "--center=-0.7436,0.1318",
+                   "--span-start", "0.01", "--span-end", "0.008",
+                   "--frames", "2", "--definition", "64",
+                   "--max-iter", "64", "--out-dir", str(plain_dir)])
+    assert rc == 0
+    a = np.asarray(Image.open(out_dir / "frame_0000.png"), float)
+    b = np.asarray(Image.open(plain_dir / "frame_0000.png"), float)
+    assert a.shape == b.shape
+    assert (a != b).any()  # the samples blended
