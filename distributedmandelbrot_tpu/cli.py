@@ -292,7 +292,7 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
                  julia_c: tuple[str, str] | None = None,
                  family: tuple[int, bool] | None = None,
                  no_pallas: bool = False, normalize: bool = False,
-                 supersample: int = 1):
+                 supersample: int = 1, bla: bool = False):
     """One view -> RGBA (Mandelbrot, or Julia when ``julia_c`` is set, or
     a Multibrot/Burning-Ship view when ``family=(power, burning)``),
     choosing direct vs perturbation rendering.  Shared by the render and
@@ -316,7 +316,7 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
             render_kwargs=dict(smooth=smooth, np_dtype=np_dtype,
                                colormap=colormap, deep=deep, julia_c=julia_c,
                                family=family, no_pallas=no_pallas,
-                               normalize=normalize))
+                               normalize=normalize, bla=bla))
 
     pallas_first = ((lambda *a, **k: None) if no_pallas else _pallas_first)
 
@@ -375,7 +375,7 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
             scale_counts_to_uint8)
         counts, _ = compute_counts_perturb(dspec, max_iter,
                                            dtype=np_dtype,
-                                           julia_c=julia_c)
+                                           julia_c=julia_c, bla=bla)
         _warn_if_deep_all_inset(counts, max_iter, span)
         values = np.asarray(scale_counts_to_uint8(
             counts, max_iter=max_iter)).ravel()
@@ -782,6 +782,14 @@ def cmd_render(argv: Sequence[str]) -> int:
                         help="perturbation deep zoom: center taken at "
                              "arbitrary decimal precision, valid at any "
                              "span (auto-selected below 1e-12)")
+    parser.add_argument("--bla", action="store_true",
+                        help="bilinear-approximation fast path for deep "
+                             "integer renders (ops/bla.py): skips orbit "
+                             "segments where the delta recurrence is "
+                             "effectively linear — up to ~10x on slow "
+                             "(parabolic / minibrot-margin) deep views. "
+                             "Approximate by contract: escapes inside a "
+                             "skipped segment are detected at its end")
     parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
                         help="arithmetic width (the algorithm still auto-selects: sub-f32-resolution f32 renders use f32 perturbation); default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
@@ -811,6 +819,15 @@ def cmd_render(argv: Sequence[str]) -> int:
     if args.normalize and not args.smooth:
         raise SystemExit("--normalize applies to --smooth renders only "
                          "(integer output is already quantized upstream)")
+    if args.bla and args.smooth:
+        raise SystemExit("--bla accelerates integer deep renders; the "
+                         "smooth path has no BLA variant yet")
+    if args.bla and not args.deep and args.span >= DEEP_SPAN_THRESHOLD:
+        raise SystemExit("--bla applies to perturbation deep renders "
+                         "(--deep, or a span below "
+                         f"{DEEP_SPAN_THRESHOLD:g}); this span renders "
+                         "on the direct kernels, which have no orbit "
+                         "to skip")
     if family is not None:
         if args.deep:
             raise SystemExit(f"--fractal {args.fractal} has no perturbation "
@@ -834,7 +851,8 @@ def cmd_render(argv: Sequence[str]) -> int:
                         julia_c=julia_c, family=family,
                         no_pallas=args.no_pallas,
                         normalize=args.normalize,
-                        supersample=args.supersample)
+                        supersample=args.supersample,
+                        bla=args.bla)
     _save_png(args.out, rgba)
     return 0
 

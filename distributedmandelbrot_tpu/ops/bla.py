@@ -1,0 +1,348 @@
+"""BLA (bilinear approximation) acceleration for perturbation deep zoom.
+
+State of the art for deep-zoom renderers (Kalles Fraktaler 2.15+,
+fractalshades): over an orbit segment where the quadratic term of the
+delta recurrence is negligible, ``L`` perturbation steps collapse to ONE
+bilinear map
+
+    dz_{n+L} = A dz_n + B dc
+
+with ``(A, B)`` composed from the reference orbit and a conservative
+validity radius ``r`` bounding ``|dz_n|`` so the dropped ``dz^2`` terms
+stay below ``eps`` of the linear term.  Tables for skip lengths 1, 2, 4,
+... are built host-side in float64 by pairwise merging (O(orbit) work,
+a few MB) and the device loop applies the longest valid skip each
+iteration.
+
+TPU-native twist — **tile-granular skipping**: per-lane skip lengths
+diverge (the classical CPU implementations branch per pixel), which is
+poison for SIMD.  Here ONE skip decision is made per chunk per
+iteration from the maximum live ``|dz|``, so the whole chunk advances in
+lockstep: far-from-escape lanes (tiny deltas — the overwhelming
+majority of a deep view) ride long skips, and as soon as any live lane
+grows, the chunk degrades to exact single steps — which is precisely
+when accuracy matters.  Callers chunk tiles (see
+``perturbation._compute_perturb``) so a stalled region doesn't gate the
+whole tile.
+
+Accuracy contract (why this is an OPT-IN fast path, not the default):
+- the escape test runs at skip boundaries, not inside skipped segments,
+  so a pixel escaping mid-segment is detected late — its count lands at
+  the segment end (error < the skip length).  In practice lanes near
+  escape have large ``|dz|`` and fail the radius checks, forcing exact
+  steps, so measured count errors are confined to scattered boundary
+  pixels;
+- the same holds for Pauldelbrot glitch detection — a glitch inside a
+  skipped segment is flagged at the boundary (still flagged: glitched
+  deltas COLLAPSE toward ``-Z``, i.e. grow to ``|Z|`` scale, which
+  blows the radius check and forces exact stepping into the glitch);
+- skipped steps drop the quadratic term: deltas differ from the exact
+  scan at relative ``eps`` per skip (default 2^-16, ~256 ulps of f32
+  noise across a whole render).
+
+Reference files for the semantics being accelerated:
+``_perturb_scan`` (ops/perturbation.py) — counts, glitch flags and the
+in-set convention are identical by construction for pixels that never
+ride an invalid skip.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributedmandelbrot_tpu.ops.perturbation import GLITCH_TOL
+
+# Relative size of the dropped quadratic term at the base level:
+# |dz| < eps * |Z| keeps |dz^2| below eps of the linear |2 Z dz|.
+DEFAULT_BLA_EPS = 2.0 ** -16
+
+# Deepest skip = 2^LMAX steps; the loop pays (LMAX - min level) scalar
+# level checks per iteration.
+BLA_LEVELS_MAX = 14
+
+# Shortest STORED (and selectable) skip: skips below this aren't worth
+# an iteration's overhead (level checks + gathers + the live-max
+# reduction) versus just bursting exact steps, so levels under it are
+# merge intermediates only — never stored, uploaded, or selected.
+# Storage therefore costs ~5 * 2 * N / BLA_MIN_SKIP entries (row width
+# N / min_skip, halving per level), not the dense levels * N / 2.
+BLA_MIN_SKIP = 64
+
+
+def build_bla_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
+                    *, eps: float = DEFAULT_BLA_EPS,
+                    levels: int | None = None):
+    """Pairwise-merged BLA tables over a reference orbit (host, f64).
+
+    Returns ``(A_re, A_im, B_re, B_im, r2)`` each shaped
+    ``(rows, ceil(N / BLA_MIN_SKIP))`` — row ``i`` holds the entries for
+    skip length ``BLA_MIN_SKIP * 2^i`` (from orbit positions aligned to
+    it), right-padded with zeros (r2 = 0 => never valid).  ``dc_max`` is
+    the largest ``|dc|`` any lane will use — the merge's cross term is
+    bounded with it, so one table serves a whole tile.
+
+    Merge rule for segment1 (A1,B1,r1) followed by segment2 (A2,B2,r2):
+    valid iff the input delta fits segment1 AND the output of segment1
+    fits segment2 — conservatively ``|dz| < min(r1, (r2 - |B1| dc_max)
+    / |A1|)``; the composed map is ``A = A2 A1, B = A2 B1 + B2``.
+    """
+    n = len(z_re)
+    min_level = max(1, BLA_MIN_SKIP.bit_length() - 1)
+    if levels is None:
+        levels = min(BLA_LEVELS_MAX, max(min_level,
+                                         int(np.log2(max(2, n)))))
+    z = z_re.astype(np.float64) + 1j * z_im.astype(np.float64)
+    # Single-step linearization at position k: dz' = 2 Z_k dz + dc.
+    a = 2.0 * z
+    b = np.ones_like(z)
+    with np.errstate(over="ignore", invalid="ignore"):
+        r = eps * np.abs(z)
+    rows = max(1, levels - min_level + 1)
+    width = max(1, (n + BLA_MIN_SKIP - 1) // BLA_MIN_SKIP)
+    A_re = np.zeros((rows, width))
+    A_im = np.zeros((rows, width))
+    B_re = np.zeros((rows, width))
+    B_im = np.zeros((rows, width))
+    R2 = np.zeros((rows, width))
+
+    def store(row, a_l, b_l, r_l):
+        k = len(a_l)
+        A_re[row, :k] = a_l.real
+        A_im[row, :k] = a_l.imag
+        B_re[row, :k] = b_l.real
+        B_im[row, :k] = b_l.imag
+        R2[row, :k] = np.square(np.maximum(r_l, 0.0))
+
+    # a/b/r start as the per-position single-step maps (skip 1 — the
+    # exact path handles single steps, quadratic term included); each
+    # merge pass halves the count and doubles the skip.  Levels below
+    # min_level are intermediates only.
+    for level in range(1, levels + 1):
+        m = len(a) // 2
+        if m == 0:
+            break
+        a1, a2 = a[0:2 * m:2], a[1:2 * m:2]
+        b1, b2 = b[0:2 * m:2], b[1:2 * m:2]
+        r1, r2_ = r[0:2 * m:2], r[1:2 * m:2]
+        with np.errstate(over="ignore", invalid="ignore", divide="ignore"):
+            abs_a1 = np.abs(a1)
+            abs_b1 = np.abs(b1)
+            r_out = np.minimum(
+                r1, np.where(abs_a1 > 0,
+                             (r2_ - abs_b1 * dc_max) / np.maximum(
+                                 abs_a1, 1e-300), 0.0))
+            a_m = a2 * a1
+            b_m = a2 * b1 + b2
+        r_out = np.where(np.isfinite(r_out), r_out, 0.0)
+        a_m = np.where(np.isfinite(a_m), a_m, 0.0)
+        b_m = np.where(np.isfinite(b_m), b_m, 0.0)
+        if level >= min_level:
+            store(level - min_level, a_m, b_m, r_out)
+        a, b, r = a_m, b_m, np.maximum(r_out, 0.0)
+    return A_re, A_im, B_re, B_im, R2
+
+
+_TABLE_CACHE: "OrderedDict[tuple, tuple]" = OrderedDict()
+_TABLE_CACHE_MAX = 4
+# Byte bound, same rationale as perturbation's device-orbit cache:
+# giant-budget tables must not pin HBM when upstream caches thrash.
+_TABLE_CACHE_MAX_BYTES = 256 * 1024 * 1024
+
+
+def _device_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
+                  eps: float, dtype):
+    """Device-resident BLA table, LRU-cached like the orbit itself
+    (perturbation._device_orbit): animation frames and repeat renders
+    share the host orbit arrays, so identity + fingerprint keys work;
+    dc_max is quantized a power of two up so nearby frames share."""
+    q = float(2.0 ** np.ceil(np.log2(max(dc_max, 1e-300))))
+    key = (id(z_re), id(z_im), len(z_re), q, eps, np.dtype(dtype).str)
+    fp = (float(z_re[0]), float(z_re[-1]), float(z_im[-1]))
+    hit = _TABLE_CACHE.get(key)
+    if hit is not None and hit[0] == fp:
+        _TABLE_CACHE.move_to_end(key)
+        return hit[1]
+    host = build_bla_table(z_re, z_im, q, eps=eps)
+    dev = tuple(jnp.asarray(t, dtype) for t in host)
+    _TABLE_CACHE[key] = (fp, dev)
+
+    def total_bytes():
+        return sum(sum(t.nbytes for t in e[1])
+                   for e in _TABLE_CACHE.values())
+
+    while (len(_TABLE_CACHE) > _TABLE_CACHE_MAX
+           or (len(_TABLE_CACHE) > 1
+               and total_bytes() > _TABLE_CACHE_MAX_BYTES)):
+        _TABLE_CACHE.popitem(last=False)
+    return dev
+
+
+# Exact steps advanced per iteration when no skip validates: amortizes
+# the level checks / gathers / live-max reduction that otherwise triple
+# the cost of regions stuck on single steps.  256 matches the plain
+# scan's slice length (perturbation.PERTURB_SEGMENT grade), measured
+# necessary to keep burst-only regions near plain-scan speed.
+BLA_EXACT_BURST = 256
+
+
+@partial(jax.jit, static_argnames=("orbit_len", "max_iter", "levels",
+                                   "add_dc"))
+def _bla_scan(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
+              max_iter: int, levels: int, add_dc: bool = True):
+    """Delta advance with tile-granular BLA skips.
+
+    Same output conventions as ``_perturb_scan`` (counts, glitched,
+    active) for pixels that never ride a skip; see the module accuracy
+    contract for the rest.  The while carry holds the chunk's vector
+    state plus the scalar orbit position ``n``.  Iterations either apply
+    ONE bilinear skip or, when no level validates, a
+    :data:`BLA_EXACT_BURST`-step run of the exact per-step recurrence
+    (tests included — semantically the plain scan for those steps).
+    """
+    dtype = jnp.result_type(dc_re)
+    shape = dc_re.shape
+    four = jnp.asarray(4.0, dtype)
+    tol = jnp.asarray(GLITCH_TOL, dtype)
+    A_re, A_im, B_re, B_im, R2 = tabs
+    # Delta dtype everywhere (the orbit arrives f64 under x64 — same
+    # cast as _segmented_orbit_scan's callers) and tail padding so the
+    # burst's fixed-size dynamic slice always fits (short orbits, and
+    # bursts straddling the end; the per-step `valid` gate keeps the
+    # padded values inert).
+    z_re = jnp.concatenate([z_re.astype(dtype),
+                            jnp.zeros(BLA_EXACT_BURST, dtype)])
+    z_im = jnp.concatenate([z_im.astype(dtype),
+                            jnp.zeros(BLA_EXACT_BURST, dtype)])
+
+    def _burst_step(s, xs):
+        """One exact step of the burst scan: the plain _perturb_scan
+        step plus a scalar validity guard for bursts straddling the
+        orbit end (one guard variant only — a cond choosing between an
+        ungated and a gated scan was observed on XLA:TPU costing as if
+        BOTH branches execute).  Retirement positions come from per-lane
+        pass counting: a lane failing the test at in-burst offset j has
+        accumulated j passes, so cnt = n0 + passes — identical to the
+        positional convention."""
+        dzr, dzi, act, npass, glitched = s
+        zr, zi, i = xs
+        valid = i < orbit_len
+        fr = zr + dzr
+        fi = zi + dzi
+        mag2 = fr * fr + fi * fi
+        zmag2 = zr * zr + zi * zi
+        glitched = glitched | (act & valid & (mag2 < tol * zmag2))
+        act2 = act & ((mag2 < four) | ~valid)
+        npass = npass + act2.astype(jnp.int32)
+        ndzr = ((zr + zr) * dzr - (zi + zi) * dzi
+                + (dzr * dzr - dzi * dzi))
+        ndzi = (zr + zr) * dzi + (zi + zi) * dzr + 2 * dzr * dzi
+        if add_dc:
+            ndzr = ndzr + dc_re
+            ndzi = ndzi + dc_im
+        ndzr = jnp.where(valid, ndzr, dzr)
+        ndzi = jnp.where(valid, ndzi, dzi)
+        return (ndzr, ndzi, act2, npass, glitched), None
+
+    def exact_burst(state):
+        n0, dzr, dzi, act, cnt, glitched = state
+        zseg_r = lax.dynamic_slice_in_dim(z_re, n0, BLA_EXACT_BURST)
+        zseg_i = lax.dynamic_slice_in_dim(z_im, n0, BLA_EXACT_BURST)
+        idx = n0 + jnp.arange(BLA_EXACT_BURST, dtype=jnp.int32)
+        (dzr, dzi, act2, npass, glitched), _ = lax.scan(
+            _burst_step,
+            (dzr, dzi, act, jnp.zeros(shape, jnp.int32), glitched),
+            (zseg_r, zseg_i, idx))
+        newly = act & ~act2
+        cnt = jnp.where(newly, n0 + npass, cnt)
+        return (n0 + BLA_EXACT_BURST, dzr, dzi, act2, cnt, glitched)
+
+    def body(state):
+        n, dzr, dzi, act, cnt, glitched = state
+        zr = z_re[n]
+        zi = z_im[n]
+        # Escape/glitch test of z_{n+1} = Z[n] + dz_{n+1} (re-testing a
+        # position after a skip is harmless: positional counts).
+        fr = zr + dzr
+        fi = zi + dzi
+        mag2 = fr * fr + fi * fi
+        zmag2 = zr * zr + zi * zi
+        glitched = glitched | (act & (mag2 < tol * zmag2))
+        newly_out = act & (mag2 >= four)
+        cnt = jnp.where(newly_out, n, cnt)
+        act = act & ~newly_out
+        # Largest valid aligned skip for the whole chunk.  Table row i
+        # covers skip length 2^(min_level + i); levels below min_level
+        # are not stored (see BLA_MIN_SKIP) — a region that can only
+        # manage tiny skips runs exact bursts at plain-scan speed.
+        min_level = max(1, BLA_MIN_SKIP.bit_length() - 1)
+        max_dz2 = jnp.max(jnp.where(act, dzr * dzr + dzi * dzi,
+                                    jnp.zeros((), dtype)))
+        l_sel = jnp.asarray(0, jnp.int32)
+        for lv in range(min_level + levels - 1, min_level - 1, -1):
+            span = 1 << lv
+            idx = n >> lv
+            ok = ((n & (span - 1)) == 0) & (n + span <= orbit_len) \
+                & (max_dz2 < R2[lv - min_level, idx])
+            l_sel = jnp.where((l_sel == 0) & ok, lv, l_sel)
+
+        def apply_skip(s):
+            n, dzr, dzi, act, cnt, glitched = s
+            li = jnp.maximum(l_sel - min_level, 0)
+            ti = n >> jnp.maximum(l_sel, 1)
+            ar = A_re[li, ti]
+            ai = A_im[li, ti]
+            br = B_re[li, ti]
+            bi = B_im[li, ti]
+            bla_r = ar * dzr - ai * dzi
+            bla_i = ar * dzi + ai * dzr
+            if add_dc:
+                bla_r = bla_r + (br * dc_re - bi * dc_im)
+                bla_i = bla_i + (br * dc_im + bi * dc_re)
+            return (n + (jnp.int32(1) << l_sel), bla_r, bla_i, act, cnt,
+                    glitched)
+
+        return lax.cond(l_sel > 0, apply_skip, exact_burst,
+                        (n, dzr, dzi, act, cnt, glitched))
+
+    def cond(state):
+        n, _, _, act, _, _ = state
+        return (n < orbit_len) & jnp.any(act)
+
+    init = (jnp.asarray(0, jnp.int32), dc_re.astype(dtype),
+            dc_im.astype(dtype), jnp.ones(shape, jnp.bool_),
+            jnp.full(shape, orbit_len, jnp.int32),
+            jnp.zeros(shape, jnp.bool_))
+    n, dzr, dzi, act, cnt, glitched = lax.while_loop(cond, body, init)
+    # Lanes still active when the loop left: position n tests passed —
+    # n == orbit_len normally; an early exit (all inactive) leaves their
+    # cnt at the orbit_len sentinel, same thing.
+    if orbit_len < max_iter:
+        glitched = glitched | act
+    counts = jnp.where(cnt >= max_iter, 0, jnp.maximum(cnt, 1))
+    return counts, glitched, act
+
+
+def bla_scan_factory(z_re: np.ndarray, z_im: np.ndarray, dc_max: float, *,
+                     max_iter: int, dtype, add_dc: bool = True,
+                     eps: float = DEFAULT_BLA_EPS):
+    """A ``scan_fn(zr, zi, dre, dim) -> (counts, glitched)``-shaped
+    callable for ``perturbation._compute_perturb``, with the BLA table
+    built (and device-cached) from the HOST orbit arrays.  ``zr/zi``
+    passed at call time must be the device copies of the same orbit."""
+    tabs = _device_table(z_re, z_im, dc_max, eps, dtype)
+    levels = tabs[0].shape[0]
+    orbit_len = len(z_re)
+
+    def scan_fn(zr, zi, dre, dim):
+        counts, glitched, _ = _bla_scan(
+            zr, zi, tabs, dre, dim, orbit_len=orbit_len,
+            max_iter=max_iter, levels=levels, add_dc=add_dc)
+        return counts, glitched
+
+    return scan_fn

@@ -548,8 +548,8 @@ def _device_orbit(z_re: np.ndarray, z_im: np.ndarray):
 
 def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
                      dtype, prec_bits: int, max_glitch_fix: int | None,
-                     julia_c: tuple[str, str] | None = None
-                     ) -> tuple[np.ndarray, int]:
+                     julia_c: tuple[str, str] | None = None,
+                     scan_factory=None) -> tuple[np.ndarray, int]:
     """Shared perturbation driver: validates the span/dtype combination,
     widens orbit precision with depth, auto-selects the reference, runs
     ``scan_fn(zr, zi, dre, dim)`` over row chunks (it returns a value
@@ -561,6 +561,11 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     fixed parameter ``c`` — the delta recurrence simply loses its ``dc``
     term, everything else (reference selection, glitch handling, exact
     fallback) is family-agnostic.
+
+    ``scan_factory(z_re, z_im, dc_max) -> scan_fn`` (optional) builds an
+    orbit-specific scan instead of the shared ``scan_fn`` — the BLA fast
+    path needs its skip tables rebuilt per reference orbit, including
+    the secondary-reference repair pass.
 
     Spans must keep deltas representable: ~1e-30 floor for f32 deltas,
     ~1e-290 for f64 — deeper spans are rejected rather than silently
@@ -594,6 +599,9 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     # Deltas are relative to the chosen reference, not the view center.
     dre -= off_re
     dim -= off_im
+    if scan_factory is not None:
+        dc_max = float(np.sqrt(np.max(dre * dre + dim * dim)))
+        scan_fn = scan_factory(z_re, z_im, dc_max)
     zr, zi = _device_orbit(z_re, z_im)
     # Row-chunked: the scan carries its state through every step; big
     # tiles are walked in row bands to bound the carry footprint.  The
@@ -679,7 +687,13 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
             dre2[:k] = (bad[:, 1] - c2).astype(np.float64) * step
             dim2[:k] = (bad[:, 0] - r2).astype(np.float64) * step
             zr2_dev, zi2_dev = _device_orbit(z2_re, z2_im)
-            v2, g2 = jax.device_get(scan_fn(
+            if scan_factory is not None:
+                dc2_max = float(np.sqrt(np.max(
+                    dre2[:k] * dre2[:k] + dim2[:k] * dim2[:k])))
+                scan2 = scan_factory(z2_re, z2_im, dc2_max)
+            else:
+                scan2 = scan_fn
+            v2, g2 = jax.device_get(scan2(
                 zr2_dev, zi2_dev,
                 jnp.asarray(dre2.astype(dtype)),
                 jnp.asarray(dim2.astype(dtype))))
@@ -724,8 +738,8 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
                            dtype=np.float32,
                            prec_bits: int = DEFAULT_PREC_BITS,
                            max_glitch_fix: int | None = None,
-                           julia_c: tuple[str, str] | None = None
-                           ) -> tuple[np.ndarray, int]:
+                           julia_c: tuple[str, str] | None = None,
+                           bla: bool = False) -> tuple[np.ndarray, int]:
     """Escape counts for a deep-zoom tile via perturbation.
 
     Returns ``(counts, n_glitched)``: int32 (height, width) counts in
@@ -745,6 +759,12 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
     precision of the *view location* comes from the bigint reference
     orbit, not the device dtype (see :func:`_compute_perturb` for the
     span floors and precision widening).
+
+    ``bla=True`` selects the tile-granular bilinear-approximation fast
+    path (ops/bla.py) — far fewer device iterations at giant budgets in
+    exchange for a documented approximation (late escape/glitch
+    detection at skip boundaries); an OPT-IN speed mode, not the
+    default exact scan.
     """
     if max_iter <= 1:
         return np.zeros((spec.height, spec.width), np.int32), 0
@@ -756,10 +776,19 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
                                             add_dc=add_dc)
         return counts, glitched
 
+    factory = None
+    if bla:
+        from distributedmandelbrot_tpu.ops.bla import bla_scan_factory
+
+        def factory(z_re, z_im, dc_max):
+            return bla_scan_factory(z_re, z_im, dc_max,
+                                    max_iter=max_iter, dtype=dtype,
+                                    add_dc=add_dc)
+
     return _compute_perturb(spec, max_iter, scan, dtype=dtype,
                             prec_bits=prec_bits,
                             max_glitch_fix=max_glitch_fix,
-                            julia_c=julia_c)
+                            julia_c=julia_c, scan_factory=factory)
 
 
 def _escape_count_fixed(za: int, zb: int, max_iter: int, bits: int,

@@ -463,3 +463,61 @@ def test_device_orbit_cache_reuses_and_guards():
     assert a3 is not a1
     assert float(np.asarray(a3)[-1]) == 123.0
     pt._DEVICE_ORBIT_CACHE.clear()
+
+
+def test_bla_matches_exact_scan_on_filament_view():
+    """The BLA fast path (opt-in) agrees with the exact scan to its
+    documented contract: >= 99% pixel agreement on a boundary-crossing
+    view, and EXACT agreement where no skip ever rides over an escape
+    (the c=i Misiurewicz filaments at a budget deep enough to skip)."""
+    spec = P.DeepTileSpec("0", "1", 1e-12, width=64, height=64)
+    exact, _ = P.compute_counts_perturb(spec, 3000)
+    fast, _ = P.compute_counts_perturb(spec, 3000, bla=True)
+    agree = float((exact == fast).mean())
+    assert agree >= 0.99, f"BLA agreement {agree:.4f}"
+    # Escaped/in-set CLASSIFICATION must agree everywhere the counts do
+    # not: late detection shifts a count, never flips in-set status for
+    # lanes that took exact steps near their escape.
+    assert (((exact == 0) == (fast == 0)).mean()) >= 0.99
+
+
+def test_bla_skips_cover_inset_budget():
+    """An all-interior deep window (the period-6 bond point of the main
+    cardioid: c = 3/8 + i*sqrt(3)/8, exact to arbitrary digits) must
+    classify every pixel in-set through the full budget under BLA —
+    skipping may never turn a bounded orbit into an escape."""
+    import math
+
+    d = 40
+    num = math.isqrt(3 * 10 ** (2 * d)) * 125
+    s = str(num).zfill(d + 3)
+    im = s[:-(d + 3)] + "." + s[-(d + 3):]
+    spec = P.DeepTileSpec("0.375", im, 1e-15, width=32, height=32)
+    exact, _ = P.compute_counts_perturb(spec, 4000)
+    fast, _ = P.compute_counts_perturb(spec, 4000, bla=True)
+    assert np.array_equal(exact, fast)
+    assert (exact == 0).all()
+
+
+def test_bla_table_composition():
+    """The first STORED level's coefficients equal the exact composition
+    of the BLA_MIN_SKIP single-step linearizations they merge
+    (dz' = A dz + B dc with the quadratic terms dropped)."""
+    from distributedmandelbrot_tpu.ops.bla import (BLA_MIN_SKIP,
+                                                   build_bla_table)
+
+    rng = np.random.default_rng(7)
+    n = 2 * BLA_MIN_SKIP
+    # Bounded-orbit-like values keep the composition well-conditioned.
+    z = 0.5 * (rng.normal(size=n) + 1j * rng.normal(size=n))
+    A_re, A_im, B_re, B_im, R2 = build_bla_table(
+        z.real.copy(), z.imag.copy(), dc_max=1e-12)
+    dz = 1e-10 + 0j
+    dc = 1e-12 + 0j
+    want = dz
+    for k in range(BLA_MIN_SKIP):
+        want = 2.0 * z[k] * want + dc
+    got = (A_re[0, 0] + 1j * A_im[0, 0]) * dz \
+        + (B_re[0, 0] + 1j * B_im[0, 0]) * dc
+    assert abs(got - want) <= 1e-6 * max(abs(want), 1e-30)
+    assert (R2 >= 0).all() and np.isfinite(R2).all()
