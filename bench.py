@@ -433,6 +433,46 @@ def bench_config4(repeats: int) -> dict:
     return out
 
 
+def bench_deepslow(repeats: int) -> dict:
+    """Slow-dynamics deep zoom: the period-6 bond point of the main
+    cardioid (c = 3/8 + i sqrt(3)/8, center exact to 40 digits) at span
+    1e-15 and budget 100000 — a parabolic window where every pixel runs
+    the full orbit.  The classic pathological deep-zoom case; reports
+    the exact perturbation scan and the opt-in BLA fast path
+    (ops/bla.py — approximate by documented contract, bit-identical on
+    THIS all-interior view, which the render asserts)."""
+    import math
+
+    from distributedmandelbrot_tpu.ops import (DeepTileSpec,
+                                               compute_counts_perturb)
+
+    d = 40
+    num = math.isqrt(3 * 10 ** (2 * d)) * 125
+    ds = str(num).zfill(d + 3)
+    im = ds[:-(d + 3)] + "." + ds[-(d + 3):]
+    side, mi = 256, 100_000
+    spec = DeepTileSpec("0.375", im, 1e-15, width=side, height=side)
+
+    outs = {}
+
+    def leg(bla):
+        def run():
+            outs[bla] = compute_counts_perturb(spec, mi, bla=bla)[0]
+            return np.zeros(())
+        return run
+
+    t_exact = _time_chain(leg(False), max(1, repeats - 1))
+    t_bla = _time_chain(leg(True), max(1, repeats - 1))
+    if not np.array_equal(outs[False], outs[True]):
+        raise AssertionError("BLA diverged on the all-interior bond view")
+    return {"metric": f"deep-slow parabolic bond point {side}^2 mi={mi} "
+                      "span 1e-15 (exact perturbation vs opt-in BLA)",
+            "value": round(_mpix(side * side, t_exact), 3),
+            "unit": "Mpix/s",
+            "bla_mpix_s": round(_mpix(side * side, t_bla), 3),
+            "bla_speedup": round(t_exact / t_bla, 1)}
+
+
 def bench_config5(repeats: int, segment: int) -> dict:
     """BASELINE config 5 (local-mesh stand-in for v5e-16): 60-frame zoom,
     every frame's tile batch chained on device in one dispatch.
@@ -711,6 +751,7 @@ def main() -> int:
                    lambda r: bench_config3(r, args.segment),
                    bench_config4,
                    lambda r: bench_config5(r, args.segment),
+                   bench_deepslow,
                    bench_worstcase,
                    bench_farm):
             try:

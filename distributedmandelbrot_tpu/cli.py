@@ -361,7 +361,7 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
                              height=definition)
         if smooth:
             nu, _ = compute_smooth_perturb(dspec, max_iter, dtype=np_dtype,
-                                           julia_c=julia_c)
+                                           julia_c=julia_c, bla=bla)
             _warn_if_deep_all_inset(nu, max_iter, span)
             return smooth_to_rgba(nu, max_iter, colormap=colormap,
                               normalize=normalize)
@@ -784,12 +784,14 @@ def cmd_render(argv: Sequence[str]) -> int:
                              "span (auto-selected below 1e-12)")
     parser.add_argument("--bla", action="store_true",
                         help="bilinear-approximation fast path for deep "
-                             "integer renders (ops/bla.py): skips orbit "
-                             "segments where the delta recurrence is "
-                             "effectively linear — up to ~10x on slow "
-                             "(parabolic / minibrot-margin) deep views. "
-                             "Approximate by contract: escapes inside a "
-                             "skipped segment are detected at its end")
+                             "renders, integer or --smooth (ops/bla.py): "
+                             "skips orbit segments where the delta "
+                             "recurrence is effectively linear — up to "
+                             "~10x on slow (parabolic / minibrot-margin) "
+                             "deep views.  Approximate by contract: "
+                             "escapes inside a skipped segment are "
+                             "detected at its end; smooth freeze values "
+                             "stay exact (the table's z_cap guard)")
     parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
                         help="arithmetic width (the algorithm still auto-selects: sub-f32-resolution f32 renders use f32 perturbation); default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
@@ -819,9 +821,6 @@ def cmd_render(argv: Sequence[str]) -> int:
     if args.normalize and not args.smooth:
         raise SystemExit("--normalize applies to --smooth renders only "
                          "(integer output is already quantized upstream)")
-    if args.bla and args.smooth:
-        raise SystemExit("--bla accelerates integer deep renders; the "
-                         "smooth path has no BLA variant yet")
     if args.bla and not args.deep and args.span >= DEEP_SPAN_THRESHOLD:
         raise SystemExit("--bla applies to perturbation deep renders "
                          "(--deep, or a span below "
@@ -901,6 +900,10 @@ def cmd_animate(argv: Sequence[str]) -> int:
                         help="anti-aliasing per frame (see dmtpu render "
                              "--supersample); zoom animations flicker "
                              "visibly less with it")
+    parser.add_argument("--bla", action="store_true",
+                        help="BLA fast path for the deep (perturbation) "
+                             "frames — see dmtpu render --bla; direct-"
+                             "kernel frames are unaffected")
     _add_no_pallas(parser)
     parser.add_argument("--out-dir", required=True,
                         help="directory for frame_NNNN.png files")
@@ -918,6 +921,7 @@ def cmd_animate(argv: Sequence[str]) -> int:
         raise SystemExit("--frames must be >= 1")
     if args.span_end <= 0 or args.span_start <= 0:
         raise SystemExit("spans must be positive")
+
 
     import os
     import time
@@ -960,7 +964,8 @@ def cmd_animate(argv: Sequence[str]) -> int:
                             np_dtype=np_dtype, colormap=args.colormap,
                             deep=deep, julia_c=julia_c, family=family,
                             no_pallas=args.no_pallas,
-                            supersample=args.supersample)
+                            supersample=args.supersample,
+                            bla=args.bla)
         path = os.path.join(args.out_dir, f"frame_{f:04d}.png")
         _save_png(path, rgba)
         print(f"frame {f + 1}/{args.frames} span {span:.3g} "

@@ -77,7 +77,8 @@ BLA_MIN_SKIP = 64
 
 def build_bla_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
                     *, eps: float = DEFAULT_BLA_EPS,
-                    levels: int | None = None):
+                    levels: int | None = None,
+                    z_cap: float | None = None):
     """Pairwise-merged BLA tables over a reference orbit (host, f64).
 
     Returns ``(A_re, A_im, B_re, B_im, r2)`` each shaped
@@ -91,6 +92,11 @@ def build_bla_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
     valid iff the input delta fits segment1 AND the output of segment1
     fits segment2 — conservatively ``|dz| < min(r1, (r2 - |B1| dc_max)
     / |A1|)``; the composed map is ``A = A2 A1, B = A2 B1 + B2``.
+
+    ``z_cap`` (the smooth variant's guard) zeroes base radii at orbit
+    positions with ``|Z| >= z_cap``: a valid skip then cannot cross the
+    smooth bailout radius, so the frozen full value a smooth render
+    reads is always produced by exact steps.
     """
     n = len(z_re)
     min_level = max(1, BLA_MIN_SKIP.bit_length() - 1)
@@ -103,6 +109,8 @@ def build_bla_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
     b = np.ones_like(z)
     with np.errstate(over="ignore", invalid="ignore"):
         r = eps * np.abs(z)
+        if z_cap is not None:
+            r = np.where(np.abs(z) < z_cap, r, 0.0)
     rows = max(1, levels - min_level + 1)
     width = max(1, (n + BLA_MIN_SKIP - 1) // BLA_MIN_SKIP)
     A_re = np.zeros((rows, width))
@@ -156,19 +164,20 @@ _TABLE_CACHE_MAX_BYTES = 256 * 1024 * 1024
 
 
 def _device_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
-                  eps: float, dtype):
+                  eps: float, dtype, z_cap: float | None = None):
     """Device-resident BLA table, LRU-cached like the orbit itself
     (perturbation._device_orbit): animation frames and repeat renders
     share the host orbit arrays, so identity + fingerprint keys work;
     dc_max is quantized a power of two up so nearby frames share."""
     q = float(2.0 ** np.ceil(np.log2(max(dc_max, 1e-300))))
-    key = (id(z_re), id(z_im), len(z_re), q, eps, np.dtype(dtype).str)
+    key = (id(z_re), id(z_im), len(z_re), q, eps, np.dtype(dtype).str,
+           z_cap)
     fp = (float(z_re[0]), float(z_re[-1]), float(z_im[-1]))
     hit = _TABLE_CACHE.get(key)
     if hit is not None and hit[0] == fp:
         _TABLE_CACHE.move_to_end(key)
         return hit[1]
-    host = build_bla_table(z_re, z_im, q, eps=eps)
+    host = build_bla_table(z_re, z_im, q, eps=eps, z_cap=z_cap)
     dev = tuple(jnp.asarray(t, dtype) for t in host)
     _TABLE_CACHE[key] = (fp, dev)
 
@@ -191,6 +200,55 @@ def _device_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
 BLA_EXACT_BURST = 256
 
 
+def _select_skip(n, max_dz2, R2, levels: int, orbit_len: int):
+    """Largest valid aligned skip LEVEL for the whole chunk, or 0.
+    Table row i covers skip length 2^(min_level + i); levels below
+    min_level are not stored (see BLA_MIN_SKIP) — a region that can
+    only manage tiny skips runs exact bursts at plain-scan speed.  The
+    single copy of the validity condition for BOTH scan variants."""
+    min_level = max(1, BLA_MIN_SKIP.bit_length() - 1)
+    l_sel = jnp.asarray(0, jnp.int32)
+    for lv in range(min_level + levels - 1, min_level - 1, -1):
+        span = 1 << lv
+        idx = n >> lv
+        ok = ((n & (span - 1)) == 0) & (n + span <= orbit_len) \
+            & (max_dz2 < R2[lv - min_level, idx])
+        l_sel = jnp.where((l_sel == 0) & ok, lv, l_sel)
+    return l_sel
+
+
+def _apply_skip_map(l_sel, n, tabs, dzr, dzi, dc_re, dc_im,
+                    add_dc: bool):
+    """Apply the selected level's bilinear map: ``dz' = A dz + B dc``,
+    advancing ``n`` by the skip length.  The single copy of the gather
+    and complex arithmetic for both scan variants."""
+    min_level = max(1, BLA_MIN_SKIP.bit_length() - 1)
+    A_re, A_im, B_re, B_im, _ = tabs
+    li = jnp.maximum(l_sel - min_level, 0)
+    ti = n >> jnp.maximum(l_sel, 1)
+    ar = A_re[li, ti]
+    ai = A_im[li, ti]
+    br = B_re[li, ti]
+    bi = B_im[li, ti]
+    bla_r = ar * dzr - ai * dzi
+    bla_i = ar * dzi + ai * dzr
+    if add_dc:
+        bla_r = bla_r + (br * dc_re - bi * dc_im)
+        bla_i = bla_i + (br * dc_im + bi * dc_re)
+    return n + (jnp.int32(1) << l_sel), bla_r, bla_i
+
+
+def _padded_orbit(z_re, z_im, dtype):
+    """Orbit cast to the delta dtype (it arrives f64 under x64 — same
+    cast as _segmented_orbit_scan's callers) with tail padding so the
+    bursts' fixed-size dynamic slices always fit; the per-step validity
+    gate keeps padded values inert."""
+    return (jnp.concatenate([z_re.astype(dtype),
+                             jnp.zeros(BLA_EXACT_BURST, dtype)]),
+            jnp.concatenate([z_im.astype(dtype),
+                             jnp.zeros(BLA_EXACT_BURST, dtype)]))
+
+
 @partial(jax.jit, static_argnames=("orbit_len", "max_iter", "levels",
                                    "add_dc"))
 def _bla_scan(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
@@ -209,16 +267,8 @@ def _bla_scan(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
     shape = dc_re.shape
     four = jnp.asarray(4.0, dtype)
     tol = jnp.asarray(GLITCH_TOL, dtype)
-    A_re, A_im, B_re, B_im, R2 = tabs
-    # Delta dtype everywhere (the orbit arrives f64 under x64 — same
-    # cast as _segmented_orbit_scan's callers) and tail padding so the
-    # burst's fixed-size dynamic slice always fits (short orbits, and
-    # bursts straddling the end; the per-step `valid` gate keeps the
-    # padded values inert).
-    z_re = jnp.concatenate([z_re.astype(dtype),
-                            jnp.zeros(BLA_EXACT_BURST, dtype)])
-    z_im = jnp.concatenate([z_im.astype(dtype),
-                            jnp.zeros(BLA_EXACT_BURST, dtype)])
+    R2 = tabs[4]
+    z_re, z_im = _padded_orbit(z_re, z_im, dtype)
 
     def _burst_step(s, xs):
         """One exact step of the burst scan: the plain _perturb_scan
@@ -276,36 +326,15 @@ def _bla_scan(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
         newly_out = act & (mag2 >= four)
         cnt = jnp.where(newly_out, n, cnt)
         act = act & ~newly_out
-        # Largest valid aligned skip for the whole chunk.  Table row i
-        # covers skip length 2^(min_level + i); levels below min_level
-        # are not stored (see BLA_MIN_SKIP) — a region that can only
-        # manage tiny skips runs exact bursts at plain-scan speed.
-        min_level = max(1, BLA_MIN_SKIP.bit_length() - 1)
         max_dz2 = jnp.max(jnp.where(act, dzr * dzr + dzi * dzi,
                                     jnp.zeros((), dtype)))
-        l_sel = jnp.asarray(0, jnp.int32)
-        for lv in range(min_level + levels - 1, min_level - 1, -1):
-            span = 1 << lv
-            idx = n >> lv
-            ok = ((n & (span - 1)) == 0) & (n + span <= orbit_len) \
-                & (max_dz2 < R2[lv - min_level, idx])
-            l_sel = jnp.where((l_sel == 0) & ok, lv, l_sel)
+        l_sel = _select_skip(n, max_dz2, R2, levels, orbit_len)
 
         def apply_skip(s):
             n, dzr, dzi, act, cnt, glitched = s
-            li = jnp.maximum(l_sel - min_level, 0)
-            ti = n >> jnp.maximum(l_sel, 1)
-            ar = A_re[li, ti]
-            ai = A_im[li, ti]
-            br = B_re[li, ti]
-            bi = B_im[li, ti]
-            bla_r = ar * dzr - ai * dzi
-            bla_i = ar * dzi + ai * dzr
-            if add_dc:
-                bla_r = bla_r + (br * dc_re - bi * dc_im)
-                bla_i = bla_i + (br * dc_im + bi * dc_re)
-            return (n + (jnp.int32(1) << l_sel), bla_r, bla_i, act, cnt,
-                    glitched)
+            n, bla_r, bla_i = _apply_skip_map(l_sel, n, tabs, dzr, dzi,
+                                              dc_re, dc_im, add_dc)
+            return (n, bla_r, bla_i, act, cnt, glitched)
 
         return lax.cond(l_sel > 0, apply_skip, exact_burst,
                         (n, dzr, dzi, act, cnt, glitched))
@@ -344,5 +373,149 @@ def bla_scan_factory(z_re: np.ndarray, z_im: np.ndarray, dc_max: float, *,
             zr, zi, tabs, dre, dim, orbit_len=orbit_len,
             max_iter=max_iter, levels=levels, add_dc=add_dc)
         return counts, glitched
+
+    return scan_fn
+
+
+@partial(jax.jit, static_argnames=("orbit_len", "max_iter", "levels",
+                                   "bailout", "add_dc"))
+def _bla_scan_smooth(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
+                     max_iter: int, levels: int, bailout: float,
+                     add_dc: bool = True):
+    """Smooth twin of :func:`_bla_scan`: mirrors
+    ``perturbation._perturb_scan_smooth``'s conventions (frozen full
+    value at the first radius-``bailout`` crossing, radius-2 count for
+    in-set classification) with tile-granular skips.
+
+    The table must be built with ``z_cap = bailout / 2`` (the factory
+    does): skips then never cross the smoothing radius, so every frozen
+    value is produced by exact steps — the nu payload keeps exact-scan
+    quality wherever a lane freezes.  Escape/glitch timing carries the
+    same boundary-detection contract as the integer scan.
+    """
+    dtype = jnp.result_type(dc_re)
+    shape = dc_re.shape
+    four = jnp.asarray(4.0, dtype)
+    b2 = jnp.asarray(bailout * bailout, dtype)
+    tol = jnp.asarray(GLITCH_TOL, dtype)
+    R2 = tabs[4]
+    z_re, z_im = _padded_orbit(z_re, z_im, dtype)
+
+    def _burst_step(s, xs):
+        dzr, dzi, act_b, nb, act2, n2, fzr, fzi, glitched = s
+        zr, zi, i = xs
+        valid = i < orbit_len
+        fr = zr + dzr
+        fi = zi + dzi
+        mag2 = fr * fr + fi * fi
+        zmag2 = zr * zr + zi * zi
+        glitched = glitched | (act2 & valid & (mag2 < tol * zmag2))
+        newly = act_b & valid & (mag2 >= b2)
+        fzr = jnp.where(newly, fr, fzr)
+        fzi = jnp.where(newly, fi, fzi)
+        act_b = act_b & ((mag2 < b2) | ~valid)
+        nb = nb + act_b.astype(jnp.int32)
+        act2 = act2 & ((mag2 < four) | ~valid)
+        n2 = n2 + act2.astype(jnp.int32)
+        ndzr = (zr + zr) * dzr - (zi + zi) * dzi + (dzr * dzr - dzi * dzi)
+        ndzi = (zr + zr) * dzi + (zi + zi) * dzr + 2 * dzr * dzi
+        if add_dc:
+            ndzr = ndzr + dc_re
+            ndzi = ndzi + dc_im
+        ndzr = jnp.where(valid, ndzr, dzr)
+        ndzi = jnp.where(valid, ndzi, dzi)
+        return (ndzr, ndzi, act_b, nb, act2, n2, fzr, fzi, glitched), None
+
+    def exact_burst(state):
+        (n0, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi,
+         glitched) = state
+        zseg_r = lax.dynamic_slice_in_dim(z_re, n0, BLA_EXACT_BURST)
+        zseg_i = lax.dynamic_slice_in_dim(z_im, n0, BLA_EXACT_BURST)
+        idx = n0 + jnp.arange(BLA_EXACT_BURST, dtype=jnp.int32)
+        zeros_i = jnp.zeros(shape, jnp.int32)
+        (dzr, dzi, nact_b, nb, nact2, n2, fzr, fzi, glitched), _ = \
+            lax.scan(_burst_step,
+                     (dzr, dzi, act_b, zeros_i, act2, zeros_i, fzr, fzi,
+                      glitched),
+                     (zseg_r, zseg_i, idx))
+        cnt_b = jnp.where(act_b & ~nact_b, n0 + nb, cnt_b)
+        cnt2 = jnp.where(act2 & ~nact2, n0 + n2, cnt2)
+        return (n0 + BLA_EXACT_BURST, dzr, dzi, nact_b, cnt_b, nact2,
+                cnt2, fzr, fzi, glitched)
+
+    def body(state):
+        (n, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi,
+         glitched) = state
+        zr = z_re[n]
+        zi = z_im[n]
+        fr = zr + dzr
+        fi = zi + dzi
+        mag2 = fr * fr + fi * fi
+        zmag2 = zr * zr + zi * zi
+        glitched = glitched | (act2 & (mag2 < tol * zmag2))
+        newly = act_b & (mag2 >= b2)
+        fzr = jnp.where(newly, fr, fzr)
+        fzi = jnp.where(newly, fi, fzi)
+        cnt_b = jnp.where(newly, n, cnt_b)
+        act_b = act_b & ~newly
+        out2 = act2 & (mag2 >= four)
+        cnt2 = jnp.where(out2, n, cnt2)
+        act2 = act2 & ~out2
+        live = act_b | act2
+        max_dz2 = jnp.max(jnp.where(live, dzr * dzr + dzi * dzi,
+                                    jnp.zeros((), dtype)))
+        l_sel = _select_skip(n, max_dz2, R2, levels, orbit_len)
+
+        def apply_skip(s):
+            (n, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi,
+             glitched) = s
+            n, bla_r, bla_i = _apply_skip_map(l_sel, n, tabs, dzr, dzi,
+                                              dc_re, dc_im, add_dc)
+            return (n, bla_r, bla_i, act_b, cnt_b, act2, cnt2, fzr, fzi,
+                    glitched)
+
+        return lax.cond(l_sel > 0, apply_skip, exact_burst, state)
+
+    def cond(state):
+        n, _, _, act_b, _, act2 = state[:6]
+        return (n < orbit_len) & jnp.any(act_b | act2)
+
+    ones = jnp.ones(shape, jnp.bool_)
+    sent = jnp.full(shape, orbit_len, jnp.int32)
+    init = (jnp.asarray(0, jnp.int32), dc_re.astype(dtype),
+            dc_im.astype(dtype), ones, sent, ones, sent,
+            jnp.full(shape, bailout, dtype), jnp.zeros(shape, dtype),
+            jnp.zeros(shape, jnp.bool_))
+    (n, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi, glitched) = \
+        lax.while_loop(cond, body, init)
+    if orbit_len < max_iter:
+        glitched = glitched | act2
+    # Identical epilogue to _perturb_scan_smooth, with the positional
+    # counts standing in for the accumulated ones.
+    mag2 = jnp.maximum(fzr * fzr + fzi * fzi, b2)
+    log_ratio = jnp.log(mag2) / jnp.asarray(2.0 * np.log(bailout), dtype)
+    nu = (cnt_b + 1).astype(dtype) - jnp.log2(log_ratio)
+    nu = jnp.where(cnt2 >= max_iter, jnp.zeros((), dtype), nu)
+    return nu, glitched
+
+
+def bla_smooth_scan_factory(z_re: np.ndarray, z_im: np.ndarray,
+                            dc_max: float, *, max_iter: int, bailout: float,
+                            dtype, add_dc: bool = True,
+                            eps: float = DEFAULT_BLA_EPS):
+    """Smooth counterpart of :func:`bla_scan_factory` — returns a
+    ``scan_fn(zr, zi, dre, dim) -> (nu, glitched)``.  The table carries
+    the ``z_cap = bailout / 2`` guard so freezes always come from exact
+    steps."""
+    tabs = _device_table(z_re, z_im, dc_max, eps, dtype,
+                         z_cap=bailout / 2.0)
+    levels = tabs[0].shape[0]
+    orbit_len = len(z_re)
+
+    def scan_fn(zr, zi, dre, dim):
+        return _bla_scan_smooth(zr, zi, tabs, dre, dim,
+                                orbit_len=orbit_len, max_iter=max_iter,
+                                levels=levels, bailout=float(bailout),
+                                add_dc=add_dc)
 
     return scan_fn

@@ -913,8 +913,8 @@ def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
                            prec_bits: int = DEFAULT_PREC_BITS,
                            bailout: float = 256.0,
                            max_glitch_fix: int | None = None,
-                           julia_c: tuple[str, str] | None = None
-                           ) -> tuple[np.ndarray, int]:
+                           julia_c: tuple[str, str] | None = None,
+                           bla: bool = False) -> tuple[np.ndarray, int]:
     """Smooth (band-free) deep-zoom values via perturbation.
 
     Returns ``(nu, n_glitched)``: float (height, width) renormalized
@@ -925,6 +925,11 @@ def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
     the exact fixed-point fallback (a one-level banding artifact on
     those isolated pixels — acceptable, since the alternative is
     arbitrary-precision log arithmetic).
+
+    ``bla=True``: the tile-granular bilinear-approximation fast path
+    (ops/bla.py) — the table's ``z_cap`` guard keeps every frozen
+    smoothing value exact; escape/glitch timing carries the documented
+    skip-boundary contract.
     """
     if max_iter <= 1:
         return np.zeros((spec.height, spec.width), dtype), 0
@@ -934,7 +939,17 @@ def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
         return _perturb_scan_smooth(zr, zi, dre, dim, max_iter=max_iter,
                                     bailout=float(bailout), add_dc=add_dc)
 
+    factory = None
+    if bla:
+        from distributedmandelbrot_tpu.ops.bla import bla_smooth_scan_factory
+
+        def factory(z_re, z_im, dc_max):
+            return bla_smooth_scan_factory(z_re, z_im, dc_max,
+                                           max_iter=max_iter,
+                                           bailout=float(bailout),
+                                           dtype=dtype, add_dc=add_dc)
+
     return _compute_perturb(spec, max_iter, scan, dtype=dtype,
                             prec_bits=prec_bits,
                             max_glitch_fix=max_glitch_fix,
-                            julia_c=julia_c)
+                            julia_c=julia_c, scan_factory=factory)
