@@ -441,17 +441,14 @@ def bench_deepslow(repeats: int) -> dict:
     the exact perturbation scan and the opt-in BLA fast path
     (ops/bla.py — approximate by documented contract, bit-identical on
     THIS all-interior view, which the render asserts)."""
-    import math
-
     from distributedmandelbrot_tpu.ops import (DeepTileSpec,
                                                compute_counts_perturb)
+    from distributedmandelbrot_tpu.ops.bla import (BOND_POINT_IM,
+                                                   BOND_POINT_RE)
 
-    d = 40
-    num = math.isqrt(3 * 10 ** (2 * d)) * 125
-    ds = str(num).zfill(d + 3)
-    im = ds[:-(d + 3)] + "." + ds[-(d + 3):]
     side, mi = 256, 100_000
-    spec = DeepTileSpec("0.375", im, 1e-15, width=side, height=side)
+    spec = DeepTileSpec(BOND_POINT_RE, BOND_POINT_IM, 1e-15,
+                        width=side, height=side)
 
     outs = {}
 

@@ -66,6 +66,16 @@ DEFAULT_BLA_EPS = 2.0 ** -16
 # level checks per iteration.
 BLA_LEVELS_MAX = 14
 
+# The period-6 bond point of the main cardioid, c = 3/8 + i sqrt(3)/8
+# (boundary angle pi/3) — exactly representable as decimal strings
+# (imag = isqrt(3 * 10^80) * 125, digit-shifted), and the canonical
+# slow-dynamics benchmark view for this module: parabolic (multiplier
+# 1) dynamics keep every pixel of a deep window iterating to the full
+# budget, the case BLA accelerates ~10x.  Shared by bench.py's
+# deep-slow config and the test suite so they can never drift.
+BOND_POINT_RE = "0.375"
+BOND_POINT_IM = "0.2165063509461096616909307926882340458678500"
+
 # Shortest STORED (and selectable) skip: skips below this aren't worth
 # an iteration's overhead (level checks + gathers + the live-max
 # reduction) versus just bursting exact steps, so levels under it are
@@ -78,7 +88,7 @@ BLA_MIN_SKIP = 64
 def build_bla_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
                     *, eps: float = DEFAULT_BLA_EPS,
                     levels: int | None = None,
-                    z_cap: float | None = None):
+                    z_cap: float = 4.0):
     """Pairwise-merged BLA tables over a reference orbit (host, f64).
 
     Returns ``(A_re, A_im, B_re, B_im, r2)`` each shaped
@@ -93,10 +103,17 @@ def build_bla_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
     fits segment2 — conservatively ``|dz| < min(r1, (r2 - |B1| dc_max)
     / |A1|)``; the composed map is ``A = A2 A1, B = A2 B1 + B2``.
 
-    ``z_cap`` (the smooth variant's guard) zeroes base radii at orbit
-    positions with ``|Z| >= z_cap``: a valid skip then cannot cross the
-    smooth bailout radius, so the frozen full value a smooth render
-    reads is always produced by exact steps.
+    ``z_cap`` zeroes base radii at orbit positions with ``|Z| >=
+    z_cap``.  The default (4.0) invalidates every segment touching
+    ESCAPED orbit values: a bounded reference stays |Z| <= 2, and the
+    post-escape extension squares toward ~1e100 — segments straddling
+    the escape would otherwise merge huge-but-positive-radius entries
+    whose coefficients saturate to inf in f32, and a zero-delta lane
+    skipped through one NaN-poisons into a false in-set (found in
+    review; regression-tested).  The smooth factory tightens the cap to
+    ``bailout / 2`` so skips also never cross the smoothing radius.
+    Belt and braces, stored radii are additionally zeroed wherever the
+    merged coefficients exceed f32 range.
     """
     n = len(z_re)
     min_level = max(1, BLA_MIN_SKIP.bit_length() - 1)
@@ -109,8 +126,7 @@ def build_bla_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
     b = np.ones_like(z)
     with np.errstate(over="ignore", invalid="ignore"):
         r = eps * np.abs(z)
-        if z_cap is not None:
-            r = np.where(np.abs(z) < z_cap, r, 0.0)
+        r = np.where(np.abs(z) < z_cap, r, 0.0)
     rows = max(1, levels - min_level + 1)
     width = max(1, (n + BLA_MIN_SKIP - 1) // BLA_MIN_SKIP)
     A_re = np.zeros((rows, width))
@@ -119,13 +135,23 @@ def build_bla_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
     B_im = np.zeros((rows, width))
     R2 = np.zeros((rows, width))
 
+    f32_max = float(np.finfo(np.float32).max)
+
     def store(row, a_l, b_l, r_l):
         k = len(a_l)
         A_re[row, :k] = a_l.real
         A_im[row, :k] = a_l.imag
         B_re[row, :k] = b_l.real
         B_im[row, :k] = b_l.imag
-        R2[row, :k] = np.square(np.maximum(r_l, 0.0))
+        # A coefficient the f32 upload would saturate must never be
+        # selectable (inf * 0 = NaN poisons zero-delta lanes).
+        fits = (np.isfinite(a_l) & np.isfinite(b_l)
+                & (np.abs(a_l.real) < f32_max)
+                & (np.abs(a_l.imag) < f32_max)
+                & (np.abs(b_l.real) < f32_max)
+                & (np.abs(b_l.imag) < f32_max))
+        R2[row, :k] = np.where(fits,
+                               np.square(np.maximum(r_l, 0.0)), 0.0)
 
     # a/b/r start as the per-position single-step maps (skip 1 — the
     # exact path handles single steps, quadratic term included); each
@@ -164,7 +190,7 @@ _TABLE_CACHE_MAX_BYTES = 256 * 1024 * 1024
 
 
 def _device_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
-                  eps: float, dtype, z_cap: float | None = None):
+                  eps: float, dtype, z_cap: float = 4.0):
     """Device-resident BLA table, LRU-cached like the orbit itself
     (perturbation._device_orbit): animation frames and repeat renders
     share the host orbit arrays, so identity + fingerprint keys work;
@@ -178,7 +204,11 @@ def _device_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
         _TABLE_CACHE.move_to_end(key)
         return hit[1]
     host = build_bla_table(z_re, z_im, q, eps=eps, z_cap=z_cap)
-    dev = tuple(jnp.asarray(t, dtype) for t in host)
+    # The cast may saturate extension-segment coefficients to inf; the
+    # builder zeroes those entries' radii (z_cap + f32-range gates), so
+    # they are never selected — the warning is noise.
+    with np.errstate(over="ignore"):
+        dev = tuple(jnp.asarray(t, dtype) for t in host)
     _TABLE_CACHE[key] = (fp, dev)
 
     def total_bytes():
@@ -387,10 +417,11 @@ def _bla_scan_smooth(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
     value at the first radius-``bailout`` crossing, radius-2 count for
     in-set classification) with tile-granular skips.
 
-    The table must be built with ``z_cap = bailout / 2`` (the factory
-    does): skips then never cross the smoothing radius, so every frozen
-    value is produced by exact steps — the nu payload keeps exact-scan
-    quality wherever a lane freezes.  Escape/glitch timing carries the
+    The table must be built with ``z_cap <= bailout / 2`` (the factory
+    uses ``min(4, bailout/2)`` — the 4.0 escape-segment guard is already
+    tighter for every standard bailout): skips then never cross the
+    smoothing radius, so every frozen value is produced by exact steps —
+    the nu payload keeps exact-scan quality wherever a lane freezes.  Escape/glitch timing carries the
     same boundary-detection contract as the integer scan.
     """
     dtype = jnp.result_type(dc_re)
@@ -504,11 +535,11 @@ def bla_smooth_scan_factory(z_re: np.ndarray, z_im: np.ndarray,
                             dtype, add_dc: bool = True,
                             eps: float = DEFAULT_BLA_EPS):
     """Smooth counterpart of :func:`bla_scan_factory` — returns a
-    ``scan_fn(zr, zi, dre, dim) -> (nu, glitched)``.  The table carries
-    the ``z_cap = bailout / 2`` guard so freezes always come from exact
-    steps."""
+    ``scan_fn(zr, zi, dre, dim) -> (nu, glitched)``.  The table's
+    ``z_cap`` guard (min of the 4.0 escape-segment cap and bailout/2)
+    keeps every freeze inside exact steps."""
     tabs = _device_table(z_re, z_im, dc_max, eps, dtype,
-                         z_cap=bailout / 2.0)
+                         z_cap=min(4.0, bailout / 2.0))
     levels = tabs[0].shape[0]
     orbit_len = len(z_re)
 

@@ -531,8 +531,13 @@ def _device_orbit(z_re: np.ndarray, z_im: np.ndarray):
     if hit is not None and hit[0] == fp:
         _DEVICE_ORBIT_CACHE.move_to_end(key)
         return hit[1], hit[2]
-    zr = jnp.asarray(z_re)
-    zi = jnp.asarray(z_im)
+    # The orbit's post-escape extension squares toward ~1e100; without
+    # x64 the f32 upload saturates those entries to inf BY DESIGN (the
+    # scans treat them as escaped/invalid) — the numpy cast warning is
+    # noise here.
+    with np.errstate(over="ignore"):
+        zr = jnp.asarray(z_re)
+        zi = jnp.asarray(z_im)
     _DEVICE_ORBIT_CACHE[key] = (fp, zr, zi)
 
     def total_bytes():

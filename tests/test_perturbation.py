@@ -486,13 +486,11 @@ def test_bla_skips_cover_inset_budget():
     cardioid: c = 3/8 + i*sqrt(3)/8, exact to arbitrary digits) must
     classify every pixel in-set through the full budget under BLA —
     skipping may never turn a bounded orbit into an escape."""
-    import math
+    from distributedmandelbrot_tpu.ops.bla import (BOND_POINT_IM,
+                                                    BOND_POINT_RE)
 
-    d = 40
-    num = math.isqrt(3 * 10 ** (2 * d)) * 125
-    s = str(num).zfill(d + 3)
-    im = s[:-(d + 3)] + "." + s[-(d + 3):]
-    spec = P.DeepTileSpec("0.375", im, 1e-15, width=32, height=32)
+    spec = P.DeepTileSpec(BOND_POINT_RE, BOND_POINT_IM, 1e-15,
+                          width=32, height=32)
     exact, _ = P.compute_counts_perturb(spec, 4000)
     fast, _ = P.compute_counts_perturb(spec, 4000, bla=True)
     assert np.array_equal(exact, fast)
@@ -529,13 +527,11 @@ def test_bla_smooth_matches_exact_on_inset_view():
     freeze-exactness guard — on a mixed view every BLA pixel whose nu
     differs from the exact scan differs by a small count shift, never a
     corrupted smoothing fraction (|dnu| bounded by the max skip)."""
-    import math
+    from distributedmandelbrot_tpu.ops.bla import (BOND_POINT_IM,
+                                                    BOND_POINT_RE)
 
-    d = 40
-    num = math.isqrt(3 * 10 ** (2 * d)) * 125
-    s = str(num).zfill(d + 3)
-    im = s[:-(d + 3)] + "." + s[-(d + 3):]
-    spec = P.DeepTileSpec("0.375", im, 1e-15, width=32, height=32)
+    spec = P.DeepTileSpec(BOND_POINT_RE, BOND_POINT_IM, 1e-15,
+                          width=32, height=32)
     exact, _ = P.compute_smooth_perturb(spec, 4000)
     fast, _ = P.compute_smooth_perturb(spec, 4000, bla=True)
     assert np.array_equal(exact, fast)
@@ -554,3 +550,66 @@ def test_bla_smooth_matches_exact_on_inset_view():
     both = (e != 0) & (f != 0)
     diff = np.abs(e[both] - f[both])
     assert np.percentile(diff, 95) <= 1.0, float(np.percentile(diff, 95))
+
+
+def test_bla_julia_mode():
+    """BLA in Julia mode (add_dc=False — the skip's B term rides a zero
+    dc): classification agreement with the exact scan on the deep Julia
+    view the exact-parity test uses."""
+    C = ("-0.8", "0.156")
+    spec = P.DeepTileSpec("1.5275031186435346", "-0.07591217835228786",
+                          1e-16, width=48, height=48)
+    exact, _ = P.compute_counts_perturb(spec, 1500, julia_c=C)
+    fast, _ = P.compute_counts_perturb(spec, 1500, julia_c=C, bla=True)
+    assert (((exact == 0) == (fast == 0)).mean()) >= 0.99
+    assert float((exact == fast).mean()) >= 0.99
+
+
+def test_bla_escape_straddling_segments_never_selectable():
+    """Regression (review finding): a reference orbit escaping near the
+    budget produces merge segments straddling the escape whose
+    coefficients saturate to inf in f32; with a positive radius, a
+    zero-delta lane skipped through one NaN-poisons into a false
+    in-set.  The builder must zero every such entry's radius, and an
+    exterior-center render whose orbit covers the budget must classify
+    its pixels escaped, identically to the exact scan."""
+    from distributedmandelbrot_tpu.ops.bla import (BLA_MIN_SKIP,
+                                                   build_bla_table)
+
+    # Exterior point: escape count ~40 at this c; budget just above it
+    # so the +12 orbit extension still covers the budget (the case where
+    # the orbit_len < max_iter glitch flag can NOT catch the bug).
+    c = 0.26
+    z = 0j
+    orbit = []
+    e = None
+    for k in range(1, 200):
+        z = z * z + c
+        orbit.append(z)
+        if e is None and abs(z) >= 2:
+            e = k
+            # true diverging extension, like _orbit_fixed's
+            for _ in range(12):
+                z = z * z + c
+                if abs(z) > 1e50:
+                    break
+                orbit.append(z)
+            break
+    orbit = np.array(orbit)
+    A_re, A_im, B_re, B_im, R2 = build_bla_table(
+        orbit.real.copy(), orbit.imag.copy(), dc_max=1e-13)
+    f32_max = float(np.finfo(np.float32).max)
+    huge = ((np.abs(A_re) >= f32_max) | (np.abs(A_im) >= f32_max)
+            | (np.abs(B_re) >= f32_max) | (np.abs(B_im) >= f32_max))
+    assert not (huge & (R2 > 0)).any(), \
+        "saturating coefficients with selectable radius"
+    # Segments touching escaped values (position >= e-1) are invalid.
+    first_bad = max(0, (e - 1)) // BLA_MIN_SKIP
+    assert (R2[0, first_bad:] == 0).all()
+
+    # End-to-end: exterior center, budget = escape + 3 <= orbit cover.
+    spec = P.DeepTileSpec("0.26", "0", 1e-13, width=16, height=16)
+    exact, _ = P.compute_counts_perturb(spec, e + 3)
+    fast, _ = P.compute_counts_perturb(spec, e + 3, bla=True)
+    assert np.array_equal(exact, fast)
+    assert (exact != 0).all()  # every pixel escaped — none falsely in-set
