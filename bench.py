@@ -439,8 +439,10 @@ def bench_deepslow(repeats: int) -> dict:
     1e-15 and budget 100000 — a parabolic window where every pixel runs
     the full orbit.  The classic pathological deep-zoom case; reports
     the exact perturbation scan and the opt-in BLA fast path
-    (ops/bla.py — approximate by documented contract, bit-identical on
-    THIS all-interior view, which the render asserts)."""
+    (ops/bla.py — approximate by documented contract; on TPU the two
+    are bit-identical on this all-interior view, pinned by tests, and
+    the artifact carries the measured ``bla_agreement`` rather than
+    asserting it, so a CPU-fallback sweep completes either way)."""
     from distributedmandelbrot_tpu.ops import (DeepTileSpec,
                                                compute_counts_perturb)
     from distributedmandelbrot_tpu.ops.bla import (BOND_POINT_IM,
@@ -460,14 +462,18 @@ def bench_deepslow(repeats: int) -> dict:
 
     t_exact = _time_chain(leg(False), max(1, repeats - 1))
     t_bla = _time_chain(leg(True), max(1, repeats - 1))
-    if not np.array_equal(outs[False], outs[True]):
-        raise AssertionError("BLA diverged on the all-interior bond view")
+    # Reported, not asserted: on TPU the two are bit-identical here
+    # (pinned by tests); a CPU-fallback run could flip a marginal
+    # boundary lane via FMA-contraction trajectory drift, which should
+    # show in the artifact rather than abort the sweep.
+    agree = float((outs[False] == outs[True]).mean())
     return {"metric": f"deep-slow parabolic bond point {side}^2 mi={mi} "
                       "span 1e-15 (exact perturbation vs opt-in BLA)",
             "value": round(_mpix(side * side, t_exact), 3),
             "unit": "Mpix/s",
             "bla_mpix_s": round(_mpix(side * side, t_bla), 3),
-            "bla_speedup": round(t_exact / t_bla, 1)}
+            "bla_speedup": round(t_exact / t_bla, 1),
+            "bla_agreement": round(agree, 6)}
 
 
 def bench_config5(repeats: int, segment: int) -> dict:

@@ -104,16 +104,19 @@ def build_bla_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
     / |A1|)``; the composed map is ``A = A2 A1, B = A2 B1 + B2``.
 
     ``z_cap`` zeroes base radii at orbit positions with ``|Z| >=
-    z_cap``.  The default (4.0) invalidates every segment touching
-    ESCAPED orbit values: a bounded reference stays |Z| <= 2, and the
-    post-escape extension squares toward ~1e100 — segments straddling
-    the escape would otherwise merge huge-but-positive-radius entries
-    whose coefficients saturate to inf in f32, and a zero-delta lane
-    skipped through one NaN-poisons into a false in-set (found in
-    review; regression-tested).  The smooth factory tightens the cap to
-    ``bailout / 2`` so skips also never cross the smoothing radius.
-    Belt and braces, stored radii are additionally zeroed wherever the
-    merged coefficients exceed f32 range.
+    z_cap``.  The default (4.0) invalidates every segment containing a
+    post-escape entry beyond the first one or two steps (a bounded
+    reference stays |Z| <= 2; after escape |Z| squares past 4 within a
+    couple of steps toward ~1e100): segments straddling the escape
+    would otherwise merge huge-but-positive-radius entries whose
+    coefficients saturate to inf in f32, and a zero-delta lane skipped
+    through one NaN-poisons into a false in-set (found in review;
+    regression-tested).  The earliest straddling positions that slip
+    the cap keep FINITE coefficients (late detection there is the
+    ordinary skip-boundary contract); additionally, stored radii are
+    zeroed wherever the merged coefficients exceed f32 range.  The
+    smooth factory passes ``min(4, bailout/2)`` so skips also never
+    cross the smoothing radius.
     """
     n = len(z_re)
     min_level = max(1, BLA_MIN_SKIP.bit_length() - 1)

@@ -502,7 +502,7 @@ def test_bla_table_composition():
     of the BLA_MIN_SKIP single-step linearizations they merge
     (dz' = A dz + B dc with the quadratic terms dropped)."""
     from distributedmandelbrot_tpu.ops.bla import (BLA_MIN_SKIP,
-                                                   build_bla_table)
+                                                    build_bla_table)
 
     rng = np.random.default_rng(7)
     n = 2 * BLA_MIN_SKIP
@@ -574,16 +574,19 @@ def test_bla_escape_straddling_segments_never_selectable():
     exterior-center render whose orbit covers the budget must classify
     its pixels escaped, identically to the exact scan."""
     from distributedmandelbrot_tpu.ops.bla import (BLA_MIN_SKIP,
-                                                   build_bla_table)
+                                                    build_bla_table)
 
-    # Exterior point: escape count ~40 at this c; budget just above it
-    # so the +12 orbit extension still covers the budget (the case where
-    # the orbit_len < max_iter glitch flag can NOT catch the bug).
-    c = 0.26
+    # Exterior point just past the cardioid cusp: escape count ~150
+    # (must exceed BLA_MIN_SKIP so the table actually stores levels and
+    # a stored segment straddles the escape — with a shorter orbit this
+    # test would be vacuous); budget just above the escape so the +12
+    # orbit extension still covers it (the case where the
+    # orbit_len < max_iter glitch flag can NOT catch the bug).
+    c = 0.2504
     z = 0j
     orbit = []
     e = None
-    for k in range(1, 200):
+    for k in range(1, 400):
         z = z * z + c
         orbit.append(z)
         if e is None and abs(z) >= 2:
@@ -596,19 +599,23 @@ def test_bla_escape_straddling_segments_never_selectable():
                 orbit.append(z)
             break
     orbit = np.array(orbit)
+    from distributedmandelbrot_tpu.ops.bla import BLA_MIN_SKIP as MS
+    assert e is not None and e > 2 * MS, f"test premise broken: e={e}"
     A_re, A_im, B_re, B_im, R2 = build_bla_table(
         orbit.real.copy(), orbit.imag.copy(), dc_max=1e-13)
+    assert (R2 > 0).any(), "test premise broken: no stored level valid"
     f32_max = float(np.finfo(np.float32).max)
     huge = ((np.abs(A_re) >= f32_max) | (np.abs(A_im) >= f32_max)
             | (np.abs(B_re) >= f32_max) | (np.abs(B_im) >= f32_max))
     assert not (huge & (R2 > 0)).any(), \
         "saturating coefficients with selectable radius"
-    # Segments touching escaped values (position >= e-1) are invalid.
-    first_bad = max(0, (e - 1)) // BLA_MIN_SKIP
+    # Segments containing a post-escape |Z| >= 4 entry are invalid:
+    # the first such entry appears within 2 steps of the escape.
+    first_bad = (e + 1) // BLA_MIN_SKIP
     assert (R2[0, first_bad:] == 0).all()
 
     # End-to-end: exterior center, budget = escape + 3 <= orbit cover.
-    spec = P.DeepTileSpec("0.26", "0", 1e-13, width=16, height=16)
+    spec = P.DeepTileSpec("0.2504", "0", 1e-13, width=16, height=16)
     exact, _ = P.compute_counts_perturb(spec, e + 3)
     fast, _ = P.compute_counts_perturb(spec, e + 3, bla=True)
     assert np.array_equal(exact, fast)
