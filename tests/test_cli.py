@@ -341,3 +341,16 @@ def test_animate_supersample(tmp_path):
     b = np.asarray(Image.open(plain_dir / "frame_0000.png"), float)
     assert a.shape == b.shape
     assert (a != b).any()  # the samples blended
+
+
+def test_render_supersample_deep(tmp_path):
+    """Supersampling composes with the perturbation deep path: subpixel
+    centers shift via Decimal (full precision preserved), each sample
+    rendering through compute_counts_perturb."""
+    out = tmp_path / "ssd.png"
+    rc = cli.main(["render", "--deep", "--supersample", "2",
+                   "--center", "-0.74529,0.11307", "--span", "1e-6",
+                   "--definition", "48", "--max-iter", "300",
+                   "--out", str(out)])
+    assert rc == 0
+    assert _png_size(out) == (48, 48)
