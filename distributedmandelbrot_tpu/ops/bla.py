@@ -424,8 +424,9 @@ def _bla_scan_smooth(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
     uses ``min(4, bailout/2)`` — the 4.0 escape-segment guard is already
     tighter for every standard bailout): skips then never cross the
     smoothing radius, so every frozen value is produced by exact steps —
-    the nu payload keeps exact-scan quality wherever a lane freezes.  Escape/glitch timing carries the
-    same boundary-detection contract as the integer scan.
+    the nu payload keeps exact-scan quality wherever a lane freezes.
+    Escape/glitch timing carries the same boundary-detection contract
+    as the integer scan.
     """
     dtype = jnp.result_type(dc_re)
     shape = dc_re.shape
