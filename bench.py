@@ -730,6 +730,10 @@ def main() -> int:
     parser.add_argument("--worst", action="store_true",
                         help="run only the worst-case boundary-view config "
                              "(raw vs shortcut per view)")
+    parser.add_argument("--deep-slow", action="store_true",
+                        help="run only the slow-dynamics deep-zoom config "
+                             "(parabolic bond point; exact perturbation vs "
+                             "the opt-in BLA fast path)")
     args = parser.parse_args()
     fell_back = _ensure_live_backend()
 
@@ -745,6 +749,10 @@ def main() -> int:
 
     if args.worst:
         emit(bench_worstcase(args.repeats))
+        return 0
+
+    if args.deep_slow:
+        emit(bench_deepslow(args.repeats))
         return 0
 
     if args.all:

@@ -48,6 +48,7 @@ ride an invalid skip.
 
 from __future__ import annotations
 
+import logging
 from collections import OrderedDict
 from functools import partial
 
@@ -57,6 +58,8 @@ import numpy as np
 from jax import lax
 
 from distributedmandelbrot_tpu.ops.perturbation import GLITCH_TOL
+
+logger = logging.getLogger("dmtpu.bla")
 
 # Relative size of the dropped quadratic term at the base level:
 # |dz| < eps * |Z| keeps |dz^2| below eps of the linear |2 Z dz|.
@@ -333,7 +336,7 @@ def _bla_scan(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
         return (ndzr, ndzi, act2, npass, glitched), None
 
     def exact_burst(state):
-        n0, dzr, dzi, act, cnt, glitched = state
+        n0, dzr, dzi, act, cnt, glitched, skipped = state
         zseg_r = lax.dynamic_slice_in_dim(z_re, n0, BLA_EXACT_BURST)
         zseg_i = lax.dynamic_slice_in_dim(z_im, n0, BLA_EXACT_BURST)
         idx = n0 + jnp.arange(BLA_EXACT_BURST, dtype=jnp.int32)
@@ -343,10 +346,11 @@ def _bla_scan(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
             (zseg_r, zseg_i, idx))
         newly = act & ~act2
         cnt = jnp.where(newly, n0 + npass, cnt)
-        return (n0 + BLA_EXACT_BURST, dzr, dzi, act2, cnt, glitched)
+        return (n0 + BLA_EXACT_BURST, dzr, dzi, act2, cnt, glitched,
+                skipped)
 
     def body(state):
-        n, dzr, dzi, act, cnt, glitched = state
+        n, dzr, dzi, act, cnt, glitched, skipped = state
         zr = z_re[n]
         zi = z_im[n]
         # Escape/glitch test of z_{n+1} = Z[n] + dz_{n+1} (re-testing a
@@ -364,30 +368,34 @@ def _bla_scan(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
         l_sel = _select_skip(n, max_dz2, R2, levels, orbit_len)
 
         def apply_skip(s):
-            n, dzr, dzi, act, cnt, glitched = s
-            n, bla_r, bla_i = _apply_skip_map(l_sel, n, tabs, dzr, dzi,
-                                              dc_re, dc_im, add_dc)
-            return (n, bla_r, bla_i, act, cnt, glitched)
+            n, dzr, dzi, act, cnt, glitched, skipped = s
+            n2, bla_r, bla_i = _apply_skip_map(l_sel, n, tabs, dzr, dzi,
+                                               dc_re, dc_im, add_dc)
+            # n2 - n: the advance _apply_skip_map actually made — the
+            # single source of truth for the skip length.
+            return (n2, bla_r, bla_i, act, cnt, glitched,
+                    skipped + (n2 - n))
 
         return lax.cond(l_sel > 0, apply_skip, exact_burst,
-                        (n, dzr, dzi, act, cnt, glitched))
+                        (n, dzr, dzi, act, cnt, glitched, skipped))
 
     def cond(state):
-        n, _, _, act, _, _ = state
+        n, _, _, act = state[:4]
         return (n < orbit_len) & jnp.any(act)
 
     init = (jnp.asarray(0, jnp.int32), dc_re.astype(dtype),
             dc_im.astype(dtype), jnp.ones(shape, jnp.bool_),
             jnp.full(shape, orbit_len, jnp.int32),
-            jnp.zeros(shape, jnp.bool_))
-    n, dzr, dzi, act, cnt, glitched = lax.while_loop(cond, body, init)
+            jnp.zeros(shape, jnp.bool_), jnp.asarray(0, jnp.int32))
+    (n, dzr, dzi, act, cnt, glitched, skipped) = \
+        lax.while_loop(cond, body, init)
     # Lanes still active when the loop left: position n tests passed —
     # n == orbit_len normally; an early exit (all inactive) leaves their
     # cnt at the orbit_len sentinel, same thing.
     if orbit_len < max_iter:
         glitched = glitched | act
     counts = jnp.where(cnt >= max_iter, 0, jnp.maximum(cnt, 1))
-    return counts, glitched, act
+    return counts, glitched, act, skipped
 
 
 def bla_scan_factory(z_re: np.ndarray, z_im: np.ndarray, dc_max: float, *,
@@ -402,9 +410,12 @@ def bla_scan_factory(z_re: np.ndarray, z_im: np.ndarray, dc_max: float, *,
     orbit_len = len(z_re)
 
     def scan_fn(zr, zi, dre, dim):
-        counts, glitched, _ = _bla_scan(
+        counts, glitched, _, skipped = _bla_scan(
             zr, zi, tabs, dre, dim, orbit_len=orbit_len,
             max_iter=max_iter, levels=levels, add_dc=add_dc)
+        if logger.isEnabledFor(logging.DEBUG):  # one sync fetch/chunk
+            logger.debug("BLA skipped %d of %d orbit steps on this chunk",
+                         int(skipped), orbit_len)
         return counts, glitched
 
     return scan_fn
@@ -462,8 +473,8 @@ def _bla_scan_smooth(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
         return (ndzr, ndzi, act_b, nb, act2, n2, fzr, fzi, glitched), None
 
     def exact_burst(state):
-        (n0, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi,
-         glitched) = state
+        (n0, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi, glitched,
+         skipped) = state
         zseg_r = lax.dynamic_slice_in_dim(z_re, n0, BLA_EXACT_BURST)
         zseg_i = lax.dynamic_slice_in_dim(z_im, n0, BLA_EXACT_BURST)
         idx = n0 + jnp.arange(BLA_EXACT_BURST, dtype=jnp.int32)
@@ -476,11 +487,11 @@ def _bla_scan_smooth(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
         cnt_b = jnp.where(act_b & ~nact_b, n0 + nb, cnt_b)
         cnt2 = jnp.where(act2 & ~nact2, n0 + n2, cnt2)
         return (n0 + BLA_EXACT_BURST, dzr, dzi, nact_b, cnt_b, nact2,
-                cnt2, fzr, fzi, glitched)
+                cnt2, fzr, fzi, glitched, skipped)
 
     def body(state):
-        (n, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi,
-         glitched) = state
+        (n, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi, glitched,
+         skipped) = state
         zr = z_re[n]
         zi = z_im[n]
         fr = zr + dzr
@@ -502,12 +513,12 @@ def _bla_scan_smooth(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
         l_sel = _select_skip(n, max_dz2, R2, levels, orbit_len)
 
         def apply_skip(s):
-            (n, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi,
-             glitched) = s
-            n, bla_r, bla_i = _apply_skip_map(l_sel, n, tabs, dzr, dzi,
-                                              dc_re, dc_im, add_dc)
-            return (n, bla_r, bla_i, act_b, cnt_b, act2, cnt2, fzr, fzi,
-                    glitched)
+            (n, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi, glitched,
+             skipped) = s
+            n2_, bla_r, bla_i = _apply_skip_map(l_sel, n, tabs, dzr, dzi,
+                                                dc_re, dc_im, add_dc)
+            return (n2_, bla_r, bla_i, act_b, cnt_b, act2, cnt2, fzr,
+                    fzi, glitched, skipped + (n2_ - n))
 
         return lax.cond(l_sel > 0, apply_skip, exact_burst, state)
 
@@ -520,9 +531,9 @@ def _bla_scan_smooth(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
     init = (jnp.asarray(0, jnp.int32), dc_re.astype(dtype),
             dc_im.astype(dtype), ones, sent, ones, sent,
             jnp.full(shape, bailout, dtype), jnp.zeros(shape, dtype),
-            jnp.zeros(shape, jnp.bool_))
-    (n, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi, glitched) = \
-        lax.while_loop(cond, body, init)
+            jnp.zeros(shape, jnp.bool_), jnp.asarray(0, jnp.int32))
+    (n, dzr, dzi, act_b, cnt_b, act2, cnt2, fzr, fzi, glitched,
+     skipped) = lax.while_loop(cond, body, init)
     if orbit_len < max_iter:
         glitched = glitched | act2
     # Identical epilogue to _perturb_scan_smooth, with the positional
@@ -531,7 +542,7 @@ def _bla_scan_smooth(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
     log_ratio = jnp.log(mag2) / jnp.asarray(2.0 * np.log(bailout), dtype)
     nu = (cnt_b + 1).astype(dtype) - jnp.log2(log_ratio)
     nu = jnp.where(cnt2 >= max_iter, jnp.zeros((), dtype), nu)
-    return nu, glitched
+    return nu, glitched, skipped
 
 
 def bla_smooth_scan_factory(z_re: np.ndarray, z_im: np.ndarray,
@@ -548,9 +559,13 @@ def bla_smooth_scan_factory(z_re: np.ndarray, z_im: np.ndarray,
     orbit_len = len(z_re)
 
     def scan_fn(zr, zi, dre, dim):
-        return _bla_scan_smooth(zr, zi, tabs, dre, dim,
-                                orbit_len=orbit_len, max_iter=max_iter,
-                                levels=levels, bailout=float(bailout),
-                                add_dc=add_dc)
+        nu, glitched, skipped = _bla_scan_smooth(
+            zr, zi, tabs, dre, dim, orbit_len=orbit_len,
+            max_iter=max_iter, levels=levels, bailout=float(bailout),
+            add_dc=add_dc)
+        if logger.isEnabledFor(logging.DEBUG):  # one sync fetch/chunk
+            logger.debug("BLA skipped %d of %d orbit steps on this chunk",
+                         int(skipped), orbit_len)
+        return nu, glitched
 
     return scan_fn
