@@ -591,7 +591,7 @@ def test_packed_tiles_match_single_tile_kernel(cycle_check):
              TileSpec(1.5, 1.5, 0.1, 0.1, width=tile, height=tile),
              TileSpec(-0.8, 0.1, 0.2, 0.2, width=tile, height=tile)]
     mis = [300, 150, 80, 260]
-    for n in (2, 3, 4):
+    for n in (1, 2, 3, 4):
         got = compute_tiles_packed_pallas(specs[:n], mis[:n], block_h=32,
                                           interpret=True,
                                           cycle_check=cycle_check)
