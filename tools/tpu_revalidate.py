@@ -13,6 +13,8 @@ surface in dependency order and stops at the first failure:
 4b. production-shape sharded Pallas: 4096^2 tiles, mixed budgets, Mpix/s
     within 15% of the single-tile rate
 5. perturbation scan on device (moderate zoom, parity vs XLA f64)
+5b. BLA fast path on hardware (bench_deepslow: bond-point view,
+    bit-identical and faster than the exact scan)
 6. farm e2e with the auto (Pallas) backend at production chunk size
 7. bench headline (prints the JSON line)
 7b. bench worst-case boundary views (raw vs shortcut per view)
@@ -184,6 +186,18 @@ def main() -> int:
     print(f"perturb 256^2 mi=2000: {time.time()-t0:.2f}s, "
           f"{ng} glitch-fixed, {len(np.unique(counts))} levels")
     assert len(np.unique(counts)) > 10
+
+    step("5b. BLA fast path on hardware (bench_deepslow)")
+    # The ONE copy of the bond-point benchmark (view, budget, timing
+    # methodology) lives in bench.py; this step just runs it and turns
+    # its reported fields into hard assertions (safe here: the script
+    # aborts unless the backend is TPU, where identity is pinned).
+    from bench import bench_deepslow
+    ds = bench_deepslow(2)
+    print(f"bond: exact {ds['value']} Mpix/s, bla {ds['bla_mpix_s']} "
+          f"(x{ds['bla_speedup']}), agreement {ds['bla_agreement']}")
+    assert ds["bla_agreement"] == 1.0, "BLA diverged on the bond view"
+    assert ds["bla_speedup"] > 1.0, "BLA slower on its showcase view"
 
     step("6. farm e2e (auto backend, 4096^2)")
     from distributedmandelbrot_tpu.cli import parse_level_settings
