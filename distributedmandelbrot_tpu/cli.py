@@ -198,6 +198,24 @@ def _auto_deep(span: float, cx: float, cy: float, definition: int,
         and not _span_f32_resolvable(cx, cy, span, definition))
 
 
+def _resolve_deep(deep: bool | None, span: float, cx: float, cy: float,
+                  definition: int, np_dtype,
+                  family: tuple[int, bool] | None) -> bool:
+    """The ONE resolution of the direct-vs-perturbation routing decision:
+    families have no perturbation path, an explicit ``deep`` wins, and
+    ``None`` auto-selects via :func:`_auto_deep`.  _render_view's
+    dispatch, the packed supersample fast-path predicate, and cmd_render's
+    --bla applicability guard all resolve here, so none of them can
+    desynchronize from the path actually rendered (round-3 advisor: the
+    guard compared the raw span threshold and wrongly rejected --bla on
+    f32-unresolvable spans that _auto_deep routes to perturbation)."""
+    if family is not None:
+        return False
+    if deep is None:
+        return _auto_deep(span, cx, cy, definition, np_dtype)
+    return bool(deep)
+
+
 def _warn_if_deep_all_inset(plane, max_iter: int, span: float) -> None:
     """A deep view where EVERY pixel classifies in-set (value 0) is
     almost always an under-budgeted render, not a discovery: escape
@@ -247,15 +265,15 @@ def _render_supersampled(c_re: str, c_im: str, span: float, definition: int,
 
     kw = render_kwargs
     if (not kw.get("smooth") and not kw.get("no_pallas")
-            and kw.get("np_dtype") == np.float32
-            and kw.get("deep") is not True):
+            and kw.get("np_dtype") == np.float32):
         # Packed fast path (integer f32, direct): one kernel pass for
         # all samples.  Falls through to the sequential path when
-        # pallas is unavailable or declines the shape/budget.
+        # pallas is unavailable or declines the shape/budget.  Routing
+        # MUST agree with _render_view's — both resolve via
+        # _resolve_deep, the single copy of the decision.
         cx, cy = float(c_re), float(c_im)
-        if not (kw.get("deep") is None and _auto_deep(
-                span, cx, cy, definition, np.float32)) \
-                or kw.get("family") is not None:
+        if not _resolve_deep(kw.get("deep"), span, cx, cy, definition,
+                             np.float32, kw.get("family")):
             power, burning = kw.get("family") or (2, False)
             jc_pair = kw.get("julia_c")
             jc = (complex(float(jc_pair[0]), float(jc_pair[1]))
@@ -349,9 +367,8 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
         return value_to_rgba(values.reshape(spec.height, spec.width),
                              colormap=colormap)
 
-    if deep is None:
-        deep = _auto_deep(span, float(c_re), float(c_im), definition,
-                          np_dtype)
+    deep = _resolve_deep(deep, span, float(c_re), float(c_im), definition,
+                         np_dtype, family)
     if deep:
         from distributedmandelbrot_tpu.ops import (DeepTileSpec,
                                                    compute_smooth_perturb)
@@ -821,12 +838,6 @@ def cmd_render(argv: Sequence[str]) -> int:
     if args.normalize and not args.smooth:
         raise SystemExit("--normalize applies to --smooth renders only "
                          "(integer output is already quantized upstream)")
-    if args.bla and not args.deep and args.span >= DEEP_SPAN_THRESHOLD:
-        raise SystemExit("--bla applies to perturbation deep renders "
-                         "(--deep, or a span below "
-                         f"{DEEP_SPAN_THRESHOLD:g}); this span renders "
-                         "on the direct kernels, which have no orbit "
-                         "to skip")
     if family is not None:
         if args.deep:
             raise SystemExit(f"--fractal {args.fractal} has no perturbation "
@@ -840,13 +851,27 @@ def cmd_render(argv: Sequence[str]) -> int:
     c_re, c_im = (s.strip() for s in center_str.split(","))
     julia_c = tuple(s.strip() for s in args.c.split(",")) \
         if args.fractal == "julia" else None
+    np_dtype = _resolve_dtype(args, center=(float(c_re), float(c_im)),
+                              can_perturb=family is None)
+    # --bla applicability follows the ACTUAL routing decision (round-3
+    # advisor: gating on the raw span threshold wrongly rejected views
+    # that _auto_deep routes to f32 perturbation, e.g. span 1e-8 at
+    # high definition).  Resolved ONCE here and passed down, so the
+    # guard and the render agree by construction (same pattern as
+    # cmd_animate's per-frame resolution).
+    deep = _resolve_deep(True if args.deep else None, args.span,
+                         float(c_re), float(c_im), args.definition,
+                         np_dtype, family)
+    if args.bla and not deep:
+        raise SystemExit("--bla applies to perturbation deep renders "
+                         "(--deep, or a view the auto-selector routes "
+                         "to perturbation); this view renders on the "
+                         "direct kernels, which have no orbit to skip")
     rgba = _render_view(c_re, c_im, args.span, args.definition,
                         args.max_iter, smooth=args.smooth,
-                        np_dtype=_resolve_dtype(
-                            args, center=(float(c_re), float(c_im)),
-                            can_perturb=family is None),
+                        np_dtype=np_dtype,
                         colormap=args.colormap,
-                        deep=True if args.deep else None,
+                        deep=deep,
                         julia_c=julia_c, family=family,
                         no_pallas=args.no_pallas,
                         normalize=args.normalize,
@@ -956,9 +981,8 @@ def cmd_animate(argv: Sequence[str]) -> int:
         max_iter = max(1, round(args.max_iter * mi_ratio ** f))
         # The decision is made once and passed down, so the progress
         # label can never disagree with the path actually rendered.
-        deep = family is None and _auto_deep(span, float(c_re),
-                                             float(c_im), args.definition,
-                                             np_dtype)
+        deep = _resolve_deep(None, span, float(c_re), float(c_im),
+                             args.definition, np_dtype, family)
         rgba = _render_view(c_re, c_im, span, args.definition,
                             max_iter, smooth=args.smooth,
                             np_dtype=np_dtype, colormap=args.colormap,

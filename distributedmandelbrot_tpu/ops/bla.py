@@ -204,7 +204,13 @@ def _device_table(z_re: np.ndarray, z_im: np.ndarray, dc_max: float,
     q = float(2.0 ** np.ceil(np.log2(max(dc_max, 1e-300))))
     key = (id(z_re), id(z_im), len(z_re), q, eps, np.dtype(dtype).str,
            z_cap)
-    fp = (float(z_re[0]), float(z_re[-1]), float(z_im[-1]))
+    # Fingerprint matches _device_orbit's guard strength and adds a
+    # mid-orbit sample: an id()-reuse collision after upstream lru
+    # eviction must not serve a stale table for a different orbit that
+    # happens to share length and endpoints (round-3 advisor).
+    mid = len(z_re) // 2
+    fp = (float(z_re[0]), float(z_im[0]), float(z_re[-1]),
+          float(z_im[-1]), float(z_re[mid]), float(z_im[mid]))
     hit = _TABLE_CACHE.get(key)
     if hit is not None and hit[0] == fp:
         _TABLE_CACHE.move_to_end(key)

@@ -77,6 +77,20 @@ MAX_UNROLL = 64
 # test-value terms, negligible area), never correctness.
 INTERIOR_MARGIN = {np.dtype(np.float32): 1e-5, np.dtype(np.float64): 1e-12}
 
+
+def _validated_margin(dtype) -> float:
+    """The one margin policy shared by every interior test.  Only dtypes in
+    :data:`INTERIOR_MARGIN` are validated; anything narrower (f16/bf16) gets
+    a loud error rather than a margin below one ulp of the test polynomial
+    that could silently misclassify an exterior point as interior."""
+    try:
+        return INTERIOR_MARGIN[np.dtype(dtype)]
+    except KeyError:
+        raise ValueError(
+            f"no validated interior margin for dtype {np.dtype(dtype)}; "
+            "pass margin= explicitly (f32/f64 are supported by default)"
+        ) from None
+
 # Budgets at/above this enable the Brent cycle probe by default (see
 # escape_loop): deep budgets are where in-set pixels missed by the closed
 # forms dominate; shallow budgets lose more to the probe's per-step
@@ -129,16 +143,7 @@ def mandelbrot_interior(c_real, c_imag, margin: float | None = None):
     """
     dtype = jnp.result_type(c_real)
     if margin is None:
-        try:
-            margin = INTERIOR_MARGIN[np.dtype(dtype)]
-        except KeyError:
-            # The strict-by-margin guarantee is only validated for the
-            # dtypes in the table; for anything narrower (f16/bf16) the
-            # f32 margin would be below one ulp of the test polynomials
-            # and could misclassify — demand an explicit margin instead.
-            raise ValueError(
-                f"no validated interior margin for dtype {dtype}; pass "
-                "margin= explicitly (f32/f64 are supported by default)")
+        margin = _validated_margin(dtype)
     m = jnp.asarray(margin, dtype)
     y2 = c_imag * c_imag
     xm = c_real - jnp.asarray(0.25, dtype)
@@ -173,7 +178,7 @@ def multibrot_interior(c_real, c_imag, power: int,
     is two multiplies and an add — rounding is a couple of ulps)."""
     dtype = jnp.result_type(c_real)
     if margin is None:
-        margin = INTERIOR_MARGIN.get(np.dtype(dtype), 1e-5)
+        margin = _validated_margin(dtype)
     r = multibrot_interior_radius(power)
     lim = jnp.asarray(r * r - margin, dtype)
     return c_real * c_real + c_imag * c_imag < lim

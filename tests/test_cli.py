@@ -71,6 +71,27 @@ def test_render_deep_julia(tmp_path):
     assert _png_size(out) == (64, 64)
 
 
+def test_render_bla_guard_follows_routing(tmp_path):
+    """--bla applicability gates on the ACTUAL routing decision, not the
+    raw span threshold (round-3 advisor): a span above the f64 deep
+    threshold that _auto_deep still routes to f32 perturbation (pitch
+    below f32 resolution) legitimately accepts --bla; a genuinely
+    shallow direct-kernel view still rejects it loudly."""
+    out = tmp_path / "bla.png"
+    # span 1e-8 at 64^2 near |c|~0.75: pitch ~1.6e-10 << f32 ulp ~9e-8,
+    # so the f32 render auto-routes to perturbation — --bla applies.
+    rc = cli.main(["render", "--bla", "--dtype", "f32",
+                   "--span", "1e-8", "--definition", "64",
+                   "--max-iter", "128",
+                   "--center", "-0.74529,0.11307", "--out", str(out)])
+    assert rc == 0
+    assert _png_size(out) == (64, 64)
+    with pytest.raises(SystemExit, match="direct kernels"):
+        cli.main(["render", "--bla", "--span", "0.01", "--definition",
+                  "64", "--max-iter", "64",
+                  "--center", "-0.748,0.09", "--out", str(out)])
+
+
 def test_worker_backend_validation():
     with pytest.raises(SystemExit):
         cli.main(["worker", "--backend", "pallas", "--dtype", "f64"])

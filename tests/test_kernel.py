@@ -416,3 +416,24 @@ def test_interior_margin_rejects_unvalidated_dtypes():
         mandelbrot_interior(c, c)
     # An explicit margin opts in.
     assert bool(mandelbrot_interior(c, c, margin=1e-2).any())
+
+
+def test_multibrot_interior_shares_margin_policy():
+    """multibrot_interior follows the same one-policy margin resolution as
+    mandelbrot_interior: unvalidated dtypes raise (round-3 verdict — the
+    old ``.get(dtype, 1e-5)`` fallback silently broke the strict-by-margin
+    guarantee for bf16/f16 callers), explicit margins opt in."""
+    import jax.numpy as jnp
+    import pytest
+
+    from distributedmandelbrot_tpu.ops.escape_time import multibrot_interior
+
+    for dt in (jnp.float16, jnp.bfloat16):
+        c = jnp.zeros((4, 4), dt)
+        with pytest.raises(ValueError, match="no validated interior margin"):
+            multibrot_interior(c, c, power=3)
+    c = jnp.zeros((4, 4), jnp.float16)
+    assert bool(multibrot_interior(c, c, power=3, margin=1e-2).any())
+    # Validated dtypes still classify the origin interior by default.
+    c32 = jnp.zeros((4, 4), jnp.float32)
+    assert bool(multibrot_interior(c32, c32, power=3).all())
