@@ -1,0 +1,450 @@
+"""Live-lane compaction for the Pallas escape kernel.
+
+The round-3 hardware audit measured the escape loop at ~95 Giter/s in
+small or mixed early-exit calls but 225-250 Giter/s in big uniformly
+deep calls, and recorded two negative results (depth-sorting a mixed
+call's program order, probe-stride tuning) that localize the gap to the
+*shape of the work*, not its schedule: on boundary views the block-
+granular early exit leaves each surviving block running a full
+(block_h, block_w) vector for a handful of live lanes — measured 6.9x
+the ideal per-pixel iteration work on the worst-case filament view.
+
+This module implements the structural fix, in two phases — and on the
+current bench stack it is a MEASURED NEGATIVE, shipped opt-in only: the
+resume kernel hits 520 Giter/s (2.3x the plain kernel's best big-call
+rate, chained-delta timing), but XLA:TPU's element-granular lowering of
+the compaction glue (gather/scatter/sort at 0.6-2.7 GB/s) costs more
+than the compute it saves.  See the ``_COMPACT_OPTED_IN`` note and
+ROUND4_NOTES.md "Live-lane compaction" for the full measurement table.
+The design:
+
+1. **Phase 1** (``_state_batch_kernel``): the normal block kernel, capped
+   at ``phase_budget`` iterations (the shallow majority of a mixed view
+   escapes here), which instead of the uint8 plane emits the raw
+   per-pixel machine state — ``(c, z, n, act)``.
+2. **Compaction + resume rounds**: surviving lanes from ALL blocks and
+   tiles of the batch are gathered into one dense buffer (XLA cumsum +
+   gather — no host sync, shapes static), and ``_resume_block_kernel``
+   continues them in ``seg_steps``-iteration rounds, re-compacting
+   between rounds so the buffer's live prefix shrinks as stragglers
+   retire.  Every block of every round is fully live — exactly the
+   uniform-deep big-call regime the audit measured at 225-250 Giter/s —
+   and the executed iteration count approaches the per-pixel ideal the
+   CUDA reference gets from per-pixel early return
+   (``DistributedMandelbrotWorkerCUDA.py:62-67``).
+
+**Bit-identity argument** (tested, not just argued): phase 1, the resume
+rounds, and the plain kernel share ONE loop body
+(``pallas_escape._run_seg_loop``) whose segment boundaries land on
+``1 + k*unroll`` regardless of which call executes them — ``phase_budget``
+and ``seg_steps`` are unroll-aligned, so a resumed lane executes the
+identical arithmetic sequence, and the final count classification
+(``n >= budget -> 0``) is insensitive to the segment-granular overshoot
+and retirement the split introduces (an unescaped lane's count is
+already past its budget; an escaping lane's mask froze at the exact
+step).  The uint8 scaling is the same integer expression, applied
+per-lane at the end.
+
+**Static shapes, no host sync**: the compact buffer's capacity is a
+static fraction of the batch (``COMPACT_CAPACITY_FRAC``).  If a view
+leaves more survivors than that — deep near-uniform views, which are
+exactly the ones already in the fast big-call regime — the overflow
+lanes resume IN PLACE over the original grid under a ``lax.cond`` that
+costs nothing when it doesn't fire.  Output is correct in both regimes;
+the capacity only bounds how much gets accelerated.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributedmandelbrot_tpu.ops.escape_time import resolve_cycle_check
+from distributedmandelbrot_tpu.ops.pallas_escape import (
+    DEFAULT_BLOCK_H, DEFAULT_BLOCK_W, DEFAULT_UNROLL, PallasUnsupported,
+    _interior_init, _load_block_coords, _pallas, _run_seg_loop, fit_blocks)
+
+# Phase-1 budget: how deep the full grid runs before survivors compact.
+# From the measured escape-depth distributions (ROUND4_NOTES.md): at 256
+# iterations the filament worst-case view retains 4.7% of lanes, the
+# hard seahorse-head view 17.9% — past the knee of the depth CDF, while
+# costing only ~13% of a 2000-budget view's ideal work.  Must be a
+# multiple of the kernel unroll (segment alignment, see module doc).
+PHASE1_BUDGET = 256
+
+# Resume-round length.  Shorter rounds re-compact more often (tighter
+# straggler control) but pay the per-round XLA glue more often; 256
+# matches the phase-1 knee spacing of the measured CDFs.
+RESUME_SEG = 256
+
+# Compact-buffer capacity as a fraction of the batch's pixels, aligned
+# up to a whole (32, 128) block grid.  1/4 covers every measured
+# boundary view's survivor fraction at PHASE1_BUDGET with 40% headroom;
+# overflowing views resume in place (see module doc).
+COMPACT_CAPACITY_FRAC = 4  # denominator
+
+_LANE = 128          # compact buffer row width (f32 vreg lane count)
+_RESUME_BLOCK_H = 32 # compact buffer block rows (VMEM-friendly, divides
+                     # every capacity because capacity aligns to 4096)
+
+
+def _state_batch_kernel(params_ref, mrd_ref, cr_ref, ci_ref, zr_out, zi_out,
+                        n_out, act_out, zr_s, zi_s, act_s, n_s, *,
+                        phase_budget: int, unroll: int, block_h: int,
+                        block_w: int, interior_check: bool, julia: bool,
+                        power: int, burning: bool):
+    """Phase 1: the batch-grid escape kernel, capped at ``phase_budget``
+    iterations, emitting raw state planes instead of uint8.
+
+    The c planes are emitted from the kernel's OWN grid values (not
+    regenerated on the XLA side) so the resume arithmetic consumes
+    bit-identical coordinates by construction.  ``act`` is zeroed for
+    tiles whose entire budget fits in phase 1 — they are complete, and
+    their unescaped lanes already hold ``n >= budget``."""
+    pl, _ = _pallas()
+    t, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    shape = zr_s.shape
+    g_real, g_imag, c_real, c_imag, mrd = _load_block_coords(
+        params_ref, mrd_ref, t, i, j, shape, block_h, block_w, julia)
+    dyn_steps = mrd - 1
+
+    zr_s[:] = g_real
+    zi_s[:] = g_imag
+    act0, n_sat, live0 = _interior_init(
+        c_real, c_imag, dyn_steps, shape, interior_check and not julia,
+        power=power, burning=burning)
+    act_s[:] = act0
+    n_s[:] = n_sat
+
+    _run_seg_loop(zr_s, zi_s, act_s, n_s, (), c_real, c_imag, live0,
+                  cond_cap=jnp.minimum(dyn_steps, phase_budget),
+                  sat_steps=dyn_steps, unroll=unroll, cycle_check=False,
+                  power=power, burning=burning)
+
+    cr_ref[0] = c_real
+    ci_ref[0] = c_imag
+    zr_out[0] = zr_s[:]
+    zi_out[0] = zi_s[:]
+    n_out[0] = n_s[:]
+    # Tiles completed inside phase 1 contribute no survivors.
+    act_out[0] = act_s[:] * (dyn_steps > phase_budget).astype(jnp.int32)
+
+
+def _pallas_escape_state(params, mrds, *, k: int, height: int, width: int,
+                         phase_budget: int, unroll: int, block_h: int,
+                         block_w: int, interior_check: bool, julia: bool,
+                         power: int, burning: bool, interpret: bool):
+    """Dispatch phase 1 over a k-tile batch -> six (k, H, W) state planes
+    ``(c_re, c_im, z_re, z_im, n, act)``."""
+    pl, pltpu = _pallas()
+    kernel = partial(_state_batch_kernel, phase_budget=phase_budget,
+                     unroll=unroll, block_h=block_h, block_w=block_w,
+                     interior_check=interior_check, julia=julia,
+                     power=power, burning=burning)
+    f32 = jnp.float32
+    i32 = jnp.int32
+    out_block = pl.BlockSpec((1, block_h, block_w), lambda t, i, j: (t, i, j))
+    return pl.pallas_call(
+        kernel,
+        grid=(k, height // block_h, width // block_w),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[out_block] * 6,
+        out_shape=[jax.ShapeDtypeStruct((k, height, width), f32)] * 4
+        + [jax.ShapeDtypeStruct((k, height, width), i32)] * 2,
+        scratch_shapes=[pltpu.VMEM((block_h, block_w), f32),
+                        pltpu.VMEM((block_h, block_w), f32),
+                        pltpu.VMEM((block_h, block_w), i32),
+                        pltpu.VMEM((block_h, block_w), i32)],
+        interpret=interpret,
+    )(params, mrds)
+
+
+def _resume_block_kernel(it0_ref, dyn_ref, cr_ref, ci_ref, zr_in, zi_in,
+                         n_in, act_in, zr_out, zi_out, n_out, act_out,
+                         zr_s, zi_s, act_s, n_s, *, seg_steps: int,
+                         unroll: int, power: int, burning: bool):
+    """One resume round over one block of lane-state planes: continue the
+    shared loop body from iteration ``it0`` for at most ``seg_steps``
+    more iterations (both unroll-aligned).  Geometry-free — lanes carry
+    their own ``c`` and per-lane budget, so one kernel serves the dense
+    compact buffer, mixed-budget batches, and the overflow in-place
+    resume."""
+    it0 = it0_ref[0, 0]
+    act0 = act_in[...]
+    zr_s[:] = zr_in[...]
+    zi_s[:] = zi_in[...]
+    act_s[:] = act0
+    n_s[:] = n_in[...]
+    c_real = cr_ref[...]
+    c_imag = ci_ref[...]
+
+    _run_seg_loop(zr_s, zi_s, act_s, n_s, (), c_real, c_imag,
+                  jnp.sum(act0, dtype=jnp.int32),
+                  cond_cap=it0 + (seg_steps - 1), sat_steps=it0,
+                  unroll=unroll, cycle_check=False, power=power,
+                  burning=burning, it0=it0, dyn_ref=dyn_ref)
+
+    zr_out[...] = zr_s[:]
+    zi_out[...] = zi_s[:]
+    n_out[...] = n_s[:]
+    act_out[...] = act_s[:]
+
+
+def _pallas_resume(it0, dyn, cr, ci, zr, zi, n, act, *, seg_steps: int,
+                   unroll: int, block_h: int, power: int, burning: bool,
+                   interpret: bool):
+    """One resume round over (R, 128) lane-state planes -> updated
+    ``(z_re, z_im, n, act)``."""
+    pl, pltpu = _pallas()
+    rows, width = zr.shape
+    kernel = partial(_resume_block_kernel, seg_steps=seg_steps,
+                     unroll=unroll, power=power, burning=burning)
+    f32 = jnp.float32
+    i32 = jnp.int32
+    block = pl.BlockSpec((block_h, width), lambda i: (i, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(rows // block_h,),
+        in_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM)] + [block] * 7,
+        out_specs=[block] * 4,
+        out_shape=[jax.ShapeDtypeStruct((rows, width), f32)] * 2
+        + [jax.ShapeDtypeStruct((rows, width), i32)] * 2,
+        scratch_shapes=[pltpu.VMEM((block_h, width), f32),
+                        pltpu.VMEM((block_h, width), f32),
+                        pltpu.VMEM((block_h, width), i32),
+                        pltpu.VMEM((block_h, width), i32)],
+        interpret=interpret,
+    )(it0, dyn, cr, ci, zr, zi, n, act)
+
+
+def _gather_lanes(valid, take, fills, *arrays):
+    """Gather ``arrays`` at lane indices ``take`` where ``valid``, else
+    the per-array fill — the one copy of the compact/re-compact gather."""
+    return [jnp.where(valid, a.reshape(-1)[take], f)
+            for a, f in zip(arrays, fills)]
+
+
+@partial(jax.jit, static_argnames=(
+    "k", "height", "width", "max_iter", "cap_lanes", "phase_budget",
+    "seg_steps", "block_h", "block_w", "unroll", "clamp", "interior_check",
+    "julia", "power", "burning", "interpret"))
+def _compact_escape(params, mrds, *, k: int, height: int, width: int,
+                    max_iter: int, cap_lanes: int, phase_budget: int,
+                    seg_steps: int, block_h: int, block_w: int, unroll: int,
+                    clamp: bool, interior_check: bool, julia: bool,
+                    power: int, burning: bool, interpret: bool):
+    """The full compacted pipeline: phase 1 -> gather survivors -> resume
+    rounds with re-compaction -> scatter back -> uint8 scaling.  One jit,
+    no host syncs; see the module doc for the design and the identity
+    argument."""
+    total = max_iter - 1
+    N = k * height * width
+    C = cap_lanes
+    i32 = jnp.int32
+
+    cr, ci, zr, zi, n, act = _pallas_escape_state(
+        params, mrds, k=k, height=height, width=width,
+        phase_budget=phase_budget, unroll=unroll, block_h=block_h,
+        block_w=block_w, interior_check=interior_check, julia=julia,
+        power=power, burning=burning, interpret=interpret)
+
+    dyn_lane = jnp.broadcast_to((mrds[:, 0] - 1)[:, None, None],
+                                (k, height, width)).reshape(N)
+    act_f = act.reshape(N)
+    n_f = n.reshape(N)
+    live = act_f != 0
+    pos = jnp.cumsum(live.astype(i32)) - 1
+    keep = live & (pos < C)
+    kept_ct = jnp.sum(keep, dtype=i32)
+
+    idx = jnp.nonzero(keep, size=C, fill_value=N)[0].astype(i32)
+    valid = jnp.arange(C, dtype=i32) < kept_ct
+    take = jnp.minimum(idx, N - 1)
+    czr, czi, ccr, cci = _gather_lanes(
+        valid, take, (0.0, 0.0, 0.0, 0.0),
+        zr.reshape(N), zi.reshape(N), cr.reshape(N), ci.reshape(N))
+    cn, cact, cdyn = _gather_lanes(valid, take, (0, 0, 0),
+                                   n_f, act_f, dyn_lane)
+    orig = jnp.where(valid, idx, N)
+
+    it0 = jnp.asarray(phase_budget + 1, i32)
+    shape2 = (C // _LANE, _LANE)
+    seg = jnp.asarray(seg_steps, i32)
+
+    def round_cond(carry):
+        it0, live_ct = carry[0], carry[1]
+        return (live_ct > 0) & (it0 <= total)
+
+    def round_body(carry):
+        (it0, _, czr, czi, cn, cact, ccr, cci, cdyn, orig, n_out) = carry
+        zr2, zi2, n2, act2 = _pallas_resume(
+            it0.reshape(1, 1), cdyn.reshape(shape2), ccr.reshape(shape2),
+            cci.reshape(shape2), czr.reshape(shape2), czi.reshape(shape2),
+            cn.reshape(shape2), cact.reshape(shape2), seg_steps=seg_steps,
+            unroll=unroll, block_h=_RESUME_BLOCK_H, power=power,
+            burning=burning, interpret=interpret)
+        # Every lane's count lands in the output each round (scatter by
+        # original pixel index, OOB-dropped padding): lanes that retired
+        # this round are final; lanes still live get overwritten by a
+        # later round's scatter.
+        n_out = n_out.at[orig].set(n2.reshape(C), mode="drop")
+        # Re-compact: live lanes to the buffer front, so straggler-free
+        # tail blocks of later rounds exit before their first segment.
+        lv = act2.reshape(C) != 0
+        cnt = jnp.sum(lv, dtype=i32)
+        idx2 = jnp.nonzero(lv, size=C, fill_value=C)[0].astype(i32)
+        val2 = jnp.arange(C, dtype=i32) < cnt
+        take2 = jnp.minimum(idx2, C - 1)
+        czr, czi, ccr2, cci2 = _gather_lanes(val2, take2,
+                                             (0.0, 0.0, 0.0, 0.0),
+                                             zr2, zi2, ccr, cci)
+        cn, cdyn2 = _gather_lanes(val2, take2, (0, 0), n2, cdyn)
+        # Live lanes are exactly the valid prefix — no gather needed
+        # (dtype pinned: a weak-typed where would widen under x64 and
+        # break the while carry's type invariance).
+        cact = val2.astype(i32)
+        orig = jnp.where(val2, orig[take2], N)
+        return (it0 + seg, cnt, czr, czi, cn, cact, ccr2, cci2, cdyn2,
+                orig, n_out)
+
+    carry = (it0, kept_ct, czr, czi, cn, cact, ccr, cci, cdyn, orig, n_f)
+    n_f = lax.while_loop(round_cond, round_body, carry)[-1]
+
+    # Overflow: survivors past capacity resume IN PLACE over the original
+    # layout (their own act plane, everything else dead) — the original
+    # grid's block structure is exactly the fast regime for the
+    # near-uniform deep views that overflow.  The cond skips the whole
+    # branch (compile-time shapes equal) when nothing overflowed.
+    overflow = jnp.sum(live, dtype=i32) - kept_ct
+    act_resid = (live & (pos >= C)).astype(i32)
+
+    def in_place_resume(n_base):
+        rows = N // _LANE
+        bh = _RESUME_BLOCK_H if rows % _RESUME_BLOCK_H == 0 else 8
+        shp = (rows, _LANE)
+        dyn_p = dyn_lane.reshape(shp)
+        cr_p = cr.reshape(shp)
+        ci_p = ci.reshape(shp)
+
+        def cond(carry):
+            it0r, live_ct = carry[0], carry[1]
+            return (live_ct > 0) & (it0r <= total)
+
+        def body(carry):
+            it0r, _, zr_p, zi_p, n_p, act_p = carry
+            zr2, zi2, n2, act2 = _pallas_resume(
+                it0r.reshape(1, 1), dyn_p, cr_p, ci_p, zr_p, zi_p, n_p,
+                act_p, seg_steps=seg_steps, unroll=unroll, block_h=bh,
+                power=power, burning=burning, interpret=interpret)
+            return (it0r + seg, jnp.sum(act2, dtype=i32), zr2, zi2, n2,
+                    act2)
+
+        out = lax.while_loop(cond, body,
+                             (it0, overflow, zr.reshape(shp),
+                              zi.reshape(shp), n_base.reshape(shp),
+                              act_resid.reshape(shp)))
+        return out[4].reshape(N)
+
+    n_f = lax.cond(overflow > 0, in_place_resume, lambda nb: nb, n_f)
+
+    # Per-lane uint8 scaling — the same integer expression as the plain
+    # kernel's epilogue, applied after reassembly.
+    counts = jnp.where(n_f >= dyn_lane, 0, n_f + 1)
+    mrd_lane = dyn_lane + 1
+    vals = (counts * 256 + (mrd_lane - 1)) // mrd_lane
+    if clamp:
+        vals = jnp.minimum(vals, 255)
+    return vals.astype(jnp.uint8).reshape(k, height, width)
+
+
+def compact_capacity(n_pixels: int) -> int:
+    """Static compact-buffer capacity for a batch: ``n_pixels / 4``
+    aligned up to a whole (32, 128) block grid."""
+    granule = _RESUME_BLOCK_H * _LANE
+    want = max(granule, n_pixels // COMPACT_CAPACITY_FRAC)
+    return -(-want // granule) * granule
+
+
+# Opt-in gate for the compacted dispatch.  MEASURED NEGATIVE on the
+# current bench stack (2026-07-31, v5 lite via the axon tunnel): the
+# resume kernel itself runs 520 Giter/s — 2.3x the plain kernel's best
+# big-call rate, exactly the win the round-3 audit predicted — but
+# XLA:TPU lowers the per-lane compaction glue to element-granular data
+# movement (chained-delta measured: gather 4M-of-16M 29 ms, scatter 24
+# ms, 16M sort 50 ms = 0.6-2.7 GB/s), which exceeds the ENTIRE device
+# compute of the views it would accelerate (filament 16x1024^2: 16 ms).
+# Patch-granular glue (8x128 DMA-able blocks) is affordable but removes
+# only 1.3x of iteration work (straggler waste lives inside patches).
+# Full numbers: ROUND4_NOTES.md "Live-lane compaction".  On a stack
+# with healthy gather bandwidth, set DMTPU_COMPACT=1 to enable.
+_COMPACT_OPTED_IN = bool(int(__import__("os").environ.get(
+    "DMTPU_COMPACT", "0") or "0"))
+
+
+def prefer_compaction(budget: int, n_pixels: int) -> bool:
+    """Dispatch policy: opt-in only (see the measured-negative note on
+    ``_COMPACT_OPTED_IN``), and then only when the budget is deep enough
+    that phase 1 strands a straggler tail (>= 2x the phase-1 budget) but
+    below the cycle-probe class, which the resume kernel does not carry
+    (deep in-set-heavy views keep the probe's guarantees instead), and
+    the batch has enough pixels to fill dense resume blocks."""
+    from distributedmandelbrot_tpu.ops.escape_time import (
+        CYCLE_CHECK_MIN_ITER)
+    return (_COMPACT_OPTED_IN
+            and 2 * PHASE1_BUDGET <= budget - 1
+            and budget < CYCLE_CHECK_MIN_ITER
+            and n_pixels >= 64 * _RESUME_BLOCK_H * _LANE)
+
+
+def compact_escape_batch(params, mrds, *, k: int, height: int, width: int,
+                         max_iter: int, unroll: int = DEFAULT_UNROLL,
+                         block_h: int = DEFAULT_BLOCK_H,
+                         block_w: int = DEFAULT_BLOCK_W,
+                         clamp: bool = False, interior_check: bool = True,
+                         cycle_check: bool | None = None,
+                         julia: bool = False, power: int = 2,
+                         burning: bool = False, interpret: bool = False,
+                         phase_budget: int = PHASE1_BUDGET,
+                         seg_steps: int = RESUME_SEG):
+    """k tiles -> (k, height, width) uint8 via the compacted two-phase
+    pipeline; bit-identical to ``_pallas_escape_batch`` (tested across
+    the view/feature matrix in tests/test_compact.py).
+
+    Callers should gate on :func:`prefer_compaction`; this wrapper
+    enforces the structural requirements (cycle probe unsupported,
+    budget deeper than phase 1, unroll-aligned phases)."""
+    if resolve_cycle_check(cycle_check, max_iter):
+        raise PallasUnsupported(
+            "compacted dispatch does not carry the cycle probe; use the "
+            "plain kernel for probe-class budgets")
+    if max_iter - 1 <= phase_budget:
+        raise PallasUnsupported(
+            f"budget {max_iter} completes inside phase 1 ({phase_budget}); "
+            "use the plain kernel")
+    if phase_budget % unroll or seg_steps % unroll:
+        raise PallasUnsupported(
+            f"phase budget {phase_budget} / segment {seg_steps} must be "
+            f"unroll-aligned ({unroll}) for resume bit-identity")
+    if width % _LANE:
+        raise PallasUnsupported(
+            f"width {width} not a multiple of {_LANE}")
+    if height % block_h or width % block_w:
+        # Same silent-partial-grid hazard fit_blocks guards for the
+        # plain kernels: a non-divisible extent would compute only
+        # extent // block blocks and leave the rest garbage.
+        raise PallasUnsupported(
+            f"extents ({height}, {width}) not divisible by the "
+            f"({block_h}, {block_w}) block")
+    return _compact_escape(
+        params, mrds, k=k, height=height, width=width, max_iter=max_iter,
+        cap_lanes=compact_capacity(k * height * width),
+        phase_budget=phase_budget, seg_steps=seg_steps, block_h=block_h,
+        block_w=block_w, unroll=unroll, clamp=clamp,
+        interior_check=interior_check, julia=julia, power=power,
+        burning=burning, interpret=interpret)

@@ -186,16 +186,13 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                       julia=julia, power=power, burning=burning)
 
 
-def _escape_tile_body(i, j, t, shape, store, params_ref, mrd_ref, zr_ref,
-                      zi_ref, act_ref, n_ref, snap_refs, *, max_iter: int,
-                      unroll: int, block_h: int, block_w: int, clamp: bool,
-                      interior_check: bool, cycle_check: bool, julia: bool,
-                      power: int, burning: bool):
-    """The one escape-loop body shared by the single-tile and batch-grid
-    kernels (they differ only in which params/mrd row ``t`` feeds the
-    block and where ``store`` lands the uint8 result).  Keeping this a
-    single function is what keeps the two dispatches bit-identical by
-    construction."""
+def _load_block_coords(params_ref, mrd_ref, t, i, j, shape,
+                       block_h: int, block_w: int, julia: bool):
+    """Shared prologue of every grid-generating kernel: load tile ``t``'s
+    SMEM params row, generate this block's pixel grid on device as
+    ``start + index * step`` (f32 — the documented one-ulp-vs-host-grid
+    convention), and select the recurrence constant.  Returns
+    ``(g_real, g_imag, c_real, c_imag, mrd)``."""
     start_r = params_ref[t, 0]
     start_i = params_ref[t, 1]
     step_r = params_ref[t, 2]
@@ -213,45 +210,55 @@ def _escape_tile_body(i, j, t, shape, store, params_ref, mrd_ref, zr_ref,
     else:
         c_real = g_real
         c_imag = g_imag
+    return g_real, g_imag, c_real, c_imag, mrd
 
-    total_steps = max_iter - 1
-    if total_steps <= 0:
-        store(jnp.zeros(shape, jnp.uint8))
-        return
-    dyn_steps = mrd - 1  # this tile's own budget (traced, <= total_steps)
 
-    four = jnp.asarray(4.0, dtype)
+def _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
+                  live0, *, cond_cap, sat_steps, unroll: int,
+                  cycle_check: bool, power: int, burning: bool,
+                  it0=None, dyn_ref=None):
+    """The ONE segmented escape while-loop, shared by the single-tile,
+    batch-grid, phase-1 state, and compaction resume kernels — sharing
+    this body is what makes every dispatch (and the two halves of a
+    compacted run) bit-identical by construction.
 
-    zr_ref[:] = g_real  # z0: the pixel grid (Mandelbrot: equals c)
-    zi_ref[:] = g_imag
-    # Interior pixels otherwise dominate iteration work on set-crossing
-    # views — this shortcut is where the block-granular exit really pays.
-    act0, n_sat, live0 = _interior_init(
-        c_real, c_imag, dyn_steps, shape, interior_check and not julia,
-        power=power, burning=burning)
-    act_ref[:] = act0
-    n_ref[:] = n_sat
-    if cycle_check:
-        szr_ref, szi_ref = snap_refs  # allocated only in cycle mode
-        szr_ref[:] = g_real  # snapshot of z_0
-        szi_ref[:] = g_imag
+    Escape recurrence with a sticky active mask; see
+    ops/escape_time.py:escape_loop for why stickiness matters and how
+    the count recovers the escape iteration.  Vector state lives in the
+    scratch refs; the while carries scalars only (Mosaic constraint).
+    The mask stays int32 end-to-end — i1 vectors can appear only as
+    transient compare results, never in carries or stores.  Stickiness
+    is a select (where(cond, act, 0) == act & cond for act in {0,1}):
+    cmp+select+add per step, one op fewer than cmp+convert+and+add —
+    this loop body times ~10 vector ops, so every op is ~10% of the
+    raw throughput.
 
-    # Escape recurrence with a sticky active mask; see
-    # ops/escape_time.py:escape_loop for why stickiness matters and how
-    # the count recovers the escape iteration.  Vector state lives in the
-    # scratch refs; the while carries scalars only (Mosaic constraint).
-    # The mask stays int32 end-to-end — i1 vectors can appear only as
-    # transient compare results, never in carries or stores.  Stickiness
-    # is a select (where(cond, act, 0) == act & cond for act in {0,1}):
-    # cmp+select+add per step, one op fewer than cmp+convert+and+add —
-    # this loop body times ~10 vector ops, so every op is ~10% of the
-    # raw throughput.
+    ``cond_cap``: the loop runs segments while ``it <= cond_cap`` (and
+    lanes are live).  ``sat_steps``: the budget the cycle probe
+    saturates retired counts to.  ``it0``: the first segment's
+    iteration number (default 1); segment boundaries land on
+    ``it0 + k*unroll``, so a resumed loop executes the identical
+    iteration grid as long as resume points are unroll-aligned.
+    ``dyn_ref``: optional per-lane budget ref — lanes whose own budget
+    is exhausted retire at segment granularity (their count has already
+    reached >= budget, which classifies never-escaped regardless of any
+    segment overshoot, so late retirement never changes output; see the
+    compaction design note in ops/compact_escape.py)."""
+    four = jnp.asarray(4.0, c_real.dtype)
+    if it0 is None:
+        it0 = jnp.asarray(1, jnp.int32)
+
     def seg_body(carry):
         it, _, next_snap = carry
         zr = zr_ref[:]
         zi = zi_ref[:]
         act = act_ref[:]
         n = n_ref[:]
+        if dyn_ref is not None:
+            # Mixed-budget compact buffers: retire lanes past their own
+            # tile's budget (output-invariant — their n is already
+            # saturated past it).
+            act = jnp.where(it <= dyn_ref[:], act, 0)
         if cycle_check:
             # Brent-style snapshot refresh at doubling iteration gaps:
             # once the gap exceeds the orbit's (eventual, exact-f32)
@@ -259,6 +266,7 @@ def _escape_tile_body(i, j, t, shape, store, params_ref, mrd_ref, zr_ref,
             # period.  Scalar predicate -> vector select; refresh cost is
             # per-segment, not per-step.
             do_snap = it >= next_snap
+            szr_ref, szi_ref = snap_refs
             szr = jnp.where(do_snap, zr, szr_ref[:])
             szi = jnp.where(do_snap, zi, szi_ref[:])
             next_snap = jnp.where(do_snap, it + it, next_snap)
@@ -287,7 +295,7 @@ def _escape_tile_body(i, j, t, shape, store, params_ref, mrd_ref, zr_ref,
                 # are already inactive; NaN != NaN keeps them inert.)
                 cyc = jnp.where((zr == szr) & (zi == szi), act, 0)
                 act = act - cyc
-                n = n + cyc * dyn_steps
+                n = n + cyc * sat_steps
             n = n + act
         zr_ref[:] = zr
         zi_ref[:] = zi
@@ -302,11 +310,49 @@ def _escape_tile_body(i, j, t, shape, store, params_ref, mrd_ref, zr_ref,
 
     def seg_cond(carry):
         it, live, _ = carry
-        return (it <= dyn_steps) & (live > 0)
+        return (it <= cond_cap) & (live > 0)
 
     lax.while_loop(seg_cond, seg_body,
-                   (jnp.asarray(1, jnp.int32), live0,
-                    jnp.asarray(2, jnp.int32)))
+                   (it0, live0, jnp.asarray(2, jnp.int32)))
+
+
+def _escape_tile_body(i, j, t, shape, store, params_ref, mrd_ref, zr_ref,
+                      zi_ref, act_ref, n_ref, snap_refs, *, max_iter: int,
+                      unroll: int, block_h: int, block_w: int, clamp: bool,
+                      interior_check: bool, cycle_check: bool, julia: bool,
+                      power: int, burning: bool):
+    """The one escape-loop body shared by the single-tile and batch-grid
+    kernels (they differ only in which params/mrd row ``t`` feeds the
+    block and where ``store`` lands the uint8 result).  Keeping this a
+    single function is what keeps the two dispatches bit-identical by
+    construction."""
+    g_real, g_imag, c_real, c_imag, mrd = _load_block_coords(
+        params_ref, mrd_ref, t, i, j, shape, block_h, block_w, julia)
+
+    total_steps = max_iter - 1
+    if total_steps <= 0:
+        store(jnp.zeros(shape, jnp.uint8))
+        return
+    dyn_steps = mrd - 1  # this tile's own budget (traced, <= total_steps)
+
+    zr_ref[:] = g_real  # z0: the pixel grid (Mandelbrot: equals c)
+    zi_ref[:] = g_imag
+    # Interior pixels otherwise dominate iteration work on set-crossing
+    # views — this shortcut is where the block-granular exit really pays.
+    act0, n_sat, live0 = _interior_init(
+        c_real, c_imag, dyn_steps, shape, interior_check and not julia,
+        power=power, burning=burning)
+    act_ref[:] = act0
+    n_ref[:] = n_sat
+    if cycle_check:
+        szr_ref, szi_ref = snap_refs  # allocated only in cycle mode
+        szr_ref[:] = g_real  # snapshot of z_0
+        szi_ref[:] = g_imag
+
+    _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
+                  live0, cond_cap=dyn_steps, sat_steps=dyn_steps,
+                  unroll=unroll, cycle_check=cycle_check, power=power,
+                  burning=burning)
 
     n = n_ref[:]
     counts = jnp.where(n >= dyn_steps, 0, n + 1)

@@ -197,13 +197,14 @@ def batched_escape_pixels(mesh: Mesh, starts_steps: np.ndarray,
 @partial(jax.jit,
          static_argnames=("mesh", "definition", "max_iter_cap", "unroll",
                           "block_h", "block_w", "clamp", "interpret",
-                          "cycle_check", "batch_grid"))
+                          "cycle_check", "batch_grid", "compact"))
 def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
                             max_iter_cap: int, unroll: int, block_h: int,
                             block_w: int, clamp: bool,
                             interpret: bool = False,
                             cycle_check: bool | None = None,
-                            batch_grid: bool = False):
+                            batch_grid: bool = False,
+                            compact: bool = False):
     """The Pallas kernel under shard_map: each device runs its tile shard
     with its own traced per-tile budget (static cap = the batch max).
 
@@ -225,6 +226,21 @@ def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
 
     def shard_fn(p_shard, m_shard):
         k_loc = p_shard.shape[0]
+        if compact:
+            # Opt-in (DMTPU_COMPACT=1) two-phase compacted dispatch —
+            # measured negative on the bench stack, see
+            # ops/compact_escape.prefer_compaction.
+            from distributedmandelbrot_tpu.ops.compact_escape import (
+                compact_escape_batch)
+            # cycle_check forwards the ALREADY-RESOLVED policy (from the
+            # true cap): re-resolving against the bucketed compile cap
+            # would wrongly arm the probe for true caps 2049-4095 and
+            # reject the dispatch (round-4 review finding).
+            return compact_escape_batch(
+                p_shard, m_shard[:, None].astype(jnp.int32), k=k_loc,
+                height=definition, width=definition, max_iter=max_iter_cap,
+                unroll=unroll, block_h=block_h, block_w=block_w,
+                clamp=clamp, cycle_check=cycle_check, interpret=interpret)
         if batch_grid and k_loc > 1:
             return _pallas_escape_batch(
                 p_shard, m_shard[:, None].astype(jnp.int32), k=k_loc,
@@ -250,6 +266,8 @@ def pallas_batch_config(definition: int, cap: int,
     by both the single-host and the multihost sharded paths so the two
     can never drift.  Raises PallasUnsupported for int64 caps and
     unsupported tile extents."""
+    from distributedmandelbrot_tpu.ops.compact_escape import (
+        prefer_compaction)
     from distributedmandelbrot_tpu.ops.pallas_escape import (
         DEFAULT_UNROLL, PallasUnsupported, bucket_cap, fit_blocks,
         pallas_available, prefer_batch_grid)
@@ -265,6 +283,7 @@ def pallas_batch_config(definition: int, cap: int,
             # 2049-4095 bucket to 4096 but stay on the per-tile chain.
             "batch_grid": prefer_batch_grid(cap, definition, definition,
                                             block_h, block_w),
+            "compact": prefer_compaction(cap, definition * definition),
             "block_h": block_h, "block_w": block_w,
             "unroll": DEFAULT_UNROLL,
             "interpret": (not pallas_available() if interpret is None

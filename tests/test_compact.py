@@ -1,0 +1,199 @@
+"""Compacted two-phase escape pipeline: bit-identity vs the plain kernel.
+
+The pipeline (ops/compact_escape.py) is a measured NEGATIVE on the
+current bench stack — XLA:TPU's per-lane gather/scatter/sort run at
+0.6-2.7 GB/s there, costing more than the compute it saves (see
+ROUND4_NOTES.md "Live-lane compaction") — so dispatch never selects it
+by default.  It stays fully functional and bit-identical behind the
+DMTPU_COMPACT opt-in because the resume kernel itself measured 520
+Giter/s (2.3x the plain kernel's best big-call rate): on a stack with
+healthy gather bandwidth the same pipeline is the floor-view win the
+round-3 audit pointed at.  These tests pin the identity contract that
+makes it safe to enable.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from distributedmandelbrot_tpu.ops.compact_escape import (  # noqa: E402
+    _compact_escape, compact_capacity, compact_escape_batch,
+    prefer_compaction)
+from distributedmandelbrot_tpu.ops.pallas_escape import (  # noqa: E402
+    PallasUnsupported, _pallas_escape_batch)
+
+
+def _params(cx, cy, span, n, extra=()):
+    s = span / (n - 1)
+    return [cx - span / 2, cy - span / 2, s, s, *extra]
+
+
+def _ref(params, mrds, k, n, mi, **kw):
+    return np.asarray(_pallas_escape_batch(
+        jnp.asarray(params, jnp.float32), jnp.asarray(mrds, jnp.int32),
+        k=k, height=n, width=n, max_iter=mi, cycle_check=False,
+        interpret=True, **kw))
+
+
+def _out(params, mrds, k, n, mi, **kw):
+    return np.asarray(compact_escape_batch(
+        jnp.asarray(params, jnp.float32), jnp.asarray(mrds, jnp.int32),
+        k=k, height=n, width=n, max_iter=mi, interpret=True, **kw))
+
+
+N = 128
+BOUNDARY = _params(-0.7436447, 0.1318252, 2e-3, N)   # no provable interior
+FULLVIEW = _params(-0.5, 0.0, 3.0, N)                # interior + sky mix
+
+
+def test_identity_boundary_and_mixed_budgets():
+    """Mixed-budget batch across a boundary view and a set-crossing view:
+    byte-identical to the plain batch kernel (the resume seam, per-lane
+    budget retirement, and the scatter-back all exercised at once)."""
+    params = [BOUNDARY, FULLVIEW]
+    mrds = [[700], [650]]
+    assert (_ref(params, mrds, 2, N, 700)
+            == _out(params, mrds, 2, N, 700)).all()
+
+
+def test_identity_shallow_tile_in_deep_batch():
+    """A tile whose whole budget fits inside phase 1 must contribute no
+    survivors (its unescaped lanes already classify in-set) while its
+    batch-mate resumes past the seam."""
+    params = [BOUNDARY, FULLVIEW]
+    mrds = [[700], [200]]  # 200 - 1 < PHASE1_BUDGET
+    assert (_ref(params, mrds, 2, N, 700)
+            == _out(params, mrds, 2, N, 700)).all()
+
+
+def test_identity_overflow_in_place_resume():
+    """Capacity one block-grid on a boundary-dense view forces the
+    overflow path: lanes past capacity resume in place over the original
+    grid, still byte-identical."""
+    params = [BOUNDARY]
+    mrds = [[700]]
+    ref = _ref(params, mrds, 1, N, 700)
+    out = np.asarray(_compact_escape(
+        jnp.asarray(params, jnp.float32), jnp.asarray(mrds, jnp.int32),
+        k=1, height=N, width=N, max_iter=700, cap_lanes=4096,
+        phase_budget=64, seg_steps=64, block_h=64, block_w=128, unroll=64,
+        clamp=False, interior_check=True, julia=False, power=2,
+        burning=False, interpret=True))
+    assert (ref == out).all()
+
+
+@pytest.mark.parametrize("mode", ["julia", "ship", "multibrot", "clamp"])
+def test_identity_feature_matrix(mode):
+    kw = {}
+    params = [BOUNDARY]
+    if mode == "julia":
+        params = [_params(0.0, 0.0, 3.0, N, (-0.8, 0.156))]
+        kw["julia"] = True
+    elif mode == "ship":
+        params = [_params(-1.7443, -0.0356, 0.01, N)]
+        kw["burning"] = True
+    elif mode == "multibrot":
+        kw["power"] = 3
+    elif mode == "clamp":
+        kw["clamp"] = True
+    mrds = [[700]]
+    assert (_ref(params, mrds, 1, N, 700, **kw)
+            == _out(params, mrds, 1, N, 700, **kw)).all()
+
+
+def test_guards():
+    """Structural rejections: probe-class budgets, phase-1-only budgets,
+    unaligned phases — loud PallasUnsupported, never silent wrong output."""
+    p = jnp.asarray([BOUNDARY], jnp.float32)
+    with pytest.raises(PallasUnsupported, match="cycle probe"):
+        compact_escape_batch(p, jnp.asarray([[8192]], jnp.int32), k=1,
+                             height=N, width=N, max_iter=8192,
+                             interpret=True)
+    with pytest.raises(PallasUnsupported, match="phase 1"):
+        compact_escape_batch(p, jnp.asarray([[200]], jnp.int32), k=1,
+                             height=N, width=N, max_iter=200,
+                             interpret=True)
+    with pytest.raises(PallasUnsupported, match="unroll-aligned"):
+        compact_escape_batch(p, jnp.asarray([[700]], jnp.int32), k=1,
+                             height=N, width=N, max_iter=700,
+                             phase_budget=100, interpret=True)
+    with pytest.raises(PallasUnsupported, match="divisible"):
+        compact_escape_batch(p, jnp.asarray([[700]], jnp.int32), k=1,
+                             height=100, width=N, max_iter=700,
+                             interpret=True)
+
+
+def test_sharded_dispatch_opt_in(monkeypatch):
+    """The production sharded batch path routes through the compacted
+    dispatch (policy stubbed permissive — the real gate needs 512^2+
+    tiles, too slow for interpret mode; the policy itself is pinned in
+    test_capacity_and_policy) and stays byte-identical to the default
+    route.  The budget buckets past the probe threshold (true cap 700
+    -> compile cap 1024), exercising the already-resolved cycle_check
+    forwarding; the 2049-4095 bucket slice is covered directly in
+    test_bucketed_cap_forwards_resolved_probe."""
+    import distributedmandelbrot_tpu.ops.compact_escape as CE
+    from distributedmandelbrot_tpu.parallel import tile_mesh
+    from distributedmandelbrot_tpu.parallel.sharding import (
+        batched_escape_pixels_pallas)
+
+    mesh = tile_mesh(8)
+    k = max(2, mesh.devices.size)
+    s = 2e-3 / (N - 1)
+    starts = np.asarray([[-0.7436447 - 1e-3 + 1e-4 * i,
+                          0.1318252 - 1e-3, s] for i in range(k)])
+    mrds = np.full(k, 700, np.int64)
+    base = batched_escape_pixels_pallas(mesh, starts, mrds, definition=N)
+    routed = []
+    real = CE.compact_escape_batch
+
+    def spy(*a, **kw):
+        routed.append(True)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(CE, "prefer_compaction", lambda *a: True)
+    monkeypatch.setattr(CE, "compact_escape_batch", spy)
+    out = batched_escape_pixels_pallas(mesh, starts, mrds, definition=N)
+    assert routed, "compact branch was not taken — vacuous comparison"
+    assert (base == out).all()
+
+
+def test_bucketed_cap_forwards_resolved_probe():
+    """True caps 2049-4095 bucket to the 4096 compile cap; the dispatch
+    must forward the probe policy resolved from the TRUE cap (False)
+    rather than re-resolving against the bucketed cap, which would arm
+    the probe and reject the whole slice (round-4 review finding)."""
+    params = jnp.asarray([BOUNDARY], jnp.float32)
+    mrds = jnp.asarray([[300]], jnp.int32)  # cheap per-lane budget
+    ref = np.asarray(_pallas_escape_batch(
+        params, mrds, k=1, height=N, width=N, max_iter=4096,
+        cycle_check=False, interpret=True))
+    out = np.asarray(compact_escape_batch(
+        params, mrds, k=1, height=N, width=N, max_iter=4096,
+        cycle_check=False, interpret=True))
+    assert (ref == out).all()
+    with pytest.raises(PallasUnsupported, match="cycle probe"):
+        compact_escape_batch(params, mrds, k=1, height=N, width=N,
+                             max_iter=4096, interpret=True)
+
+
+def test_capacity_and_policy():
+    """Capacity aligns to whole (32, 128) block grids; the dispatch
+    policy is opt-in only (measured negative on the bench stack) and
+    never selects probe-class or phase-1-only budgets even when opted
+    in."""
+    assert compact_capacity(16 * 1024 * 1024) == 4 * 1024 * 1024
+    assert compact_capacity(100) == 32 * 128
+    assert compact_capacity(4097 * 4) % (32 * 128) == 0
+    import distributedmandelbrot_tpu.ops.compact_escape as CE
+    assert not prefer_compaction(2000, 1 << 24)  # no opt-in
+    try:
+        CE._COMPACT_OPTED_IN = True
+        assert prefer_compaction(2000, 1 << 24)
+        assert not prefer_compaction(8192, 1 << 24)   # probe class
+        assert not prefer_compaction(300, 1 << 24)    # fits phase 1
+        assert not prefer_compaction(2000, 1 << 10)   # too few pixels
+    finally:
+        CE._COMPACT_OPTED_IN = False
