@@ -176,6 +176,21 @@ def main() -> int:
     assert shard_rate >= 0.85 * single_rate, (
         f"sharded 4096^2 path lost >15% vs single-tile "
         f"({shard_rate:.1f} vs {single_rate:.1f} Mpix/s)")
+    # Bidirectional production-shape check (round-3 verdict item 2: the
+    # old assert was one-directional, so a slow single-tile baseline
+    # passed silently): at MATCHED per-call pixels the 4096^2 shape must
+    # stay within 20% of the 1024^2 batch — the r03 "3x gap" was the
+    # per-call dispatch constant, not the tile shape (ROUND4_NOTES.md).
+    from bench import bench_tileshape
+    ts = bench_tileshape(2)
+    print(f"4096^2x4 {ts['tile4096x4_mpix_s']} vs 1024^2x64 "
+          f"{ts['tile1024x64_mpix_s']} Mpix/s benched "
+          f"(device {ts.get('tile4096x4_device_mpix_s', 'n/a')} vs "
+          f"{ts.get('tile1024x64_device_mpix_s', 'n/a')}; per-call "
+          f"overhead {ts.get('tile4096x4_call_overhead_s', 'n/a')}s)")
+    assert ts["tile4096x4_mpix_s"] >= 0.8 * ts["tile1024x64_mpix_s"], (
+        "production 4096^2 tile shape fell >20% behind the matched "
+        "1024^2 batch")
 
     step("5. perturbation scan on device")
     from distributedmandelbrot_tpu.ops.perturbation import (
