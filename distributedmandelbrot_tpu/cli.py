@@ -148,6 +148,17 @@ def _join_negative_values(argv: Sequence[str], flags: Sequence[str]) -> list:
     return out
 
 
+def _resolve_bla(args: argparse.Namespace) -> bool | None:
+    """--bla / --no-bla -> the perturbation layer's tri-state: force on,
+    force off, or (neither) the per-orbit auto-probe
+    (ops.perturbation._auto_bla)."""
+    if getattr(args, "bla", False):
+        return True
+    if getattr(args, "no_bla", False):
+        return False
+    return None
+
+
 # Below this span, float64 pixel coordinates alias and the renderer
 # switches to the perturbation path (center at decimal-string precision).
 DEEP_SPAN_THRESHOLD = 1e-12
@@ -310,7 +321,7 @@ def _render_view(c_re: str, c_im: str, span: float, definition: int,
                  julia_c: tuple[str, str] | None = None,
                  family: tuple[int, bool] | None = None,
                  no_pallas: bool = False, normalize: bool = False,
-                 supersample: int = 1, bla: bool = False):
+                 supersample: int = 1, bla: bool | None = None):
     """One view -> RGBA (Mandelbrot, or Julia when ``julia_c`` is set, or
     a Multibrot/Burning-Ship view when ``family=(power, burning)``),
     choosing direct vs perturbation rendering.  Shared by the render and
@@ -800,15 +811,21 @@ def cmd_render(argv: Sequence[str]) -> int:
                              "arbitrary decimal precision, valid at any "
                              "span (auto-selected below 1e-12)")
     parser.add_argument("--bla", action="store_true",
-                        help="bilinear-approximation fast path for deep "
-                             "renders, integer or --smooth (ops/bla.py): "
-                             "skips orbit segments where the delta "
-                             "recurrence is effectively linear — up to "
-                             "~10x on slow (parabolic / minibrot-margin) "
-                             "deep views.  Approximate by contract: "
-                             "escapes inside a skipped segment are "
-                             "detected at its end; smooth freeze values "
-                             "stay exact (the table's z_cap guard)")
+                        help="force the bilinear-approximation fast path "
+                             "for deep renders, integer or --smooth "
+                             "(ops/bla.py): skips orbit segments where "
+                             "the delta recurrence is effectively linear "
+                             "— up to ~10x on slow (parabolic / minibrot-"
+                             "margin) deep views.  Approximate by "
+                             "contract: escapes inside a skipped segment "
+                             "are detected at its end; smooth freeze "
+                             "values stay exact (the table's z_cap "
+                             "guard).  Default (neither flag): a cheap "
+                             "probe auto-enables BLA exactly where it "
+                             "wins")
+    parser.add_argument("--no-bla", action="store_true",
+                        help="force the exact delta scan (disable the "
+                             "BLA auto-probe)")
     parser.add_argument("--dtype", choices=["f32", "f64"], default=None,
                         help="arithmetic width (the algorithm still auto-selects: sub-f32-resolution f32 renders use f32 perturbation); default: f64 for --smooth, f32 otherwise")
     parser.add_argument("--colormap", default="jet")
@@ -862,6 +879,8 @@ def cmd_render(argv: Sequence[str]) -> int:
     deep = _resolve_deep(True if args.deep else None, args.span,
                          float(c_re), float(c_im), args.definition,
                          np_dtype, family)
+    if args.bla and args.no_bla:
+        raise SystemExit("--bla and --no-bla are mutually exclusive")
     if args.bla and not deep:
         raise SystemExit("--bla applies to perturbation deep renders "
                          "(--deep, or a view the auto-selector routes "
@@ -876,7 +895,7 @@ def cmd_render(argv: Sequence[str]) -> int:
                         no_pallas=args.no_pallas,
                         normalize=args.normalize,
                         supersample=args.supersample,
-                        bla=args.bla)
+                        bla=_resolve_bla(args))
     _save_png(args.out, rgba)
     return 0
 
@@ -926,9 +945,13 @@ def cmd_animate(argv: Sequence[str]) -> int:
                              "--supersample); zoom animations flicker "
                              "visibly less with it")
     parser.add_argument("--bla", action="store_true",
-                        help="BLA fast path for the deep (perturbation) "
-                             "frames — see dmtpu render --bla; direct-"
-                             "kernel frames are unaffected")
+                        help="force the BLA fast path for the deep "
+                             "(perturbation) frames — see dmtpu render "
+                             "--bla; direct-kernel frames are unaffected; "
+                             "default: per-orbit auto-probe")
+    parser.add_argument("--no-bla", action="store_true",
+                        help="force the exact delta scan (disable the "
+                             "BLA auto-probe)")
     _add_no_pallas(parser)
     parser.add_argument("--out-dir", required=True,
                         help="directory for frame_NNNN.png files")
@@ -946,6 +969,8 @@ def cmd_animate(argv: Sequence[str]) -> int:
         raise SystemExit("--frames must be >= 1")
     if args.span_end <= 0 or args.span_start <= 0:
         raise SystemExit("spans must be positive")
+    if args.bla and args.no_bla:
+        raise SystemExit("--bla and --no-bla are mutually exclusive")
 
 
     import os
@@ -989,7 +1014,7 @@ def cmd_animate(argv: Sequence[str]) -> int:
                             deep=deep, julia_c=julia_c, family=family,
                             no_pallas=args.no_pallas,
                             supersample=args.supersample,
-                            bla=args.bla)
+                            bla=_resolve_bla(args))
         path = os.path.join(args.out_dir, f"frame_{f:04d}.png")
         _save_png(path, rgba)
         print(f"frame {f + 1}/{args.frames} span {span:.3g} "

@@ -416,15 +416,32 @@ def bla_scan_factory(z_re: np.ndarray, z_im: np.ndarray, dc_max: float, *,
     orbit_len = len(z_re)
 
     def scan_fn(zr, zi, dre, dim):
-        counts, glitched, _, skipped = _bla_scan(
+        counts, packed, skipped = _bla_scan_fetch(
             zr, zi, tabs, dre, dim, orbit_len=orbit_len,
             max_iter=max_iter, levels=levels, add_dc=add_dc)
         if logger.isEnabledFor(logging.DEBUG):  # one sync fetch/chunk
             logger.debug("BLA skipped %d of %d orbit steps on this chunk",
                          int(skipped), orbit_len)
-        return counts, glitched
+        return counts, packed
 
     return scan_fn
+
+
+@partial(jax.jit, static_argnames=("orbit_len", "max_iter", "levels",
+                                   "add_dc"))
+def _bla_scan_fetch(z_re, z_im, tabs, dc_re, dc_im, *, orbit_len: int,
+                    max_iter: int, levels: int, add_dc: bool):
+    """:func:`_bla_scan` shaped for the device->host fetch — same
+    lossless trimming as perturbation._perturb_scan_fetch (uint16
+    counts when the budget fits, bit-packed glitch mask), one jit so
+    the trim costs no extra dispatch."""
+    from distributedmandelbrot_tpu.ops.perturbation import _pack_mask
+    counts, glitched, _, skipped = _bla_scan(
+        z_re, z_im, tabs, dc_re, dc_im, orbit_len=orbit_len,
+        max_iter=max_iter, levels=levels, add_dc=add_dc)
+    if max_iter < (1 << 16):
+        counts = counts.astype(jnp.uint16)
+    return counts, _pack_mask(glitched), skipped
 
 
 @partial(jax.jit, static_argnames=("orbit_len", "max_iter", "levels",
@@ -565,13 +582,27 @@ def bla_smooth_scan_factory(z_re: np.ndarray, z_im: np.ndarray,
     orbit_len = len(z_re)
 
     def scan_fn(zr, zi, dre, dim):
-        nu, glitched, skipped = _bla_scan_smooth(
+        nu, packed, skipped = _bla_scan_smooth_fetch(
             zr, zi, tabs, dre, dim, orbit_len=orbit_len,
             max_iter=max_iter, levels=levels, bailout=float(bailout),
             add_dc=add_dc)
         if logger.isEnabledFor(logging.DEBUG):  # one sync fetch/chunk
             logger.debug("BLA skipped %d of %d orbit steps on this chunk",
                          int(skipped), orbit_len)
-        return nu, glitched
+        return nu, packed
 
     return scan_fn
+
+
+@partial(jax.jit, static_argnames=("orbit_len", "max_iter", "levels",
+                                   "bailout", "add_dc"))
+def _bla_scan_smooth_fetch(z_re, z_im, tabs, dc_re, dc_im, *,
+                           orbit_len: int, max_iter: int, levels: int,
+                           bailout: float, add_dc: bool):
+    """Smooth twin of :func:`_bla_scan_fetch` (nu stays f32; only the
+    glitch mask packs)."""
+    from distributedmandelbrot_tpu.ops.perturbation import _pack_mask
+    nu, glitched, skipped = _bla_scan_smooth(
+        z_re, z_im, tabs, dc_re, dc_im, orbit_len=orbit_len,
+        max_iter=max_iter, levels=levels, bailout=bailout, add_dc=add_dc)
+    return nu, _pack_mask(glitched), skipped

@@ -38,6 +38,7 @@ span and pixel offsets need floating point.
 
 from __future__ import annotations
 
+import logging
 from collections import OrderedDict
 from dataclasses import dataclass
 from functools import partial
@@ -47,10 +48,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+logger = logging.getLogger(__name__)
+
 # Fixed-point precision floor for the reference orbit (fractional bits);
 # compute_counts_perturb widens automatically with depth so the orbit
-# always carries >= 64 bits below the pixel pitch.
-DEFAULT_PREC_BITS = 256
+# always carries >= 64 bits below the pixel pitch — the widening formula,
+# not this floor, is what enforces the precision policy.  One 128-bit
+# limb pair is the floor (the auto-widening already exceeds it beyond
+# span ~1e-19): the old 256 floor doubled the limb work of every orbit
+# and every exact glitch repair at production depths for no added
+# guarantee (this rig is single-core — the repair loop is serial), a
+# measured 2x on the config-4 repair pass.
+DEFAULT_PREC_BITS = 128
 
 # Pauldelbrot criterion: |z|^2 < GLITCH_TOL * |Z|^2 marks a pixel
 # glitched (cancellation ate the significand).
@@ -116,7 +125,7 @@ def _fixed_to_float(v: int, bits: int) -> float:
 
 def reference_orbit(center_re: str | float, center_im: str | float,
                     max_iter: int, *,
-                    prec_bits: int = DEFAULT_PREC_BITS
+                    prec_bits: int = 256
                     ) -> tuple[np.ndarray, np.ndarray, int]:
     """High-precision escape-time orbit of the center, truncated to
     float64 arrays.  The arrays are shared with an LRU cache — treat
@@ -130,8 +139,10 @@ def reference_orbit(center_re: str | float, center_im: str | float,
     pixels escaping near the orbit's end can reach the smooth-coloring
     radius; consumers needing only the tested orbit must slice
     ``Z[:valid_len]``.  Arithmetic is ``prec_bits``-bit fixed-point
-    bigint (stdlib): per-step rounding is 2^-prec_bits — for the default
-    256 bits, ~190 orders of magnitude below float64's own truncation.
+    bigint (stdlib): per-step rounding is 2^-prec_bits — the default
+    stays 256 bits (~77 decimal digits of input precision) because this
+    public helper takes raw decimal strings with NO depth auto-widening,
+    unlike the _compute_perturb path and its 128-bit floor.
     """
     v_re = _to_fixed(center_re, prec_bits)
     v_im = _to_fixed(center_im, prec_bits)
@@ -263,7 +274,7 @@ def _escape_counts_exact_batch(points: list[tuple[int, int]],
 
 
 def escape_counts_exact(c_re: str | float, c_im: str | float, max_iter: int,
-                        *, prec_bits: int = DEFAULT_PREC_BITS) -> int:
+                        *, prec_bits: int = 256) -> int:
     """Reference-convention escape count of one point in fixed point
     (the glitch-pixel fallback): 0 = never escaped within budget."""
     return _escape_count_fixed(_to_fixed(c_re, prec_bits),
@@ -310,8 +321,27 @@ class DeepTileSpec:
 PERTURB_SEGMENT = 256
 
 
+# Stagnation stop for the delta scans (round-4, config-4 profile): the
+# device scan's whole-chunk early exit only fires when EVERY lane
+# retires, so a handful of bounded lanes — which end up glitch-flagged
+# and exactly recomputed anyway — dragged the full 512^2 chunk through
+# the entire 50000-step orbit (measured: 678 such lanes = 74% of warm
+# render time).  Once the live count has not changed for this many
+# steps AND the live set is small, the scan stops and flags the
+# stragglers as suspect; they join the exact-repair path that already
+# guaranteed their values.  Output-exact by construction (the repair is
+# exact); the trade is bounded: at most ``STAGNATION_MAX_LIVE`` lanes
+# can be diverted, each costing one exact fixed-point orbit — a FLAT
+# cap, because a relative one would let a minibrot sliver of thousands
+# of clean in-set pixels (which the device scan retires for free) be
+# diverted to the serial bigint loop (round-4 review finding).
+STAGNATION_QUIET_STEPS = 2048
+STAGNATION_MAX_LIVE = 64
+
+
 def _segmented_orbit_scan(step, init, z_re, z_im, live_of, *,
-                          segment: int = PERTURB_SEGMENT):
+                          segment: int = PERTURB_SEGMENT,
+                          stagnation=None):
     """``lax.scan(step, init, orbit)`` with tile-granular early exit.
 
     The delta scans are select-free with sticky masks, so once no lane
@@ -322,6 +352,15 @@ def _segmented_orbit_scan(step, init, z_re, z_im, live_of, *,
     ``segment``-step slices run under a ``while_loop`` that stops when
     ``live_of(carry)`` reports no live lanes; the ragged tail runs as a
     plain scan (its lanes are inert if the loop exited early).
+
+    ``stagnation=(live_count_of, live_mask_of, cap)`` additionally arms
+    the stagnation stop (see :data:`STAGNATION_QUIET_STEPS`): the loop
+    also exits when the live count is both <= ``cap`` and unchanged for
+    the quiet window, and the return becomes ``(carry, suspect)`` where
+    ``suspect`` marks lanes still live at such a stop — their carry
+    values are NOT trustworthy (the ragged tail may step them against
+    mismatched orbit entries) and the caller MUST route them to an
+    exact recompute.
 
     Identity scope: every carry component FROZEN by the live masks
     (masks, counts, frozen z) matches the full scan bit-for-bit; the
@@ -338,30 +377,66 @@ def _segmented_orbit_scan(step, init, z_re, z_im, live_of, *,
     orbit_len = z_re.shape[0]
     full = orbit_len // segment
 
-    def seg_body(state):
-        seg, carry = state
+    def run_segment(seg, carry):
         zr = lax.dynamic_slice_in_dim(z_re, seg * segment, segment)
         zi = lax.dynamic_slice_in_dim(z_im, seg * segment, segment)
         carry, _ = lax.scan(step, carry, (zr, zi))
-        return (seg + 1, carry)
+        return carry
+
+    if stagnation is None:
+        def seg_body(state):
+            seg, carry = state
+            return (seg + 1, run_segment(seg, carry))
+
+        def seg_cond(state):
+            seg, carry = state
+            return (seg < full) & live_of(carry)
+
+        carry = init
+        if full:
+            _, carry = lax.while_loop(seg_cond, seg_body,
+                                      (jnp.asarray(0, jnp.int32), carry))
+        if orbit_len - full * segment:
+            carry, _ = lax.scan(step, carry, (z_re[full * segment:],
+                                              z_im[full * segment:]))
+        return carry
+
+    live_count_of, live_mask_of, cap = stagnation
+    quiet_segs = max(1, STAGNATION_QUIET_STEPS // segment)
+
+    def seg_body(state):
+        seg, last_change, prev, carry = state
+        carry = run_segment(seg, carry)
+        cnt = live_count_of(carry)
+        last_change = jnp.where(cnt != prev, seg + 1, last_change)
+        return (seg + 1, last_change, cnt, carry)
 
     def seg_cond(state):
-        seg, carry = state
-        return (seg < full) & live_of(carry)
+        seg, last_change, prev, carry = state
+        return ((seg < full) & (prev > 0)
+                & (((seg - last_change) < quiet_segs) | (prev > cap)))
 
     carry = init
+    seg_final = jnp.asarray(full, jnp.int32)
     if full:
-        _, carry = lax.while_loop(seg_cond, seg_body,
-                                  (jnp.asarray(0, jnp.int32), carry))
+        seg_final, _, _, carry = lax.while_loop(
+            seg_cond, seg_body,
+            (jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+             live_count_of(init), carry))
+    # Lanes live at a premature stop: their values are suspect (and the
+    # ragged tail below may advance them against the WRONG orbit
+    # entries — harmless only because they are flagged here, before it
+    # runs on the carry).
+    suspect = live_mask_of(carry) & (seg_final < full)
     if orbit_len - full * segment:
         carry, _ = lax.scan(step, carry, (z_re[full * segment:],
                                           z_im[full * segment:]))
-    return carry
+    return carry, suspect
 
 
-@partial(jax.jit, static_argnames=("max_iter", "add_dc"))
+@partial(jax.jit, static_argnames=("max_iter", "add_dc", "stagnation"))
 def _perturb_scan(z_re, z_im, dc_re, dc_im, *, max_iter: int,
-                  add_dc: bool = True):
+                  add_dc: bool = True, stagnation: bool = True):
     """Delta-orbit scan: returns (counts, glitched).
 
     Step ``k`` receives ``Z[k] = z_{k+1}`` of the center orbit and the
@@ -409,17 +484,150 @@ def _perturb_scan(z_re, z_im, dc_re, dc_im, *, max_iter: int,
     init = (dc_re.astype(dtype), dc_im.astype(dtype),
             jnp.ones(shape, jnp.bool_), jnp.zeros(shape, jnp.int32),
             jnp.zeros(shape, jnp.bool_))
-    dzr, dzi, active, n, glitched = _segmented_orbit_scan(
-        step, init, z_re.astype(dtype), z_im.astype(dtype),
-        lambda c: jnp.any(c[2]))
+    # ``stagnation=False`` callers (the reference hop probe, the auto-BLA
+    # probe) need the true alive-at-orbit-end mask — a stagnation stop
+    # would report early-stopped lanes as alive and break the hop
+    # invariant "probes still bounded when the orbit ran out"
+    # (round-4 review finding).
+    if stagnation:
+        (dzr, dzi, active, n, glitched), suspect = _segmented_orbit_scan(
+            step, init, z_re.astype(dtype), z_im.astype(dtype),
+            lambda c: jnp.any(c[2]),
+            stagnation=(lambda c: jnp.sum(c[2], dtype=jnp.int32),
+                        lambda c: c[2], STAGNATION_MAX_LIVE))
+    else:
+        dzr, dzi, active, n, glitched = _segmented_orbit_scan(
+            step, init, z_re.astype(dtype), z_im.astype(dtype),
+            lambda c: jnp.any(c[2]))
+        suspect = jnp.zeros(shape, jnp.bool_)
 
     # Pixels still bounded when the (possibly escaped-early) reference
     # orbit ran out: if the orbit covered the full budget they are
-    # in-set; otherwise their fate is unknown -> glitched.
+    # in-set; otherwise their fate is unknown -> glitched.  Stagnation-
+    # stopped stragglers are likewise unknown -> glitched (the exact
+    # repair that already guaranteed their values computes them).
     if orbit_len < max_iter:
         glitched = glitched | active
+    glitched = glitched | suspect
     counts = jnp.where(n >= max_iter, 0, jnp.maximum(n, 1))
     return counts, glitched, active
+
+
+def _pack_mask(g):
+    """Bit-pack a boolean mask device-side (little-endian within each
+    byte, matching ``np.unpackbits(..., bitorder="little")``): the
+    glitch plane crosses the device->host link once per chunk, and on a
+    tunneled rig that link (~35 MB/s) is a dominant cost of the deep
+    path — 1 bit/pixel instead of 1 byte is a straight 8x on it.
+    Must be traced inside the caller's jit (a bare call would pay its
+    own dispatch and forfeit the saving)."""
+    flat = g.reshape(-1).astype(jnp.uint8)
+    pad = (-flat.size) % 8
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.uint8)])
+    weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.int32)
+    return jnp.sum(flat.reshape(-1, 8).astype(jnp.int32) * weights,
+                   axis=1, dtype=jnp.int32).astype(jnp.uint8)
+
+
+def _unpack_mask_np(packed: np.ndarray, shape) -> np.ndarray:
+    """Host-side inverse of :func:`_pack_mask`."""
+    n = int(np.prod(shape))
+    return np.unpackbits(packed, bitorder="little")[:n].reshape(
+        shape).astype(bool)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "add_dc", "stagnation"))
+def _perturb_scan_fetch(z_re, z_im, dc_re, dc_im, *, max_iter: int,
+                        add_dc: bool = True, stagnation: bool = True):
+    """:func:`_perturb_scan` shaped for the device->host fetch: counts
+    narrowed to uint16 when the budget allows (counts <= max_iter <
+    2^16 — lossless) and the glitch mask bit-packed, both inside ONE
+    jit so the trimming costs no extra dispatch.  The driver widens
+    and unpacks on the host."""
+    counts, glitched, _ = _perturb_scan(z_re, z_im, dc_re, dc_im,
+                                        max_iter=max_iter, add_dc=add_dc,
+                                        stagnation=stagnation)
+    if max_iter < (1 << 16):
+        counts = counts.astype(jnp.uint16)
+    return counts, _pack_mask(glitched)
+
+
+@partial(jax.jit, static_argnames=("max_iter", "bailout", "add_dc"))
+def _perturb_scan_smooth_fetch(z_re, z_im, dc_re, dc_im, *, max_iter: int,
+                               bailout: float, add_dc: bool = True):
+    """Smooth twin of :func:`_perturb_scan_fetch` (nu stays f32; only
+    the glitch mask packs)."""
+    nu, glitched = _perturb_scan_smooth(z_re, z_im, dc_re, dc_im,
+                                        max_iter=max_iter, bailout=bailout,
+                                        add_dc=add_dc)
+    return nu, _pack_mask(glitched)
+
+
+# Auto-BLA gate (round-4, verdict item 3): ``bla=None`` probes whether
+# the tile-granular skip path would pay before committing to either
+# scan.  The probe is the EXACT delta scan on a ~4096-lane subsample of
+# the tile, capped at BLA_AUTO_PROBE_STEPS: BLA wins exactly on views
+# whose lanes stay bounded (and cancellation-clean) deep into a
+# full-budget orbit — slow dynamics near parabolic points / minibrot
+# margins, measured 9.5x on the bond-point bench — and loses on
+# escape-rich views whose scans exit early anyway (measured -12% on the
+# config-4 Misiurewicz window).  Survivor fraction at the probe horizon
+# separates the two cleanly: ~1.0 on the bond view vs ~0.003 on
+# config 4.  Decisions are cached per (orbit, budget, delta-scale)
+# so animations and bench repeats pay the probe dispatch once.
+BLA_AUTO_MIN_BUDGET = 20000
+BLA_AUTO_PROBE_STEPS = 4096
+BLA_AUTO_PROBE_LANES = 4096
+BLA_AUTO_SURVIVOR_FRAC = 0.5
+_AUTO_BLA_CACHE: "OrderedDict[tuple, bool]" = OrderedDict()
+_AUTO_BLA_CACHE_MAX = 64
+
+
+def _auto_bla(z_re: np.ndarray, z_im: np.ndarray, zr_dev, zi_dev,
+              dre: np.ndarray, dim: np.ndarray, max_iter: int,
+              add_dc: bool, dtype=np.float32) -> bool:
+    """Decide the BLA question for one (orbit, view, budget) — see the
+    gate note above.  ``z_re/z_im`` are the host orbit (cache key),
+    ``zr_dev/zi_dev`` the device copies the probe scans against."""
+    if max_iter < BLA_AUTO_MIN_BUDGET or len(z_re) < max_iter:
+        # Shallow budgets have nothing worth skipping; an early-escaping
+        # reference means an exterior-dominated view (config-4 class) —
+        # the scan is short and BLA's table build would outcost it.
+        return False
+    scale = float(max(np.max(np.abs(dre)), np.max(np.abs(dim)), 1e-300))
+    key = (len(z_re), float(z_re[0]), float(z_im[0]), float(z_re[-1]),
+           float(z_im[-1]), max_iter, add_dc, np.dtype(dtype).str,
+           int(np.round(np.log2(scale))))
+    hit = _AUTO_BLA_CACHE.get(key)
+    if hit is not None:
+        _AUTO_BLA_CACHE.move_to_end(key)
+        return hit
+    # 2-D lattice over the separable delta grid (a raveled stride at a
+    # width-multiple would collapse to one column — round-4 review
+    # finding), probed at the RENDER dtype (an f32 cast of sub-f32-floor
+    # f64 deltas would flush to zero and shadow the reference).
+    h, w = dre.shape
+    side = int(np.sqrt(BLA_AUTO_PROBE_LANES))
+    ci = np.linspace(0, w - 1, min(side, w)).astype(int)
+    ri = np.linspace(0, h - 1, min(side, h)).astype(int)
+    pre = np.broadcast_to(dre[0, ci][None, :], (len(ri), len(ci)))
+    pim = np.broadcast_to(dim[ri, 0][:, None], (len(ri), len(ci)))
+    plen = min(BLA_AUTO_PROBE_STEPS, len(z_re))
+    _, glitched, active = _perturb_scan(
+        zr_dev[:plen], zi_dev[:plen],
+        jnp.asarray(pre.astype(dtype)),
+        jnp.asarray(pim.astype(dtype)),
+        max_iter=plen, add_dc=add_dc, stagnation=False)
+    frac = float(np.asarray(active & ~glitched).mean())
+    decision = frac >= BLA_AUTO_SURVIVOR_FRAC
+    logger.info("BLA auto-%s: probe survivor fraction %.3f at step %d "
+                "(budget %d)", "enabled" if decision else "disabled",
+                frac, plen, max_iter)
+    _AUTO_BLA_CACHE[key] = decision
+    while len(_AUTO_BLA_CACHE) > _AUTO_BLA_CACHE_MAX:
+        _AUTO_BLA_CACHE.popitem(last=False)
+    return decision
 
 
 @lru_cache(maxsize=16)
@@ -451,41 +659,71 @@ def _find_reference(za: int, zb: int, ca: int, cb: int, span: float,
     """
     off_re = 0.0
     off_im = 0.0
-    lat = np.linspace(-span / 2, span / 2, probes)
-    for _ in range(hops):
-        z_re, z_im, n = _orbit_fixed(za, zb, ca, cb, max_iter, bits)
+    # Lattice density escalates once: the coarse pass is enough while
+    # outliving probes exist, but on all-exterior views the deepest
+    # pixels occupy a sliver of the area (config-4 1024^2: ~0.25%) that
+    # a probes^2 lattice almost never samples — the dense pass trades
+    # ONE more probe-scan dispatch (cold path only; the whole search is
+    # LRU-cached) for hundreds fewer serial exact repairs.
+    # Mandelbrot only: in julia mode a deeper exterior reference was
+    # measured to SHIFT cancellation mis-certification (a bounded pixel
+    # slipping the 1e-6 tolerance reads as escaped) on the repelling-
+    # fixed-point test view — the escalation's win is the exterior-
+    # dominated Mandelbrot case, so julia keeps the coarse-lattice
+    # behavior unchanged.
+    lattices = [probes, 64] if add_dc else [probes]
+    li = 0
+    z_re, z_im, n = _orbit_fixed(za, zb, ca, cb, max_iter, bits)
+    for _ in range(hops + len(lattices)):
         if n >= max_iter:
             break
-        pre = np.broadcast_to(lat, (probes, probes)).ravel() - off_re
-        pim = np.repeat(lat, probes) - off_im
+        side = lattices[li]
+        lat = np.linspace(-span / 2, span / 2, side)
+        pre = np.broadcast_to(lat, (side, side)).ravel() - off_re
+        pim = np.repeat(lat, side) - off_im
         # Probe against the orbit's VALID prefix: the post-escape
         # extension (there for smooth laggards) diverges and would
         # corrupt the alive mask with cancellation noise.
-        _, _, alive = _perturb_scan(
+        counts, _, alive = _perturb_scan(
             jnp.asarray(z_re[:n]), jnp.asarray(z_im[:n]),
             jnp.asarray(pre.astype(np.float64)),
             jnp.asarray(pim.astype(np.float64)), max_iter=max_iter,
-            add_dc=add_dc)
+            add_dc=add_dc, stagnation=False)
         # Hop targets are probes still bounded when the orbit ran out —
         # NOT the glitched mask, which also contains cancellation-flagged
         # probes that escaped earlier than the reference did.
         alive = np.asarray(alive)
-        if not alive.any():
-            break  # every probe escapes before the orbit does
-        # Hop to the outliving probe nearest the view center.
-        idx = np.argwhere(alive).ravel()
-        best = idx[np.argmin(np.abs(pre[idx] + off_re)
-                             + np.abs(pim[idx] + off_im))]
+        if alive.any():
+            # Hop to the outliving probe nearest the view center.
+            idx = np.argwhere(alive).ravel()
+            best = idx[np.argmin(np.abs(pre[idx] + off_re)
+                                 + np.abs(pim[idx] + off_im))]
+        else:
+            if not add_dc:
+                # Julia mode: no deepening at all (see the lattice note
+                # above) — an all-exterior lattice ends the search.
+                break
+            # All-exterior lattice: climb the escape-depth gradient —
+            # hop to the DEEPEST-escaping probe while the orbit
+            # strictly deepens, then escalate the lattice once.  Every
+            # iteration of coverage recovered converts outliving pixels
+            # from the serial exact-repair loop back to the device scan;
+            # the deepening orbits are escape-length bigints — cheap.
+            best = int(np.argmax(np.asarray(counts)))
         d_re, d_im = float(pre[best]), float(pim[best])
-        za += _to_fixed(d_re, bits)
-        zb += _to_fixed(d_im, bits)
-        if add_dc:
-            # Mandelbrot: the start point IS the parameter; both move.
-            ca, cb = za, zb
+        za2 = za + _to_fixed(d_re, bits)
+        zb2 = zb + _to_fixed(d_im, bits)
+        ca2, cb2 = (za2, zb2) if add_dc else (ca, cb)
+        z_re2, z_im2, n2 = _orbit_fixed(za2, zb2, ca2, cb2, max_iter, bits)
+        if not alive.any() and n2 <= n:
+            if li + 1 < len(lattices):
+                li += 1  # densify and retry from the current best
+                continue
+            break  # depth gradient exhausted at the densest lattice
+        za, zb, ca, cb = za2, zb2, ca2, cb2
+        z_re, z_im, n = z_re2, z_im2, n2
         off_re += d_re
         off_im += d_im
-    else:
-        z_re, z_im, n = _orbit_fixed(za, zb, ca, cb, max_iter, bits)
     return z_re, z_im, n, off_re, off_im
 
 
@@ -554,7 +792,8 @@ def _device_orbit(z_re: np.ndarray, z_im: np.ndarray):
 def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
                      dtype, prec_bits: int, max_glitch_fix: int | None,
                      julia_c: tuple[str, str] | None = None,
-                     scan_factory=None) -> tuple[np.ndarray, int]:
+                     scan_factory=None, repair_scan_fn=None,
+                     bla: bool | None = False) -> tuple[np.ndarray, int]:
     """Shared perturbation driver: validates the span/dtype combination,
     widens orbit precision with depth, auto-selects the reference, runs
     ``scan_fn(zr, zi, dre, dim)`` over row chunks (it returns a value
@@ -604,10 +843,18 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     # Deltas are relative to the chosen reference, not the view center.
     dre -= off_re
     dim -= off_im
+    zr, zi = _device_orbit(z_re, z_im)
+    # bla tri-state: True/False = caller decided; None = probe (cached).
+    use_bla = bla
+    if use_bla is None:
+        use_bla = (scan_factory is not None
+                   and _auto_bla(z_re, z_im, zr, zi, dre, dim, max_iter,
+                                 julia_c is None, dtype=dtype))
+    if not use_bla:
+        scan_factory = None  # secondary pass stays on the exact scan too
     if scan_factory is not None:
         dc_max = float(np.sqrt(np.max(dre * dre + dim * dim)))
         scan_fn = scan_factory(z_re, z_im, dc_max)
-    zr, zi = _device_orbit(z_re, z_im)
     # Row-chunked: the scan carries its state through every step; big
     # tiles are walked in row bands to bound the carry footprint.  The
     # band size is a measured trade (dev v5e, config-4 view, mi=50000):
@@ -618,14 +865,29 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     limit = (1 << 20) if np.dtype(dtype) == np.float32 else (1 << 19)
     chunk = max(1, min(spec.height, limit // max(1, spec.width)))
     vals, glitches = [], []
+    # The main grid's deltas are separable (dre varies along columns
+    # only, dim along rows — delta_grids' construction): upload the two
+    # VECTORS (KBs) and broadcast on device, instead of H x W planes —
+    # on the tunneled rig the old 2D upload (8 MB at 1024^2 f32) cost
+    # more than the scan itself.  Values are bit-identical: the same
+    # host-f64 numbers, cast at upload, broadcast.
+    dre_row = jnp.asarray(dre[0].astype(dtype))
     for r0 in range(0, spec.height, chunk):
+        rows = min(chunk, spec.height - r0)
+        dim_col = jnp.asarray(dim[r0:r0 + chunk, 0].astype(dtype))
         # device_get on the pair fetches both planes concurrently — two
         # sequential np.asarray calls pay the host link's round trip
         # twice (measured 2x on the dev rig's tunnel).
         v_part, g_part = jax.device_get(scan_fn(
             zr, zi,
-            jnp.asarray(dre[r0:r0 + chunk].astype(dtype)),
-            jnp.asarray(dim[r0:r0 + chunk].astype(dtype))))
+            jnp.broadcast_to(dre_row[None, :], (rows, spec.width)),
+            jnp.broadcast_to(dim_col[:, None], (rows, spec.width))))
+        # Providers may trim the fetch (uint16 counts, bit-packed glitch
+        # mask — see _perturb_scan_fetch): widen/unpack on the host.
+        if g_part.dtype == np.uint8:
+            g_part = _unpack_mask_np(g_part, v_part.shape)
+        if v_part.dtype == np.uint16:
+            v_part = v_part.astype(np.int32)
         vals.append(v_part)
         glitches.append(g_part)
     out = np.concatenate(vals).copy()
@@ -697,11 +959,15 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
                     dre2[:k] * dre2[:k] + dim2[:k] * dim2[:k])))
                 scan2 = scan_factory(z2_re, z2_im, dc2_max)
             else:
-                scan2 = scan_fn
+                scan2 = repair_scan_fn or scan_fn
             v2, g2 = jax.device_get(scan2(
                 zr2_dev, zi2_dev,
                 jnp.asarray(dre2.astype(dtype)),
                 jnp.asarray(dim2.astype(dtype))))
+            if g2.dtype == np.uint8:
+                g2 = _unpack_mask_np(g2, v2.shape)
+            if v2.dtype == np.uint16:
+                v2 = v2.astype(np.int32)
             v2 = v2[:k]
             g2 = g2[:k]
             fixed = bad[~g2]
@@ -744,7 +1010,8 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
                            prec_bits: int = DEFAULT_PREC_BITS,
                            max_glitch_fix: int | None = None,
                            julia_c: tuple[str, str] | None = None,
-                           bla: bool = False) -> tuple[np.ndarray, int]:
+                           bla: bool | None = None
+                           ) -> tuple[np.ndarray, int]:
     """Escape counts for a deep-zoom tile via perturbation.
 
     Returns ``(counts, n_glitched)``: int32 (height, width) counts in
@@ -765,35 +1032,39 @@ def compute_counts_perturb(spec: DeepTileSpec, max_iter: int, *,
     orbit, not the device dtype (see :func:`_compute_perturb` for the
     span floors and precision widening).
 
-    ``bla=True`` selects the tile-granular bilinear-approximation fast
-    path (ops/bla.py) — far fewer device iterations at giant budgets in
+    ``bla`` selects the tile-granular bilinear-approximation fast path
+    (ops/bla.py) — far fewer device iterations at giant budgets in
     exchange for a documented approximation (late escape/glitch
-    detection at skip boundaries); an OPT-IN speed mode, not the
-    default exact scan.
+    detection at skip boundaries).  ``True``/``False`` force the
+    choice; the default ``None`` probes the view (see ``_auto_bla``)
+    and enables BLA only where slow bounded dynamics make it win.
     """
     if max_iter <= 1:
         return np.zeros((spec.height, spec.width), np.int32), 0
     add_dc = julia_c is None
 
     def scan(zr, zi, dre, dim):
-        counts, glitched, _ = _perturb_scan(zr, zi, dre, dim,
-                                            max_iter=max_iter,
-                                            add_dc=add_dc)
-        return counts, glitched
+        return _perturb_scan_fetch(zr, zi, dre, dim, max_iter=max_iter,
+                                   add_dc=add_dc)
 
-    factory = None
-    if bla:
+    def repair_scan(zr, zi, dre, dim):
+        # The secondary repair pass scans exactly the bounded lanes the
+        # stagnation stop would re-flag — it must run stagnation-free
+        # or the pass is always wasted (round-4 review finding).
+        return _perturb_scan_fetch(zr, zi, dre, dim, max_iter=max_iter,
+                                   add_dc=add_dc, stagnation=False)
+
+    def factory(z_re, z_im, dc_max):
         from distributedmandelbrot_tpu.ops.bla import bla_scan_factory
-
-        def factory(z_re, z_im, dc_max):
-            return bla_scan_factory(z_re, z_im, dc_max,
-                                    max_iter=max_iter, dtype=dtype,
-                                    add_dc=add_dc)
+        return bla_scan_factory(z_re, z_im, dc_max,
+                                max_iter=max_iter, dtype=dtype,
+                                add_dc=add_dc)
 
     return _compute_perturb(spec, max_iter, scan, dtype=dtype,
                             prec_bits=prec_bits,
                             max_glitch_fix=max_glitch_fix,
-                            julia_c=julia_c, scan_factory=factory)
+                            julia_c=julia_c, scan_factory=factory,
+                            repair_scan_fn=repair_scan, bla=bla)
 
 
 def _escape_count_fixed(za: int, zb: int, max_iter: int, bits: int,
@@ -895,6 +1166,11 @@ def _perturb_scan_smooth(z_re, z_im, dc_re, dc_im, *, max_iter: int,
     # subset of act_b and the union degenerates to act_b; for exotic
     # bailout < 2 the radius-2 count can outlive the bailout mask and
     # must keep the loop alive).
+    # NO stagnation stop here (round-4 review finding): a stagnant-but-
+    # eventually-escaping lane diverted to the exact repair would come
+    # back as an INTEGER count — the repair cannot produce smooth nu —
+    # so the stop would trade exact smooth values for banding.  The
+    # smooth plane keeps the plain whole-chunk early exit.
     dzr, dzi, act_b, n, act2, n2, fzr, fzi, glitched = \
         _segmented_orbit_scan(step, init, z_re.astype(dtype),
                               z_im.astype(dtype),
@@ -919,7 +1195,8 @@ def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
                            bailout: float = 256.0,
                            max_glitch_fix: int | None = None,
                            julia_c: tuple[str, str] | None = None,
-                           bla: bool = False) -> tuple[np.ndarray, int]:
+                           bla: bool | None = None
+                           ) -> tuple[np.ndarray, int]:
     """Smooth (band-free) deep-zoom values via perturbation.
 
     Returns ``(nu, n_glitched)``: float (height, width) renormalized
@@ -941,20 +1218,20 @@ def compute_smooth_perturb(spec: DeepTileSpec, max_iter: int, *,
     add_dc = julia_c is None
 
     def scan(zr, zi, dre, dim):
-        return _perturb_scan_smooth(zr, zi, dre, dim, max_iter=max_iter,
-                                    bailout=float(bailout), add_dc=add_dc)
+        return _perturb_scan_smooth_fetch(zr, zi, dre, dim,
+                                          max_iter=max_iter,
+                                          bailout=float(bailout),
+                                          add_dc=add_dc)
 
-    factory = None
-    if bla:
+    def factory(z_re, z_im, dc_max):
         from distributedmandelbrot_tpu.ops.bla import bla_smooth_scan_factory
-
-        def factory(z_re, z_im, dc_max):
-            return bla_smooth_scan_factory(z_re, z_im, dc_max,
-                                           max_iter=max_iter,
-                                           bailout=float(bailout),
-                                           dtype=dtype, add_dc=add_dc)
+        return bla_smooth_scan_factory(z_re, z_im, dc_max,
+                                       max_iter=max_iter,
+                                       bailout=float(bailout),
+                                       dtype=dtype, add_dc=add_dc)
 
     return _compute_perturb(spec, max_iter, scan, dtype=dtype,
                             prec_bits=prec_bits,
                             max_glitch_fix=max_glitch_fix,
-                            julia_c=julia_c, scan_factory=factory)
+                            julia_c=julia_c, scan_factory=factory,
+                            bla=bla)

@@ -92,6 +92,23 @@ def test_render_bla_guard_follows_routing(tmp_path):
                   "--center", "-0.748,0.09", "--out", str(out)])
 
 
+def test_render_bla_tristate(tmp_path):
+    """--bla/--no-bla are mutually exclusive; --no-bla forces the exact
+    scan on a deep render (tri-state plumbing to the perturbation
+    layer — the bla=None auto-probe default is covered in
+    test_perturbation.test_auto_bla_probe_decisions)."""
+    out = tmp_path / "nb.png"
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        cli.main(["render", "--bla", "--no-bla", "--deep",
+                  "--span", "1e-13", "--definition", "64",
+                  "--max-iter", "64", "--out", str(out)])
+    rc = cli.main(["render", "--no-bla", "--deep", "--span", "1e-13",
+                   "--definition", "64", "--max-iter", "128",
+                   "--center", "-0.74529,0.11307", "--out", str(out)])
+    assert rc == 0
+    assert _png_size(out) == (64, 64)
+
+
 def test_worker_backend_validation():
     with pytest.raises(SystemExit):
         cli.main(["worker", "--backend", "pallas", "--dtype", "f64"])
