@@ -713,7 +713,7 @@ def cmd_viewer(argv: Sequence[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="dmtpu viewer",
         description="Fetch and render finished tiles.")
-    parser.add_argument("level", type=int)
+    parser.add_argument("level", type=int, nargs="?", default=None)
     parser.add_argument("index_real", type=int, nargs="?", default=None)
     parser.add_argument("index_imag", type=int, nargs="?", default=None)
     parser.add_argument("--host", default="127.0.0.1")
@@ -727,6 +727,27 @@ def cmd_viewer(argv: Sequence[str]) -> int:
     _add_common(parser)
     args = parser.parse_args(argv)
     _configure_logging(args)
+
+    if args.level is None and args.stitch:
+        parser.error("--stitch requires a level")
+    if args.level is None:
+        # No arguments: the reference viewer's interactive session
+        # (DistributedMandelbrotViewer.py:147-152) — prompt for server
+        # and chunk indices with the same prompts.  Closed stdin or
+        # non-numeric answers exit with a clean usage error, not a
+        # traceback.
+        try:
+            args.host = input("Server Addr> ") or args.host
+            port_s = input("Server Port> ")
+            args.port = int(port_s) if port_s else args.port
+            args.level = int(input("Level> "))
+            args.index_real = int(input("Index Re> "))
+            args.index_imag = int(input("Index Im> "))
+        except EOFError:
+            parser.error("no arguments and no interactive input; "
+                         "pass LEVEL [INDEX_RE INDEX_IM] (see --help)")
+        except ValueError as e:
+            parser.error(f"invalid numeric answer: {e}")
 
     from distributedmandelbrot_tpu.viewer import DataClient
 

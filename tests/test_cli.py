@@ -109,6 +109,50 @@ def test_render_bla_tristate(tmp_path):
     assert _png_size(out) == (64, 64)
 
 
+def test_viewer_prompt_mode(tmp_path, monkeypatch):
+    """`dmtpu viewer` with no arguments prompts for server and chunk
+    indices with the reference viewer's exact prompt strings
+    (DistributedMandelbrotViewer.py:147-152), then fetches and renders
+    like the flag-driven path."""
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    from distributedmandelbrot_tpu.core.workload import parse_level_settings
+    from distributedmandelbrot_tpu.worker import (DistributerClient,
+                                                  NumpyBackend, Worker)
+
+    with EmbeddedCoordinator(str(tmp_path),
+                             parse_level_settings("1:12")) as co:
+        worker = Worker(DistributerClient("127.0.0.1", co.distributer_port),
+                        NumpyBackend())
+        worker.run_until_drained()
+        co.wait_saves_settled(expected_accepted=1)
+
+        prompts = []
+        answers = iter(["127.0.0.1", str(co.dataserver_port),
+                        "1", "0", "0"])
+
+        def fake_input(prompt):
+            prompts.append(prompt)
+            return next(answers)
+
+        monkeypatch.setattr("builtins.input", fake_input)
+        out = tmp_path / "prompted.png"
+        rc = cli.main(["viewer", "--out", str(out)])
+        assert rc == 0
+        assert prompts == ["Server Addr> ", "Server Port> ", "Level> ",
+                           "Index Re> ", "Index Im> "]
+        assert _png_size(out) == (4096, 4096)
+    # --stitch without a level is flag-driven and must reject loudly,
+    # not fall into prompt mode; closed stdin exits with a usage error,
+    # not an EOFError traceback.
+    with pytest.raises(SystemExit):
+        cli.main(["viewer", "--stitch"])
+    def eof_input(prompt):
+        raise EOFError
+    monkeypatch.setattr("builtins.input", eof_input)
+    with pytest.raises(SystemExit):
+        cli.main(["viewer"])
+
+
 def test_worker_backend_validation():
     with pytest.raises(SystemExit):
         cli.main(["worker", "--backend", "pallas", "--dtype", "f64"])
