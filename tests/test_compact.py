@@ -179,6 +179,25 @@ def test_bucketed_cap_forwards_resolved_probe():
                              max_iter=4096, interpret=True)
 
 
+def test_env_opt_in_parses():
+    """DMTPU_COMPACT=1 flips the import-time opt-in (the policy gate the
+    monkeypatch-based tests bypass) — checked in a subprocess because
+    the flag is read at module import."""
+    import os
+    import subprocess
+    import sys
+
+    code = ("import distributedmandelbrot_tpu.ops.compact_escape as CE;"
+            "print(CE._COMPACT_OPTED_IN and "
+            "CE.prefer_compaction(2000, 1 << 24))")
+    env = dict(os.environ, DMTPU_COMPACT="1", JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-300:]
+    assert out.stdout.strip().endswith("True")
+
+
 def test_capacity_and_policy():
     """Capacity aligns to whole (32, 128) block grids; the dispatch
     policy is opt-in only (measured negative on the bench stack) and
