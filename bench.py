@@ -550,7 +550,8 @@ def bench_deepslow(repeats: int) -> dict:
     cardioid (c = 3/8 + i sqrt(3)/8, center exact to 40 digits) at span
     1e-15 and budget 100000 — a parabolic window where every pixel runs
     the full orbit.  The classic pathological deep-zoom case; reports
-    the exact perturbation scan and the opt-in BLA fast path
+    the exact perturbation scan and the (auto-selected by default) BLA
+    fast path
     (ops/bla.py — approximate by documented contract; on TPU the two
     are bit-identical on this all-interior view, pinned by tests, and
     the artifact carries the measured ``bla_agreement`` rather than
@@ -574,18 +575,32 @@ def bench_deepslow(repeats: int) -> dict:
 
     t_exact = _time_chain(leg(False), max(1, repeats - 1))
     t_bla = _time_chain(leg(True), max(1, repeats - 1))
+    # The headline leg runs the ACTUAL default (bla=None -> auto-probe,
+    # cached after the first call), so the artifact measures what a
+    # default render achieves rather than assuming the probe's choice
+    # (round-4 review finding).
+    t_auto = _time_chain(leg(None), max(1, repeats - 1))
     # Reported, not asserted: on TPU the two are bit-identical here
     # (pinned by tests); a CPU-fallback run could flip a marginal
     # boundary lane via FMA-contraction trajectory drift, which should
     # show in the artifact rather than abort the sweep.
     agree = float((outs[False] == outs[True]).mean())
+    # Round 4: the auto-probe (bla=None, the default every caller gets)
+    # selects BLA on this view, so the headline value is the BLA rate —
+    # what a default render actually achieves — with the exact-scan
+    # reference rate and the measured agreement alongside.
+    agree_auto = float((outs[False] == outs[None]).mean())
     return {"metric": f"deep-slow parabolic bond point {side}^2 mi={mi} "
-                      "span 1e-15 (exact perturbation vs opt-in BLA)",
-            "value": round(_mpix(side * side, t_exact), 3),
+                      "span 1e-15 (value = the DEFAULT bla=None "
+                      "auto-probed path, measured; exact scan and "
+                      "forced BLA kept as reference legs)",
+            "value": round(_mpix(side * side, t_auto), 3),
             "unit": "Mpix/s",
+            "exact_mpix_s": round(_mpix(side * side, t_exact), 3),
             "bla_mpix_s": round(_mpix(side * side, t_bla), 3),
             "bla_speedup": round(t_exact / t_bla, 1),
-            "bla_agreement": round(agree, 6)}
+            "bla_agreement": round(agree, 6),
+            "auto_agreement_vs_exact": round(agree_auto, 6)}
 
 
 def bench_config5(repeats: int, segment: int) -> dict:
@@ -962,8 +977,9 @@ def main() -> int:
                              "tile-shape config (latency-decomposed)")
     parser.add_argument("--deep-slow", action="store_true",
                         help="run only the slow-dynamics deep-zoom config "
-                             "(parabolic bond point; exact perturbation vs "
-                             "the opt-in BLA fast path)")
+                             "(parabolic bond point; value = the default "
+                             "auto-probed path, with exact-scan and "
+                             "forced-BLA reference legs)")
     args = parser.parse_args()
     fell_back = _ensure_live_backend()
 

@@ -209,8 +209,9 @@ def main() -> int:
     # aborts unless the backend is TPU, where identity is pinned).
     from bench import bench_deepslow
     ds = bench_deepslow(2)
-    print(f"bond: exact {ds['value']} Mpix/s, bla {ds['bla_mpix_s']} "
-          f"(x{ds['bla_speedup']}), agreement {ds['bla_agreement']}")
+    print(f"bond: exact {ds['exact_mpix_s']} Mpix/s, bla "
+          f"{ds['bla_mpix_s']} (x{ds['bla_speedup']}), "
+          f"agreement {ds['bla_agreement']}")
     # The BLA contract is approximate (eps-perturbed deltas); a marginal
     # boundary lane can legitimately flip under an eps/table change, so
     # assert the contract-level bound and only WARN on non-bit-identity
