@@ -22,6 +22,7 @@ reported as-is.
 
 Usage: python bench.py [--tile 1024] [--tiles N] [--max-iter 1000]
                        [--dtype f32] [--repeats 3] [--all] [--farm]
+                       [--worst] [--tileshape] [--deep-slow]
 """
 
 from __future__ import annotations
