@@ -59,10 +59,22 @@ class TileScheduler:
             if s.level in seen_levels:
                 raise ValueError(f"duplicate level {s.level}")
             seen_levels.add(s.level)
+        self._levels = seen_levels
         self.level_settings = tuple(level_settings)
         self.lease_timeout = lease_timeout
         self.clock = clock if clock is not None else MonotonicClock()
         self._completed: set[Key] = set(completed or ())
+        # Completion counter restricted to the configured grid: the resume
+        # set may carry keys from levels this run does not render (index
+        # replay keeps every level ever computed), so len(_completed) alone
+        # cannot answer is_complete().  Counting membership once here and
+        # maintaining the integer on every completion/reopen keeps
+        # is_complete() O(1) — the stats loop and embedders call it per
+        # tick, and a full-grid rescan is O(sum level^2) at level-1000
+        # scale (the rescan cost this scheduler was built to avoid,
+        # Distributer.cs:335-353).
+        self._remaining = self.total_tiles - sum(
+            1 for k in self._completed if self._in_grid(k))
         self._leases: dict[Key, Lease] = {}
         self._claims: dict[Key, tuple[int, Lease]] = {}
         self._claim_seq = 0  # claim identity; see claim()
@@ -78,7 +90,10 @@ class TileScheduler:
 
     @property
     def completed_count(self) -> int:
-        return len(self._completed)
+        """Completed tiles of the CONFIGURED grid (resume sets may carry
+        keys from other levels; those are excluded so stats can never
+        report more tiles complete than the run has)."""
+        return self.total_tiles - self._remaining
 
     @property
     def outstanding_leases(self) -> int:
@@ -86,14 +101,12 @@ class TileScheduler:
         return sum(1 for l in self._leases.values() if not l.expired(now))
 
     def is_complete(self) -> bool:
-        """All tiles of all configured levels are done."""
-        return len(self._completed) >= self.total_tiles and \
-            self._all_grid_completed()
+        """All tiles of all configured levels are done (O(1))."""
+        return self._remaining == 0
 
-    def _all_grid_completed(self) -> bool:
-        return all((s.level, i, j) in self._completed
-                   for s in self.level_settings
-                   for i in range(s.level) for j in range(s.level))
+    def _in_grid(self, key: Key) -> bool:
+        level, i, j = key
+        return level in self._levels and 0 <= i < level and 0 <= j < level
 
     # -- grant path -------------------------------------------------------
 
@@ -196,7 +209,9 @@ class TileScheduler:
         if entry[1].expired(self.clock.now()):
             self._retry.append(entry[1].workload)
             return False
-        self._completed.add(w.key)
+        if w.key not in self._completed:
+            self._completed.add(w.key)
+            self._remaining -= 1
         return True
 
     def release_claim(self, w: Workload, token: int) -> None:
@@ -224,8 +239,13 @@ class TileScheduler:
         the save errors, the result's bytes are gone and the tile must go
         back in the frontier or the run would finish with a silent hole.
         """
-        if w.key in self._completed:
+        if w.key in self._completed and self._in_grid(w.key):
+            # Out-of-grid keys (foreign levels in a resume set) stay in
+            # _completed and never enter the frontier: requeueing one
+            # would let it be granted and re-completed, corrupting the
+            # _remaining counter for tiles this run doesn't render.
             self._completed.discard(w.key)
+            self._remaining += 1
             self._retry.append(w)
 
     # -- maintenance ------------------------------------------------------

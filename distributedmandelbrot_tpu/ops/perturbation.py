@@ -871,6 +871,17 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     # on the tunneled rig the old 2D upload (8 MB at 1024^2 f32) cost
     # more than the scan itself.  Values are bit-identical: the same
     # host-f64 numbers, cast at upload, broadcast.
+    # The vector upload (and _auto_bla's lattice probe) are correct ONLY
+    # for separable grids; nothing else enforces that, and a future
+    # non-separable delta_grids (rotation, jittered supersampling) would
+    # silently render wrong pixels.  Cheap spot check, not a full scan.
+    # A data-contract check in library code, so a real raise (assert
+    # would vanish under python -O and let every pixel render wrong).
+    if not ((dre[0] == dre[-1]).all() and (dim[:, 0] == dim[:, -1]).all()):
+        raise ValueError(
+            "delta_grids produced a non-separable grid; the vector-upload "
+            "broadcast path requires dre to vary by column only and dim "
+            "by row only")
     dre_row = jnp.asarray(dre[0].astype(dtype))
     for r0 in range(0, spec.height, chunk):
         rows = min(chunk, spec.height - r0)

@@ -310,6 +310,13 @@ def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
                               interpret=interpret)
     if cycle_check is not None:
         cfg["cycle_check"] = cycle_check
+        if cycle_check and cfg.get("compact"):
+            # prefer_compaction assumed the probe resolved False; an
+            # explicit cycle_check=True override is incompatible with the
+            # compacted dispatch (it would raise PallasUnsupported and
+            # hard-fail the whole backend), so demote to the plain
+            # batch-grid path instead (round-4 advisor finding).
+            cfg["compact"] = False
     starts_steps, mrds = pad_to_mesh(starts_steps, mrds, mesh.devices.size)
     starts_steps = widen_square_pitch(starts_steps)
     sharding = NamedSharding(mesh, P(TILE_AXIS))
