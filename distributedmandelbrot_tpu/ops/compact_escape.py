@@ -383,6 +383,15 @@ def compact_capacity(n_pixels: int) -> int:
 # only 1.3x of iteration work (straggler waste lives inside patches).
 # Full numbers: ROUND4_NOTES.md "Live-lane compaction".  On a stack
 # with healthy gather bandwidth, set DMTPU_COMPACT=1 to enable.
+#
+# Round 5: the ASSEMBLED pipeline finally ran on real silicon
+# (tools/hw_compact.py -> COMPACT_HW_r05.json): byte-identical to the
+# plain kernel on both the uniform and mixed-budget batches — the
+# identity claim is now hardware-pinned — and the perf negative is
+# confirmed emphatically (filament 16x1024^2 mi=2000: 5.5 device Mpix/s
+# compacted vs 890 plain; the glue dominates end-to-end).  The opt-in
+# stays exactly that: an escape hatch whose enablement path is tested,
+# with hardware evidence that THIS stack should leave it off.
 _COMPACT_OPTED_IN = bool(int(__import__("os").environ.get(
     "DMTPU_COMPACT", "0") or "0"))
 

@@ -1,0 +1,161 @@
+"""Run the opt-in compacted dispatch ON REAL TPU HARDWARE — identity + perf.
+
+Round-5 verdict item 2: the compacted two-phase pipeline
+(``ops/compact_escape.py``, opt-in via ``DMTPU_COMPACT=1``) had only ever
+executed in CPU interpret mode; its "enable on a stack with healthy
+gather bandwidth" advice had no tested enablement path.  This tool runs
+the ASSEMBLED ``compact_escape_batch`` on the live chip:
+
+1. byte-identity vs the plain batch-grid kernel on a boundary view and
+   on a mixed-budget batch (the two cases the bit-identity matrix covers
+   in interpret mode — here on real silicon);
+2. one chained-delta perf row (same in-jit repetition methodology as
+   bench.py) so the compact-vs-plain comparison measures the device, not
+   the tunnel.
+
+Usage (live TPU): python tools/hw_compact.py [--out COMPACT_HW_r05.json]
+
+The artifact records the outcome either way — if the glue still loses on
+this stack, that is the documented, now-hardware-tested negative.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _chain(batch_fn, params_np, mrds_np, reps: int):
+    """In-jit repetition chain (bench._pallas_chain methodology) around an
+    arbitrary (params, mrds) -> uint8 batch function."""
+    import jax
+    import jax.numpy as jnp
+
+    params = jnp.asarray(params_np, jnp.float32)
+    mrds = jnp.asarray(mrds_np, jnp.int32).reshape(-1, 1)
+
+    @jax.jit
+    def run(params):
+        s = jnp.sum(batch_fn(params, mrds).astype(jnp.int32),
+                    dtype=jnp.int32)
+        for _ in range(reps - 1):
+            params = params + (s & 1).astype(jnp.float32) * 1e-12
+            s = s + jnp.sum(batch_fn(params, mrds).astype(jnp.int32),
+                            dtype=jnp.int32)
+        return s
+
+    return lambda: run(params)
+
+
+def run(out_path: str, repeats: int = 3) -> dict:
+    import jax
+
+    assert jax.default_backend() == "tpu", (
+        f"compact hardware check needs the real chip (backend: "
+        f"{jax.default_backend()})")
+
+    from functools import partial
+
+    from distributedmandelbrot_tpu.ops.compact_escape import (
+        PHASE1_BUDGET, compact_escape_batch)
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        _pallas_escape_batch, fit_blocks)
+    from distributedmandelbrot_tpu.parallel.sharding import (
+        widen_square_pitch)
+    from bench import _grid_params, _time_chain
+
+    tile, k, mi = 1024, 16, 2000
+    assert 2 * PHASE1_BUDGET <= mi - 1
+    block_h, block_w = fit_blocks(tile, tile)
+    # The filament boundary window: deep straggler tails, no provable
+    # interior — the view class compaction exists for.
+    params = widen_square_pitch(
+        _grid_params((-0.7436447, 0.1318252), 2e-3, tile, k))
+
+    kw = dict(k=k, height=tile, width=tile, max_iter=mi, block_h=block_h,
+              block_w=block_w, cycle_check=False)
+    plain_fn = partial(_pallas_escape_batch, **kw)
+    compact_fn = partial(compact_escape_batch, **kw)
+
+    artifact: dict = {
+        "device": str(jax.devices()[0]), "jax_version": jax.__version__,
+        "view": {"center": (-0.7436447, 0.1318252), "span": 2e-3,
+                 "tile": tile, "k": k, "max_iter": mi},
+    }
+    try:
+        artifact["commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True).stdout.strip()
+    except Exception:
+        pass
+
+    import jax.numpy as jnp
+    mrds_u = np.full((k, 1), mi, np.int32)
+    a = np.asarray(compact_fn(jnp.asarray(params, jnp.float32),
+                              jnp.asarray(mrds_u)))
+    b = np.asarray(plain_fn(jnp.asarray(params, jnp.float32),
+                            jnp.asarray(mrds_u)))
+    artifact["identity_uniform"] = bool((a == b).all())
+    print(f"uniform-budget identity on hardware: "
+          f"{artifact['identity_uniform']} "
+          f"({(a != b).sum()} differing bytes)", flush=True)
+
+    # Mixed budgets exercise the per-tile dynamic-budget path through
+    # both phases (and the executable-sharing bucket).
+    mrds_m = np.asarray([[600, 1000, 2000, 1500][i % 4]
+                         for i in range(k)], np.int32).reshape(-1, 1)
+    am = np.asarray(compact_fn(jnp.asarray(params, jnp.float32),
+                               jnp.asarray(mrds_m)))
+    bm = np.asarray(plain_fn(jnp.asarray(params, jnp.float32),
+                             jnp.asarray(mrds_m)))
+    artifact["identity_mixed_budget"] = bool((am == bm).all())
+    print(f"mixed-budget identity on hardware: "
+          f"{artifact['identity_mixed_budget']} "
+          f"({(am != bm).sum()} differing bytes)", flush=True)
+
+    # Chained-delta perf: pure device time, tunnel excluded.
+    pixels = k * tile * tile
+    rows = {}
+    for name, fn in (("plain", plain_fn), ("compact", compact_fn)):
+        t1 = _time_chain(_chain(fn, params, mrds_u, 1), repeats)
+        t3 = _time_chain(_chain(fn, params, mrds_u, 3), repeats)
+        dev = (t3 - t1) / 2
+        rows[name] = {
+            "benched_mpix_s": round(pixels / t1 / 1e6, 1),
+            "device_mpix_s": round(pixels / dev / 1e6, 1)
+            if dev > 0.02 * t1 else None,
+        }
+        print(f"{name}: benched {rows[name]['benched_mpix_s']} Mpix/s, "
+              f"device {rows[name]['device_mpix_s']}", flush=True)
+    artifact["perf"] = rows
+    if rows["plain"]["device_mpix_s"] and rows["compact"]["device_mpix_s"]:
+        artifact["compact_vs_plain_device"] = round(
+            rows["compact"]["device_mpix_s"]
+            / rows["plain"]["device_mpix_s"], 3)
+
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {out_path}")
+    return artifact
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "COMPACT_HW_r05.json"))
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+    run(args.out, args.repeats)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,10 @@ def _views():
     return views
 
 GRID_FULL = {
+    # (32, 128) is the narrowest legal block: the uint8 store granule is
+    # 32 sublanes x 128 lanes (_fit_block's floor), so the straggler
+    # granule cannot shrink below it — the filament-residual hunt's
+    # lever is block_h 32 vs the shipped 64, plus the unroll.
     "block_h": [32, 64, 128, 256],
     "block_w": [128, 256],
     "unroll": [16, 32, 64],
@@ -68,6 +72,9 @@ def main() -> int:
     parser.add_argument("--xla", action="store_true",
                         help="also sweep the XLA path's segment size "
                              "(escape_loop's early-exit granularity)")
+    parser.add_argument("--views", default=None,
+                        help="comma-separated view-name filter "
+                             "(e.g. 'filament,ship')")
     parser.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "sweep_results.jsonl"))
     args = parser.parse_args()
@@ -83,11 +90,15 @@ def main() -> int:
 
     import numpy as np
 
-    from bench import _grid_params, _pallas_chain, _time_chain
+    from bench import (_device_fields, _grid_params, _pallas_chain,
+                       _time_chain)
 
     views = _views()
     if args.deep:
         views.append(("seahorse-d5000", (-0.738, 0.1), 0.02, 5000, False))
+    if args.views:
+        keep = set(args.views.split(","))
+        views = [v for v in views if v[0] in keep]
 
     grid = GRID_QUICK if args.quick else GRID_FULL
     combos = [dict(zip(grid, vals))
@@ -116,17 +127,28 @@ def main() -> int:
                     if burning:
                         kw["burning"] = True
                     try:
-                        t = _time_chain(
-                            _pallas_chain(params, tile, depth, **kw),
-                            args.repeats)
+                        # Chained-delta device timing (round-5 verdict
+                        # item 4): the 532 pre-round-5 rows in this file
+                        # are tunnel-inclusive wall clock, dominated by
+                        # the rig's ~70 ms per-call constant — useless
+                        # for choosing a block shape.  The objective is
+                        # now device_mpix_s; benched kept for context.
+                        df = _device_fields(
+                            lambda r, kw=kw: _pallas_chain(
+                                params, tile, depth, reps=r, **kw),
+                            pixels, args.repeats)
                     except Exception as e:
                         print(f"{name} {kw}: FAILED {type(e).__name__}: "
                               f"{e}", flush=True)
                         continue
-                    rate = pixels / t / 1e6
+                    rate = df.get("device_mpix_s", 0.0) or 0.0
                     rec = {"ts": stamp, "view": name, "depth": depth,
                            "tile": tile, "k": k, **kw,
-                           "mpix_s": round(rate, 2)}
+                           "mpix_s": df["benched_mpix_s"],
+                           "device_mpix_s": df.get("device_mpix_s"),
+                           "call_overhead_s": df.get("call_overhead_s"),
+                           "device_unresolved":
+                               df.get("device_unresolved", False)}
                     emit(out_f, rec)
                     key = f"{name}{'' if interior else ':raw'}"
                     if rate > best.get(key, (0.0, {}))[0]:
@@ -161,10 +183,10 @@ def main() -> int:
                     if rate > xla_best.get(name, (0.0, 0))[0]:
                         xla_best[name] = (rate, segment)
 
-    print("\n=== best per view (pallas) ===")
+    print("\n=== best per view (pallas, DEVICE rate) ===")
     for key in sorted(best):
         rate, rec = best[key]
-        print(f"{key:24s} {rate:8.1f} Mpix/s  "
+        print(f"{key:24s} {rate:8.1f} device Mpix/s  "
               f"bh={rec['block_h']} bw={rec['block_w']} "
               f"unroll={rec['unroll']}")
     if args.xla:
