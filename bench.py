@@ -86,6 +86,30 @@ def _time_chain(fn, repeats: int) -> float:
     return times[len(times) // 2]
 
 
+def _reps_chain(one_rep, params, reps: int):
+    """The ONE copy of the in-jit repetition idiom behind chained-delta
+    device timing: ``one_rep(params) -> int32 checksum`` is repeated
+    ``reps`` times inside a single jit with a data dependency between
+    repetitions — the addend is data-dependent (and numerically
+    sub-ulp), so XLA can neither fold it nor CSE the repeated dispatch,
+    and the checksum chain forces sequential device execution.  Used by
+    ``_pallas_chain`` and the hardware tools (tools/hw_compact.py) so
+    the methodology can never drift between bench rows and hardware
+    artifacts.  ``params`` must be float32."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(params):
+        s = one_rep(params)
+        for _ in range(reps - 1):
+            params = params + (s & 1).astype(jnp.float32) * 1e-12
+            s = s + one_rep(params)
+        return s
+
+    return lambda: run(params)
+
+
 def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
                   reps: int = 1, **kernel_kw):
     """One jitted call: lax.map of the Pallas kernel over K tiles,
@@ -140,18 +164,7 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
             return jnp.sum(out.astype(jnp.int32), dtype=jnp.int32)
         return jnp.sum(lax.map(one, params), dtype=jnp.int32)
 
-    @jax.jit
-    def run(params):
-        s = one_rep(params)
-        for _ in range(reps - 1):
-            # The addend is data-dependent (and numerically sub-ulp), so
-            # XLA can neither fold it nor CSE the repeated dispatch; the
-            # checksum chain forces sequential device execution.
-            params = params + (s & 1).astype(jnp.float32) * 1e-12
-            s = s + one_rep(params)
-        return s
-
-    return lambda: run(params)
+    return _reps_chain(one_rep, params, reps)
 
 
 # Measured dense-kernel ceiling of this chip, chained-delta methodology:

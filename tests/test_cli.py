@@ -1,6 +1,8 @@
 """CLI surface tests: the render paths (integer / smooth / julia / deep)
 and argument plumbing that e2e farm tests don't touch."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -436,3 +438,54 @@ def test_render_supersample_deep(tmp_path):
                    "--out", str(out)])
     assert rc == 0
     assert _png_size(out) == (48, 48)
+
+
+def test_enable_compile_cache_env_and_knob(tmp_path, monkeypatch):
+    """The default-on persistent XLA compilation cache (round 5): the
+    CLI points JAX_COMPILATION_CACHE_DIR at a writable default (or the
+    DMTPU_COMPILE_CACHE override), pushes the flags through
+    jax.config.update when a site hook imported jax before main(), and
+    DMTPU_COMPILE_CACHE=0 / a pre-set env disable it entirely."""
+    import sys
+
+    calls = {}
+
+    class _Cfg:
+        @staticmethod
+        def update(k, v):
+            calls[k] = v
+
+    class _FakeJax:
+        config = _Cfg()
+
+    cache_dir = tmp_path / "xc"
+    monkeypatch.setenv("DMTPU_COMPILE_CACHE", str(cache_dir))
+    # setenv-then-delenv so monkeypatch RECORDS prior absence and the
+    # teardown restores it even though _enable_compile_cache mutates
+    # os.environ directly (a bare delenv(raising=False) on an absent
+    # var records nothing, leaking the test's values into the session).
+    for var in ("JAX_COMPILATION_CACHE_DIR",
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+        monkeypatch.setenv(var, "sentinel")
+        monkeypatch.delenv(var)
+    monkeypatch.setitem(sys.modules, "jax", _FakeJax())
+    cli._enable_compile_cache()
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == str(cache_dir)
+    assert cache_dir.is_dir()
+    assert calls["jax_compilation_cache_dir"] == str(cache_dir)
+    assert calls["jax_persistent_cache_min_compile_time_secs"] == 0.1
+
+    # Pre-existing operator configuration wins untouched.
+    calls.clear()
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/operator/choice")
+    cli._enable_compile_cache()
+    assert not calls
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == "/operator/choice"
+
+    # Opt-out.
+    calls.clear()
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+    monkeypatch.setenv("DMTPU_COMPILE_CACHE", "0")
+    cli._enable_compile_cache()
+    assert not calls
+    assert "JAX_COMPILATION_CACHE_DIR" not in os.environ

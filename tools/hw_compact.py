@@ -34,25 +34,21 @@ sys.path.insert(0, REPO)
 
 
 def _chain(batch_fn, params_np, mrds_np, reps: int):
-    """In-jit repetition chain (bench._pallas_chain methodology) around an
-    arbitrary (params, mrds) -> uint8 batch function."""
-    import jax
+    """Chained-delta timing around an arbitrary (params, mrds) -> uint8
+    batch function, via the ONE shared repetition idiom
+    (``bench._reps_chain``)."""
     import jax.numpy as jnp
+
+    from bench import _reps_chain
 
     params = jnp.asarray(params_np, jnp.float32)
     mrds = jnp.asarray(mrds_np, jnp.int32).reshape(-1, 1)
 
-    @jax.jit
-    def run(params):
-        s = jnp.sum(batch_fn(params, mrds).astype(jnp.int32),
-                    dtype=jnp.int32)
-        for _ in range(reps - 1):
-            params = params + (s & 1).astype(jnp.float32) * 1e-12
-            s = s + jnp.sum(batch_fn(params, mrds).astype(jnp.int32),
-                            dtype=jnp.int32)
-        return s
+    def one_rep(p):
+        return jnp.sum(batch_fn(p, mrds).astype(jnp.int32),
+                       dtype=jnp.int32)
 
-    return lambda: run(params)
+    return _reps_chain(one_rep, params, reps)
 
 
 def run(out_path: str, repeats: int = 3) -> dict:

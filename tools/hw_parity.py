@@ -50,6 +50,10 @@ VIEWS = {
 SIDE = 256
 
 
+class _SkipControl(Exception):
+    """Internal: caller declined the CPU-control subprocess."""
+
+
 def _f32_grid(start_real: float, start_imag: float, span: float, side: int):
     """The in-kernel grid convention: f32 start + index * f32 step."""
     step = np.float32(span / (side - 1))
@@ -80,7 +84,7 @@ def _band_stats(got_u8: np.ndarray, want_u8: np.ndarray) -> dict:
     return out
 
 
-def run(out_path: str) -> dict:
+def run(out_path: str, *, cpu_control: bool = True) -> dict:
     import jax
 
     assert jax.default_backend() == "tpu", (
@@ -217,6 +221,8 @@ def run(out_path: str) -> dict:
         "    out[name]=int((t!=g).sum())\n"
         "print(json.dumps(out))\n")
     try:
+        if not cpu_control:
+            raise _SkipControl
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    PALLAS_AXON_POOL_IPS="",
                    PYTHONPATH=REPO + os.pathsep
@@ -229,6 +235,8 @@ def run(out_path: str) -> dict:
             artifact["views"][name]["f64_xla_cpu_control_count_mismatch"] \
                 = n
         print(f"cpu-xla f64 control count mismatches: {ctrl}")
+    except _SkipControl:
+        pass  # caller opted out (revalidate: control is artifact-only)
     except Exception as e:
         print(f"cpu control skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
