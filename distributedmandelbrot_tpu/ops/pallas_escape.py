@@ -117,6 +117,18 @@ def _params_row(spec: TileSpec, julia_c: complex | None = None) -> list:
 DEFAULT_BLOCK_H = 64
 DEFAULT_BLOCK_W = 128
 
+# Cycle-probe check cadence inside the unrolled segment (steps between
+# snapshot-equality checks).  Swept on live hardware, round 5, mi=8192
+# k=8x1024^2 device Mpix/s (ROUND5_NOTES.md): per-step (stride 1) won
+# the minibrot-interior view (2486) but taxed the escape-rich seahorse
+# 16-29% vs probe-off (251 on vs 298 off); per-segment (stride 64)
+# zeroed the tax (303) but cut the minibrot win to 487 (detection waits
+# for the doubling snapshot window to cover p/gcd(p,64)*64 iterations).
+# Stride 8 dominates BOTH: minibrot 2485 (ties per-step) and seahorse
+# 320 (beats per-step AND probe-off — the cheap checks still retire the
+# view's sparse in-set lanes); stride 16 measured 1419/307.
+CYCLE_STRIDE = 8
+
 # Escape-loop steps per while-iteration (between early-exit checks).
 # Each step is ~12 straight-line vector ops; the unroll amortizes the
 # scratch load/store and the live-count reduction.  Re-swept on live
@@ -216,7 +228,8 @@ def _load_block_coords(params_ref, mrd_ref, t, i, j, shape,
 def _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
                   live0, *, cond_cap, sat_steps, unroll: int,
                   cycle_check: bool, power: int, burning: bool,
-                  it0=None, dyn_ref=None):
+                  it0=None, dyn_ref=None,
+                  cycle_stride: int = CYCLE_STRIDE):
     """The ONE segmented escape while-loop, shared by the single-tile,
     batch-grid, phase-1 state, and compaction resume kernels — sharing
     this body is what makes every dispatch (and the two halves of a
@@ -262,8 +275,8 @@ def _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
         if cycle_check:
             # Brent-style snapshot refresh at doubling iteration gaps:
             # once the gap exceeds the orbit's (eventual, exact-f32)
-            # period, the per-step equality below fires within one
-            # period.  Scalar predicate -> vector select; refresh cost is
+            # period, the per-SEGMENT equality below fires (see note).
+            # Scalar predicate -> vector select; refresh cost is
             # per-segment, not per-step.
             do_snap = it >= next_snap
             szr_ref, szi_ref = snap_refs
@@ -272,7 +285,7 @@ def _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
             next_snap = jnp.where(do_snap, it + it, next_snap)
         zr2 = zr * zr
         zi2 = zi * zi
-        for _ in range(unroll):
+        for step in range(unroll):
             if power == 2:
                 # Cached-squares form.  The Burning Ship fold reduces to
                 # ONE extra abs here: squares are abs-invariant, so the
@@ -286,13 +299,31 @@ def _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
             zr2 = zr * zr
             zi2 = zi * zi
             act = jnp.where(zr2 + zi2 < four, act, 0)
-            if cycle_check:
-                # Exact periodicity: z identical (bitwise) to the
-                # snapshot means the orbit repeats forever and can never
-                # escape — saturate its count so it classifies in-set,
-                # the same value full iteration would produce, and
-                # retire the lane from the live count.  (inf/NaN lanes
-                # are already inactive; NaN != NaN keeps them inert.)
+            if cycle_check and ((step + 1) % cycle_stride == 0
+                                or step == unroll - 1):
+                # The final-step check makes completeness unroll-proof:
+                # clamped unrolls below/indivisible by the stride (tiny
+                # budgets clamp unroll to max_iter-1) still probe at
+                # every segment boundary, whose gaps walk k*unroll and
+                # hit 0 mod p within p/gcd(p, unroll) segments.
+                # Exact periodicity, checked every CYCLE_STRIDE steps
+                # (round 5 — the original per-step check cost ~6 extra
+                # vector ops on a ~10-op step body, a measured 16-29%
+                # tax on escape-rich deep views where the probe saves
+                # nothing; the stride is a STATIC Python condition in
+                # the unrolled body, so skipped steps cost zero).
+                # Detection still fires for every cycle: with the
+                # snapshot fixed, z at a check point equals it iff the
+                # elapsed gap is a multiple of the period p, and
+                # consecutive check points walk the gap through
+                # k*stride, which hits 0 mod p within p/gcd(p, stride)
+                # checks.  Detection is merely (boundedly) later, and
+                # timing is OUTPUT-INVARIANT: a cycling lane can never
+                # escape, its count saturates past the budget whenever
+                # it retires, and it classifies never-escaped (0)
+                # either way — the invariant the identity tests and
+                # hardware step 3c pin.  (inf/NaN lanes are already
+                # inactive; NaN != NaN keeps them inert.)
                 cyc = jnp.where((zr == szr) & (zi == szi), act, 0)
                 act = act - cyc
                 n = n + cyc * sat_steps
@@ -638,7 +669,7 @@ def _escape_pack_kernel(params_ref, mrd_ref, out_ref, *refs, n_states: int,
             next_snap = jnp.where(do_snap, it + it, next_snap)
         zr2 = [z * z for z in zr]
         zi2 = [z * z for z in zi]
-        for _ in range(unroll):
+        for step in range(unroll):
             if power == 2:
                 cross = [(zr[s] + zr[s]) * zi[s] for s in NS]
                 if burning:
@@ -654,7 +685,11 @@ def _escape_pack_kernel(params_ref, mrd_ref, out_ref, *refs, n_states: int,
             zr2 = [zr[s] * zr[s] for s in NS]
             zi2 = [zi[s] * zi[s] for s in NS]
             act = [jnp.where(zr2[s] + zi2[s] < four, act[s], 0) for s in NS]
-            if cycle_check:
+            if cycle_check and ((step + 1) % CYCLE_STRIDE == 0
+                                or step == unroll - 1):
+                # Strided probe cadence + unroll-proof boundary check —
+                # same trade and same output-invariance argument as
+                # _run_seg_loop (the measured 16-29% per-step tax).
                 cyc = [jnp.where((zr[s] == szr[s]) & (zi[s] == szi[s]),
                                  act[s], 0) for s in NS]
                 act = [act[s] - cyc[s] for s in NS]
@@ -881,7 +916,7 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
             szr = jnp.where(do_snap, zr, szr_ref[:])
             szi = jnp.where(do_snap, zi, szi_ref[:])
             next_snap = jnp.where(do_snap, it + it, next_snap)
-        for _ in range(unroll):
+        for step in range(unroll):
             nzr, nzi = family_step(zr, zi, c_real, c_imag, power=power,
                                    burning=burning)
             # Escaped-from-bailout lanes freeze — their z at the first
@@ -894,11 +929,16 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
             act_b = jnp.where(m2 < b2, act_b, 0)
             n = n + act_b
             act2 = jnp.where(m2 < four, act2, 0)
-            if cycle_check:
+            if cycle_check and ((step + 1) % CYCLE_STRIDE == 0
+                                or step == unroll - 1):
                 # act2 implies act_b (radius 2 clears before bailout), so
                 # the probe fires only on live orbits; saturating the
                 # radius-2 count classifies the lane in-set and retires
                 # it (see escape_loop for the exactness argument).
+                # Strided cadence + boundary check as in _run_seg_loop
+                # (output-invariant: a cycling lane's n2 saturates past
+                # the budget whenever it retires, and the nu=0 select
+                # discards its n/z entirely).
                 cyc = jnp.where((zr == szr) & (zi == szi), act2, 0)
                 act2 = act2 - cyc
                 act_b = act_b - cyc
