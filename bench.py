@@ -522,7 +522,8 @@ def bench_config3(repeats: int, segment: int) -> dict:
             #    block granule pays (executed / ideal lane-iterations);
             #  * the cycle probe's cost is isolated by an explicit
             #    on/off A/B at this config's own budget — NOT the
-            #    4095/4096 policy boundary, which also flips the
+            #    CYCLE_CHECK_MIN_ITER policy boundary, which at this
+            #    depth class can also flip the
             #    batch-grid dispatch mode and would confound the probe
             #    with the dispatch shape.
             from distributedmandelbrot_tpu.ops.pallas_escape import (

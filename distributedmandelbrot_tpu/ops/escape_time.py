@@ -93,12 +93,19 @@ def _validated_margin(dtype) -> float:
 
 # Budgets at/above this enable the Brent cycle probe by default (see
 # escape_loop): deep budgets are where in-set pixels missed by the closed
-# forms dominate; shallow budgets lose more to the probe's per-step
-# compares than they save.  The Pallas wrappers resolve the same policy
-# from the tile's REQUESTED budget (before bucket_cap padding), so a
-# shallow tile whose bucket rounds past this threshold never pays the
-# probe.
-CYCLE_CHECK_MIN_ITER = 4096
+# forms dominate.  Lowered 4096 -> 1024 in round 5: the threshold was
+# set when the Pallas probe compared every step (a measured 16-29% tax
+# on escape-rich views); with the strided cadence
+# (pallas_escape.CYCLE_STRIDE) the tax is 0-5% at mid budgets while
+# bounded-dynamics views gain ~9x (minibrot 8x1024^2 device Mpix/s at
+# mi=2000: 239 probe-off -> 2071 measured on the default policy after
+# this change — ROUND5_NOTES.md; filament -4.9%/+1.7% at mi=2000/3000)
+# — and farm grids at the reference's canonical mi=1024
+# contain exactly such minibrot tiles as their stragglers.  The Pallas
+# wrappers resolve the same policy from the tile's REQUESTED budget
+# (before bucket_cap padding), so a shallow tile whose bucket rounds
+# past this threshold never pays the probe.
+CYCLE_CHECK_MIN_ITER = 1024
 
 
 def resolve_cycle_check(cycle_check: bool | None, max_iter: int) -> bool:

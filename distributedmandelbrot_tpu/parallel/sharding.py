@@ -234,8 +234,10 @@ def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
                 compact_escape_batch)
             # cycle_check forwards the ALREADY-RESOLVED policy (from the
             # true cap): re-resolving against the bucketed compile cap
-            # would wrongly arm the probe for true caps 2049-4095 and
-            # reject the dispatch (round-4 review finding).
+            # would wrongly arm the probe for true caps just below
+            # CYCLE_CHECK_MIN_ITER whose bucket rounds past it (the
+            # 513-1023 band since round 5) and reject the dispatch
+            # (round-4 review finding).
             return compact_escape_batch(
                 p_shard, m_shard[:, None].astype(jnp.int32), k=k_loc,
                 height=definition, width=definition, max_iter=max_iter_cap,

@@ -132,7 +132,7 @@ def test_sharded_dispatch_opt_in(monkeypatch):
     test_capacity_and_policy) and stays byte-identical to the default
     route.  The budget buckets past the probe threshold (true cap 700
     -> compile cap 1024), exercising the already-resolved cycle_check
-    forwarding; the 2049-4095 bucket slice is covered directly in
+    forwarding; the explicit-cap slice is covered directly in
     test_bucketed_cap_forwards_resolved_probe."""
     import distributedmandelbrot_tpu.ops.compact_escape as CE
     from distributedmandelbrot_tpu.parallel import tile_mesh
@@ -161,10 +161,12 @@ def test_sharded_dispatch_opt_in(monkeypatch):
 
 
 def test_bucketed_cap_forwards_resolved_probe():
-    """True caps 2049-4095 bucket to the 4096 compile cap; the dispatch
-    must forward the probe policy resolved from the TRUE cap (False)
-    rather than re-resolving against the bucketed cap, which would arm
-    the probe and reject the whole slice (round-4 review finding)."""
+    """True caps below CYCLE_CHECK_MIN_ITER that bucket to a compile
+    cap at/above it (since round 5 the live band is 513-1023 -> bucket
+    1024; here exercised at an explicit 4096 cap): the dispatch must
+    forward the probe policy resolved from the TRUE cap (False) rather
+    than re-resolving against the bucketed cap, which would arm the
+    probe and reject the whole slice (round-4 review finding)."""
     params = jnp.asarray([BOUNDARY], jnp.float32)
     mrds = jnp.asarray([[300]], jnp.int32)  # cheap per-lane budget
     ref = np.asarray(_pallas_escape_batch(
@@ -189,7 +191,7 @@ def test_env_opt_in_parses():
 
     code = ("import distributedmandelbrot_tpu.ops.compact_escape as CE;"
             "print(CE._COMPACT_OPTED_IN and "
-            "CE.prefer_compaction(2000, 1 << 24))")
+            "CE.prefer_compaction(900, 1 << 24))")
     env = dict(os.environ, DMTPU_COMPACT="1", JAX_PLATFORMS="cpu",
                PALLAS_AXON_POOL_IPS="")
     out = subprocess.run([sys.executable, "-c", code], env=env,
@@ -207,12 +209,16 @@ def test_capacity_and_policy():
     assert compact_capacity(100) == 32 * 128
     assert compact_capacity(4097 * 4) % (32 * 128) == 0
     import distributedmandelbrot_tpu.ops.compact_escape as CE
-    assert not prefer_compaction(2000, 1 << 24)  # no opt-in
+    assert not prefer_compaction(900, 1 << 24)  # no opt-in
     try:
         CE._COMPACT_OPTED_IN = True
-        assert prefer_compaction(2000, 1 << 24)
+        assert prefer_compaction(900, 1 << 24)
+        assert not prefer_compaction(2000, 1 << 24)   # probe class (r5:
+        # the strided probe's threshold dropped to 1024, shrinking the
+        # opt-in band to 513..1023 — at probe-class budgets the default
+        # dispatch carries the probe, which the resume kernel cannot)
         assert not prefer_compaction(8192, 1 << 24)   # probe class
         assert not prefer_compaction(300, 1 << 24)    # fits phase 1
-        assert not prefer_compaction(2000, 1 << 10)   # too few pixels
+        assert not prefer_compaction(900, 1 << 10)    # too few pixels
     finally:
         CE._COMPACT_OPTED_IN = False

@@ -430,7 +430,7 @@ def test_pallas_first_propagates_non_unsupported_errors(monkeypatch):
 
 def test_cycle_probe_follows_requested_budget(monkeypatch):
     """The Brent probe resolves from the tile's ACTUAL budget, not the
-    bucketed compile cap: max_iter=3000 buckets to a 4096 cap (>= the
+    bucketed compile cap: max_iter=600 buckets to a 1024 cap (>= the
     probe threshold) but must not pay the probe (round-2 advisor
     finding)."""
     from distributedmandelbrot_tpu.ops import pallas_escape as pe
@@ -448,8 +448,8 @@ def test_cycle_probe_follows_requested_budget(monkeypatch):
     # Sky-only view: every pixel escapes in the first segment, so the
     # deep budget costs nothing in interpret mode.
     spec = TileSpec(1.5, 1.5, 0.1, 0.1, width=128, height=32)
-    pe.compute_tile_pallas_device(spec, 3000, interpret=True)
-    assert seen["max_iter"] == pe.bucket_cap(3000) >= CYCLE_CHECK_MIN_ITER
+    pe.compute_tile_pallas_device(spec, 600, interpret=True)
+    assert seen["max_iter"] == pe.bucket_cap(600) >= CYCLE_CHECK_MIN_ITER
     assert seen["cycle_check"] is False
     pe.compute_tile_pallas_device(spec, CYCLE_CHECK_MIN_ITER,
                                   interpret=True)
