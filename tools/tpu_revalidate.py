@@ -81,11 +81,18 @@ def main() -> int:
 
     step("3. compile-cap sharing")
     before = _pallas_escape._cache_size()
-    compute_tile_pallas(spec, 900)   # same 1024 bucket as 1000
-    compute_tile_pallas(spec, 1024)  # same bucket
-    shared = _pallas_escape._cache_size() == before
+    compute_tile_pallas(spec, 900)   # same 1024 bucket as 1000, same
+    shared = _pallas_escape._cache_size() == before  # probe policy (off)
     print("bucket shared:", shared)
     assert shared
+    # Since round 5 the probe threshold sits AT this bucket (1024): a
+    # budget of exactly 1024 arms the probe, so it must compile a
+    # SECOND executable for the same cap — policy resolves from the
+    # true budget, and the two variants may not be conflated.
+    compute_tile_pallas(spec, 1024)
+    split = _pallas_escape._cache_size() == before + 1
+    print("probe-armed 1024 split:", split)
+    assert split
 
     step("3b. pallas smooth kernel")
     from distributedmandelbrot_tpu.ops.pallas_escape import (
