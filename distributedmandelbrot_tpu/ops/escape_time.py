@@ -94,17 +94,16 @@ def _validated_margin(dtype) -> float:
 # Budgets at/above this enable the Brent cycle probe by default (see
 # escape_loop): deep budgets are where in-set pixels missed by the closed
 # forms dominate.  Lowered 4096 -> 1024 in round 5: the threshold was
-# set when the Pallas probe compared every step (a measured 16-29% tax
-# on escape-rich views); with the strided cadence
-# (pallas_escape.CYCLE_STRIDE) the tax is 0-5% at mid budgets while
-# bounded-dynamics views gain ~9x (minibrot 8x1024^2 device Mpix/s at
-# mi=2000: 239 probe-off -> 2071 measured on the default policy after
-# this change — ROUND5_NOTES.md; filament -4.9%/+1.7% at mi=2000/3000)
-# — and farm grids at the reference's canonical mi=1024
-# contain exactly such minibrot tiles as their stragglers.  The Pallas
-# wrappers resolve the same policy from the tile's REQUESTED budget
-# (before bucket_cap padding), so a shallow tile whose bucket rounds
-# past this threshold never pays the probe.
+# set when the probe compared every step (a measured 16-29% Pallas /
+# up-to-55% XLA tax on escape-rich views); with the strided cadence
+# (CYCLE_STRIDE below, shared by the XLA and Pallas loops) the tax is
+# 0-5% at mid budgets while bounded-dynamics views gain ~9x (minibrot
+# 8x1024^2 device Mpix/s at mi=2000: 239 probe-off -> 2071 on the
+# default policy; ROUND5_NOTES.md) — and farm grids at the reference's
+# canonical mi=1024 contain exactly such minibrot tiles as their
+# stragglers.  The Pallas wrappers resolve the same policy from the
+# tile's REQUESTED budget (before bucket_cap padding), so a shallow
+# tile whose bucket rounds past this threshold never pays the probe.
 CYCLE_CHECK_MIN_ITER = 1024
 
 
@@ -113,19 +112,50 @@ def resolve_cycle_check(cycle_check: bool | None, max_iter: int) -> bool:
             else cycle_check)
 
 
-def unrolled_steps(step_fn, state, segment: int, max_unroll: int = MAX_UNROLL):
+# Cycle-probe check cadence (steps between snapshot-equality checks),
+# shared by the XLA loops here and the Pallas kernels.  Swept on live
+# hardware in round 5 (ROUND5_NOTES.md): the per-step check cost ~4-6
+# extra vector ops on an ~8-10-op step body — a measured 16-29% tax on
+# the Pallas path and up to 55% on the XLA path (seahorse mi=2000 XLA:
+# 42.6 probe-on vs 93.6 off benched; it even LOST on the
+# minibrot-interior view, 54.6 vs 63.1, because the cost runs from step
+# 1 while detection waits for convergence).  Stride 8 on the Pallas
+# sweep dominated both view classes (minibrot 2485 device Mpix/s = the
+# per-step value; seahorse 320 vs per-step 251 and probe-off 298).
+# Detection stays complete: check-point gaps walk k*stride (and
+# k*chunk at chunk boundaries), hitting 0 mod p within p/gcd checks —
+# merely boundedly later, which is output-invariant (a cycling lane's
+# count saturates past the budget whenever it retires).
+CYCLE_STRIDE = 8
+
+
+def probe_step(k: int, chunk_len: int) -> bool:
+    """STATIC predicate: does the cycle probe fire after unrolled step
+    ``k`` of a ``chunk_len``-step chunk?  Every CYCLE_STRIDE steps, plus
+    the chunk's last step so clamped/indivisible chunks keep the
+    completeness guarantee.  One copy for the XLA and Pallas loops."""
+    return (k + 1) % CYCLE_STRIDE == 0 or k == chunk_len - 1
+
+
+def unrolled_steps(step_fn, state, segment: int, max_unroll: int = MAX_UNROLL,
+                   indexed: bool = False):
     """Apply ``step_fn`` ``segment`` times: fori_loop over full
-    ``max_unroll``-step unrolled chunks, remainder unrolled flat."""
+    ``max_unroll``-step unrolled chunks, remainder unrolled flat.
+
+    ``indexed=True`` calls ``step_fn(state, k, chunk_len)`` with the
+    STATIC position inside the current unrolled chunk, so strided
+    per-step work (the cycle probe) keys off it at zero dynamic cost."""
+    call = step_fn if indexed else (lambda s, k, ln: step_fn(s))
     full, rem = divmod(segment, max_unroll)
     if full:
         def chunk(_, s):
-            for _ in range(max_unroll):
-                s = step_fn(s)
+            for k in range(max_unroll):
+                s = call(s, k, max_unroll)
             return s
         state = lax.fori_loop(0, full, chunk, state) if full > 1 else \
             chunk(0, state)
-    for _ in range(rem):
-        state = step_fn(state)
+    for k in range(rem):
+        state = call(state, k, rem)
     return state
 
 
@@ -240,7 +270,7 @@ def brent_snap_hook(state, it):
 
 
 def segmented_while(one_step, state, *, total_steps: int, segment: int,
-                    active_of, seg_hook=None):
+                    active_of, seg_hook=None, indexed: bool = False):
     """Run ``one_step`` in fixed-trip unrolled segments under a
     ``lax.while_loop`` until the iteration budget is spent or
     ``active_of(state)`` is all-False (tile-granular early exit).  The last
@@ -250,7 +280,9 @@ def segmented_while(one_step, state, *, total_steps: int, segment: int,
 
     ``seg_hook(state, it) -> state``, if given, runs once at the top of
     each segment (used for the cycle-probe snapshot refresh — per-segment
-    cost instead of per-step)."""
+    cost instead of per-step).  ``indexed`` forwards to
+    :func:`unrolled_steps` (static step positions for the strided
+    probe)."""
     segment = max(1, min(segment, total_steps))
 
     def segment_body(carry):
@@ -258,7 +290,8 @@ def segmented_while(one_step, state, *, total_steps: int, segment: int,
         if seg_hook is not None:
             s = seg_hook(s, it)
         # Fixed-trip segment; unroll capped so compile time stays bounded.
-        return (unrolled_steps(one_step, s, segment), it + segment)
+        return (unrolled_steps(one_step, s, segment, indexed=indexed),
+                it + segment)
 
     def segment_cond(carry):
         s, it = carry
@@ -320,7 +353,7 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
     """
     four = jnp.asarray(4.0, jnp.result_type(zr0))
 
-    def one_step(state):
+    def one_step(state, k=0, chunk_len=1):
         if cycle_check:
             zr, zi, zr2, zi2, active, n, szr, szi, next_snap = state
         else:
@@ -331,8 +364,9 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
         zi2 = zi * zi
         active = active & (zr2 + zi2 < four)
         if cycle_check:
-            active, n, _ = cycle_probe_update(zr, zi, szr, szi, active, n,
-                                              total_steps)
+            if probe_step(k, chunk_len):  # strided cadence (CYCLE_STRIDE)
+                active, n, _ = cycle_probe_update(zr, zi, szr, szi, active,
+                                                  n, total_steps)
             n = n + active.astype(jnp.int32)
             return (zr, zi, zr2, zi2, active, n, szr, szi, next_snap)
         n = n + active.astype(jnp.int32)
@@ -350,7 +384,8 @@ def escape_loop(zr0, zi0, c_real, c_imag, *, total_steps: int, segment: int,
     state = segmented_while(
         one_step, init, total_steps=total_steps, segment=segment,
         active_of=lambda s: s[4],
-        seg_hook=brent_snap_hook if cycle_check else None)
+        seg_hook=brent_snap_hook if cycle_check else None,
+        indexed=True)
     return counts_from_survival(state[5], total_steps)
 
 
@@ -394,7 +429,7 @@ def escape_loop_generic(step_fn, zr0, zi0, *, total_steps: int, segment: int,
     """
     four = jnp.asarray(4.0, jnp.result_type(zr0))
 
-    def one_step(state):
+    def one_step(state, k=0, chunk_len=1):
         if cycle_check:
             zr, zi, active, n, szr, szi, next_snap = state
         else:
@@ -402,8 +437,9 @@ def escape_loop_generic(step_fn, zr0, zi0, *, total_steps: int, segment: int,
         zr, zi = step_fn(zr, zi)
         active = active & (zr * zr + zi * zi < four)
         if cycle_check:
-            active, n, _ = cycle_probe_update(zr, zi, szr, szi, active, n,
-                                              total_steps)
+            if probe_step(k, chunk_len):  # strided cadence (CYCLE_STRIDE)
+                active, n, _ = cycle_probe_update(zr, zi, szr, szi, active,
+                                                  n, total_steps)
             n = n + active.astype(jnp.int32)
             return (zr, zi, active, n, szr, szi, next_snap)
         n = n + active.astype(jnp.int32)
@@ -420,7 +456,8 @@ def escape_loop_generic(step_fn, zr0, zi0, *, total_steps: int, segment: int,
     state = segmented_while(
         one_step, init, total_steps=total_steps, segment=segment,
         active_of=lambda s: s[2],
-        seg_hook=brent_snap_hook if cycle_check else None)
+        seg_hook=brent_snap_hook if cycle_check else None,
+        indexed=True)
     return counts_from_survival(state[3], total_steps)
 
 
@@ -644,7 +681,7 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
     four = jnp.asarray(4.0, dtype)
     b2 = jnp.asarray(bailout * bailout, dtype)
 
-    def one_step(state):
+    def one_step(state, k=0, chunk_len=1):
         if cycle_check:
             zr, zi, active, n, bounded2, n2, szr, szi, next_snap = state
         else:
@@ -662,14 +699,15 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
         # in-set classification matches escape_counts exactly.
         bounded2 = bounded2 & (m2 < four)
         if cycle_check:
-            # bounded2 implies still-active (radius 2 clears before the
-            # bailout radius), so the probe only ever fires on live,
-            # still-iterating orbits.  Saturating n2 classifies the lane
-            # in-set; the frozen z it leaves behind is discarded by the
-            # output branch.
-            bounded2, n2, cyc = cycle_probe_update(zr, zi, szr, szi,
-                                                   bounded2, n2, total_steps)
-            active = active & ~cyc
+            if probe_step(k, chunk_len):  # strided cadence (CYCLE_STRIDE)
+                # bounded2 implies still-active (radius 2 clears before
+                # the bailout radius), so the probe only ever fires on
+                # live, still-iterating orbits.  Saturating n2
+                # classifies the lane in-set; the frozen z it leaves
+                # behind is discarded by the output branch.
+                bounded2, n2, cyc = cycle_probe_update(
+                    zr, zi, szr, szi, bounded2, n2, total_steps)
+                active = active & ~cyc
             n2 = n2 + bounded2.astype(jnp.int32)
             return (zr, zi, active, n, bounded2, n2, szr, szi, next_snap)
         n2 = n2 + bounded2.astype(jnp.int32)
@@ -702,7 +740,8 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
     state = segmented_while(
         one_step, init, total_steps=total_steps + extra, segment=segment,
         active_of=lambda s: s[2],
-        seg_hook=brent_snap_hook if cycle_check else None)
+        seg_hook=brent_snap_hook if cycle_check else None,
+        indexed=True)
     zr, zi, active, n, bounded2, n2 = state[:6]
 
     # Frozen |z_e| is in [bailout, ~bailout^2 + |c|) — one squaring past

@@ -41,9 +41,9 @@ import numpy as np
 from jax import lax
 
 from distributedmandelbrot_tpu.core.geometry import TileSpec
-from distributedmandelbrot_tpu.ops.escape_time import (family_interior,
-                                                       family_step,
-                                                       resolve_cycle_check)
+from distributedmandelbrot_tpu.ops.escape_time import (
+    CYCLE_STRIDE,  # noqa: F401 — re-export: the constant lived here in r5
+    family_interior, family_step, probe_step, resolve_cycle_check)
 
 def _pallas():
     """Import pallas lazily: on some builds the import itself fails unless
@@ -117,17 +117,10 @@ def _params_row(spec: TileSpec, julia_c: complex | None = None) -> list:
 DEFAULT_BLOCK_H = 64
 DEFAULT_BLOCK_W = 128
 
-# Cycle-probe check cadence inside the unrolled segment (steps between
-# snapshot-equality checks).  Swept on live hardware, round 5, mi=8192
-# k=8x1024^2 device Mpix/s (ROUND5_NOTES.md): per-step (stride 1) won
-# the minibrot-interior view (2486) but taxed the escape-rich seahorse
-# 16-29% vs probe-off (251 on vs 298 off); per-segment (stride 64)
-# zeroed the tax (303) but cut the minibrot win to 487 (detection waits
-# for the doubling snapshot window to cover p/gcd(p,64)*64 iterations).
-# Stride 8 dominates BOTH: minibrot 2485 (ties per-step) and seahorse
-# 320 (beats per-step AND probe-off — the cheap checks still retire the
-# view's sparse in-set lanes); stride 16 measured 1419/307.
-CYCLE_STRIDE = 8
+# The cycle-probe cadence constant (CYCLE_STRIDE) and the strided
+# check-point predicate (probe_step) are canonical in escape_time.py —
+# ONE copy of the policy for the XLA loops and all three Pallas loop
+# bodies, with the round-5 hardware sweep numbers documented there.
 
 # Escape-loop steps per while-iteration (between early-exit checks).
 # Each step is ~12 straight-line vector ops; the unroll amortizes the
@@ -228,8 +221,7 @@ def _load_block_coords(params_ref, mrd_ref, t, i, j, shape,
 def _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
                   live0, *, cond_cap, sat_steps, unroll: int,
                   cycle_check: bool, power: int, burning: bool,
-                  it0=None, dyn_ref=None,
-                  cycle_stride: int = CYCLE_STRIDE):
+                  it0=None, dyn_ref=None):
     """The ONE segmented escape while-loop, shared by the single-tile,
     batch-grid, phase-1 state, and compaction resume kernels — sharing
     this body is what makes every dispatch (and the two halves of a
@@ -299,8 +291,7 @@ def _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
             zr2 = zr * zr
             zi2 = zi * zi
             act = jnp.where(zr2 + zi2 < four, act, 0)
-            if cycle_check and ((step + 1) % cycle_stride == 0
-                                or step == unroll - 1):
+            if cycle_check and probe_step(step, unroll):
                 # The final-step check makes completeness unroll-proof:
                 # clamped unrolls below/indivisible by the stride (tiny
                 # budgets clamp unroll to max_iter-1) still probe at
@@ -685,8 +676,7 @@ def _escape_pack_kernel(params_ref, mrd_ref, out_ref, *refs, n_states: int,
             zr2 = [zr[s] * zr[s] for s in NS]
             zi2 = [zi[s] * zi[s] for s in NS]
             act = [jnp.where(zr2[s] + zi2[s] < four, act[s], 0) for s in NS]
-            if cycle_check and ((step + 1) % CYCLE_STRIDE == 0
-                                or step == unroll - 1):
+            if cycle_check and probe_step(step, unroll):
                 # Strided probe cadence + unroll-proof boundary check —
                 # same trade and same output-invariance argument as
                 # _run_seg_loop (the measured 16-29% per-step tax).
@@ -929,8 +919,7 @@ def _smooth_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
             act_b = jnp.where(m2 < b2, act_b, 0)
             n = n + act_b
             act2 = jnp.where(m2 < four, act2, 0)
-            if cycle_check and ((step + 1) % CYCLE_STRIDE == 0
-                                or step == unroll - 1):
+            if cycle_check and probe_step(step, unroll):
                 # act2 implies act_b (radius 2 clears before bailout), so
                 # the probe fires only on live orbits; saturating the
                 # radius-2 count classifies the lane in-set and retires
