@@ -22,7 +22,7 @@ reported as-is.
 
 Usage: python bench.py [--tile 1024] [--tiles N] [--max-iter 1000]
                        [--dtype f32] [--repeats 3] [--all] [--farm]
-                       [--worst] [--tileshape] [--deep-slow]
+                       [--serve] [--worst] [--tileshape] [--deep-slow]
 """
 
 from __future__ import annotations
@@ -1044,6 +1044,115 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
     return out
 
 
+def bench_serve(repeats: int, *, levels: str = "2:256",
+                backend_name: str = "auto", storm_clients: int = 16,
+                warm_fetches: int = 32) -> dict:
+    """Serving-gateway shape: coordinator + gateway + one worker, measured
+    from the client side of the wire.  Three scenarios:
+
+    - cold miss: one fetch of an uncomputed tile — the full compute-on-read
+      path (prioritize -> farm compute -> persist -> promote -> serve);
+    - warm hit: repeated fetches of a cached tile — the tier-1 ceiling
+      (decoded-tile LRU, no store traffic);
+    - coalesced storm: N concurrent clients for one tile that is on disk
+      but not in tier 1 — single-flight fan-out of one store read.
+
+    Tile payloads ride the real TCP loopback, so warm numbers include the
+    codec + socket cost a production viewer would pay."""
+    import tempfile
+    import threading
+
+    from distributedmandelbrot_tpu.cli import parse_level_settings
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+    from distributedmandelbrot_tpu.viewer import DataClient, FetchStatus
+    from distributedmandelbrot_tpu.worker import (DistributerClient, Worker,
+                                                  auto_backend)
+
+    settings = parse_level_settings(levels)
+    n_tiles = sum(s.level * s.level for s in settings)
+    level = settings[0].level
+    hot = (level, level - 1, level - 1)  # last in the frontier walk
+    storm_tile = (level, 0, min(1, level - 1))
+
+    with tempfile.TemporaryDirectory() as tmp, \
+            EmbeddedCoordinator(tmp, settings) as co:
+        if backend_name == "auto":
+            backend = auto_backend()
+        else:
+            from distributedmandelbrot_tpu.cli import _make_backend
+            backend = _make_backend(backend_name, "f32", "auto")
+        stop = threading.Event()
+        worker = Worker(DistributerClient("127.0.0.1", co.distributer_port),
+                        backend, overlap_io=False)
+        wt = threading.Thread(target=worker.run_forever,
+                              kwargs=dict(poll_interval=0.02, stop=stop),
+                              daemon=True)
+        wt.start()
+        try:
+            client = DataClient("127.0.0.1", co.gateway_port, timeout=600)
+            # Cold miss: the hot tile is last in the frontier, so this
+            # latency is compute-on-read's queue jump, not frontier luck.
+            t0 = time.perf_counter()
+            _, status = client.fetch(*hot)
+            cold_s = time.perf_counter() - t0
+            assert status is FetchStatus.OK, status
+            # Warm hits: tier-1 fan-out of the tile just promoted.
+            warm_rates = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(warm_fetches):
+                    _, status = client.fetch(*hot)
+                    assert status is FetchStatus.OK, status
+                dt = time.perf_counter() - t0
+                warm_rates.append(_mpix(warm_fetches * CHUNK_PIXELS, dt))
+            warm_rates.sort()
+            warm_mpix = warm_rates[len(warm_rates) // 2]
+            # Storm: wait for the farm to finish so the storm tile is on
+            # disk (tier 2) but has never been fetched (not in tier 1).
+            co.wait_saves_settled(expected_accepted=n_tiles, timeout=600)
+            barrier = threading.Barrier(storm_clients + 1)
+            errors: list = []
+
+            def storm():
+                try:
+                    c = DataClient("127.0.0.1", co.gateway_port, timeout=600)
+                    barrier.wait()
+                    _, s = c.fetch(*storm_tile)
+                    assert s is FetchStatus.OK, s
+                    c.close()
+                except BaseException as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=storm, daemon=True)
+                       for _ in range(storm_clients)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join(timeout=600)
+            storm_s = time.perf_counter() - t0
+            assert not errors, errors[:2]
+            cc = co.counters.snapshot()
+        finally:
+            stop.set()
+            wt.join(timeout=60)
+
+    return {"metric": f"serve gateway {levels} warm-hit tier-1 fan-out "
+                      f"({type(backend).__name__} farm behind)",
+            "value": round(warm_mpix, 2), "unit": "Mpix/s",
+            "cold_miss_s": round(cold_s, 3),
+            "warm_hit_qps": round(warm_mpix * 1e6 / CHUNK_PIXELS, 1),
+            "storm_clients": storm_clients,
+            "storm_wall_s": round(storm_s, 3),
+            "storm_mpix_s": round(
+                _mpix(storm_clients * CHUNK_PIXELS, storm_s), 2),
+            "coalesce_leaders": cc.get("coalesce_leaders", 0),
+            "coalesce_followers": cc.get("coalesce_followers", 0),
+            "tile_cache_hits": cc.get("tile_cache_hits", 0)}
+
+
 def _ensure_live_backend(probe_timeout: float = 120.0) -> bool:
     """Guard against a dead accelerator tunnel: on this rig the TPU is
     reached through a network tunnel whose failure mode is jax backend
@@ -1084,6 +1193,9 @@ def main() -> int:
                         help="compute backend for the farm config; 'native' "
                              "is the no-device control that isolates "
                              "framework overhead from tunnel/device cost")
+    parser.add_argument("--serve", action="store_true",
+                        help="run only the serving-gateway config "
+                             "(cold-miss, warm-hit, coalesced-storm)")
     parser.add_argument("--worst", action="store_true",
                         help="run only the worst-case boundary-view config "
                              "(raw vs shortcut per view)")
@@ -1108,6 +1220,10 @@ def main() -> int:
         emit(bench_farm(args.repeats, backend_name=args.farm_backend))
         return 0
 
+    if args.serve:
+        emit(bench_serve(args.repeats, backend_name=args.farm_backend))
+        return 0
+
     if args.worst:
         emit(bench_worstcase(args.repeats))
         return 0
@@ -1130,7 +1246,8 @@ def main() -> int:
                    bench_deepslow,
                    bench_worstcase,
                    bench_tileshape,
-                   bench_farm):
+                   bench_farm,
+                   bench_serve):
             try:
                 emit(fn(args.repeats))
             except Exception as e:  # finish the sweep, but fail the run

@@ -549,6 +549,83 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
     return 0
 
 
+def cmd_serve(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu serve",
+        description="Run a serving coordinator: Distributer + DataServer + "
+                    "tile gateway (cache, coalescing, compute-on-read, "
+                    "admission control).")
+    parser.add_argument("-l", "--levels", required=True,
+                        help="level:max_iter[,level:max_iter...]")
+    parser.add_argument("-o", "--data-dir", default="",
+                        help="parent directory for Data/ (default: cwd)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--distributer-port", type=int,
+                        default=proto.DEFAULT_DISTRIBUTER_PORT)
+    parser.add_argument("--dataserver-port", type=int,
+                        default=proto.DEFAULT_DATASERVER_PORT)
+    parser.add_argument("--gateway-port", type=int,
+                        default=proto.DEFAULT_GATEWAY_PORT)
+    parser.add_argument("--lease-timeout", type=float,
+                        default=proto.DEFAULT_LEASE_TIMEOUT)
+    parser.add_argument("--sweep-period", type=float,
+                        default=proto.DEFAULT_SWEEP_PERIOD)
+    parser.add_argument("--fsync-index", action="store_true")
+    parser.add_argument("--read-timeout", type=float,
+                        default=proto.DEFAULT_READ_TIMEOUT)
+    parser.add_argument("--no-read-timeout", action="store_true")
+    parser.add_argument("--stats-period", type=float, default=60.0)
+    parser.add_argument("--cache-tiles", type=int, default=256,
+                        help="decoded-tile LRU capacity, in tiles")
+    parser.add_argument("--max-queue-depth", type=int, default=1024,
+                        help="max queries in service before shedding "
+                             "with OVERLOADED")
+    parser.add_argument("--rate", type=float, default=None,
+                        help="token-bucket refill rate in queries/s "
+                             "(default: unlimited)")
+    parser.add_argument("--burst", type=float, default=256.0,
+                        help="token-bucket capacity (burst size)")
+    parser.add_argument("--ondemand-deadline", type=float,
+                        default=proto.DEFAULT_ONDEMAND_DEADLINE,
+                        help="seconds a miss may wait for the farm to "
+                             "compute the tile before NOT_AVAILABLE")
+    parser.add_argument("--no-info-log", action="store_true")
+    _add_common(parser)
+    args = parser.parse_args(argv)
+    _configure_logging(args)
+
+    from distributedmandelbrot_tpu.coordinator import Coordinator
+    from distributedmandelbrot_tpu.storage.ownership import LevelOwnedError
+    from distributedmandelbrot_tpu.storage.store import DataDirError
+
+    settings = parse_level_settings(args.levels)
+    try:
+        coordinator = Coordinator(
+            settings, data_dir_parent=args.data_dir, host=args.host,
+            distributer_port=args.distributer_port,
+            dataserver_port=args.dataserver_port,
+            lease_timeout=args.lease_timeout, sweep_period=args.sweep_period,
+            read_timeout=None if args.no_read_timeout else args.read_timeout,
+            fsync_index=args.fsync_index, stats_period=args.stats_period,
+            gateway_port=args.gateway_port,
+            gateway_cache_tiles=args.cache_tiles,
+            gateway_max_queue_depth=args.max_queue_depth,
+            gateway_rate=args.rate, gateway_burst=args.burst,
+            ondemand_deadline=args.ondemand_deadline)
+    except (DataDirError, LevelOwnedError) as e:
+        raise SystemExit(f"dmtpu serve: {e}")
+    total = coordinator.scheduler.total_tiles
+    done = coordinator.scheduler.completed_count
+    print(f"serve: {len(settings)} level(s), {total} tiles "
+          f"({done} already complete on disk); gateway on port "
+          f"{args.gateway_port}", flush=True)
+    try:
+        asyncio.run(coordinator.run_forever())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def _make_backend(name: str, dtype: str | None, kernel: str = "auto",
                   definition: int | None = None):
     # dtype None = unpinned: auto picks per platform (native f64 on CPU,
@@ -1100,7 +1177,7 @@ def cmd_compact(argv: Sequence[str]) -> int:
 
 
 COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
-            "viewer": cmd_viewer, "render": cmd_render,
+            "serve": cmd_serve, "viewer": cmd_viewer, "render": cmd_render,
             "animate": cmd_animate, "compact": cmd_compact}
 
 
@@ -1121,8 +1198,17 @@ def _enable_compile_cache() -> None:
     here."""
     import os
     knob = os.environ.get("DMTPU_COMPILE_CACHE", "")
-    if knob == "0" or os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    if knob == "0":
         return
+    ambient = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if ambient:
+        if not knob or os.path.abspath(knob) == os.path.abspath(ambient):
+            return  # ambient setting already does what was asked
+        # An explicit DMTPU knob outranks an inherited ambient setting —
+        # silently ignoring the more specific instruction cost a round-5
+        # operator a cold cache.
+        print(f"dmtpu: DMTPU_COMPILE_CACHE={knob} overrides ambient "
+              f"JAX_COMPILATION_CACHE_DIR={ambient}", file=sys.stderr)
     path = knob or os.path.join(os.path.expanduser("~"), ".cache",
                                 "dmtpu", "xla")
     try:
@@ -1149,7 +1235,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
-              "{coordinator|worker|viewer|render|animate|compact} "
+              "{coordinator|worker|serve|viewer|render|animate|compact} "
               "[options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2

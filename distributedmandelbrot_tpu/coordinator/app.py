@@ -19,6 +19,9 @@ from distributedmandelbrot_tpu.coordinator.distributer import Distributer
 from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
 from distributedmandelbrot_tpu.core.workload import LevelSetting
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.serve.cache import DecodedTileCache
+from distributedmandelbrot_tpu.serve.gateway import TileGateway
+from distributedmandelbrot_tpu.serve.ondemand import OnDemandComputer
 from distributedmandelbrot_tpu.storage.ownership import LevelClaims
 from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils.metrics import Counters
@@ -37,7 +40,14 @@ class Coordinator:
                  read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
                  clock: Optional[Clock] = None,
                  fsync_index: bool = False,
-                 stats_period: float = 0.0) -> None:
+                 stats_period: float = 0.0,
+                 gateway_port: Optional[int] = None,
+                 gateway_cache_tiles: int = 64,
+                 gateway_max_queue_depth: int = 1024,
+                 gateway_rate: Optional[float] = None,
+                 gateway_burst: float = 256.0,
+                 ondemand_deadline: float = proto.DEFAULT_ONDEMAND_DEADLINE) \
+            -> None:
         self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index)
         # Fail loudly if another live coordinator owns any of our levels
         # on this data dir (reference: the static claimed-levels set,
@@ -66,6 +76,25 @@ class Coordinator:
                                          port=dataserver_port,
                                          read_timeout=read_timeout,
                                          counters=self.counters)
+            # The serving gateway is opt-in (gateway_port=None disables);
+            # when enabled it shares the store, scheduler, and counters,
+            # and hooks the distributer's save path for compute-on-read
+            # arrival notification.
+            self.gateway: Optional[TileGateway] = None
+            if gateway_port is not None:
+                cache = DecodedTileCache(self.store,
+                                         capacity=gateway_cache_tiles,
+                                         counters=self.counters)
+                ondemand = OnDemandComputer(self.scheduler, cache,
+                                            deadline=ondemand_deadline,
+                                            counters=self.counters)
+                self.distributer.on_chunk_saved = ondemand.notify_saved
+                self.gateway = TileGateway(
+                    cache, ondemand=ondemand, host=host, port=gateway_port,
+                    read_timeout=read_timeout,
+                    max_queue_depth=gateway_max_queue_depth,
+                    rate=gateway_rate, burst=gateway_burst,
+                    counters=self.counters)
         except BaseException:
             # Construction failed after the claim: release it, or the
             # level stays locked by this live process forever.
@@ -78,6 +107,8 @@ class Coordinator:
         try:
             await self.distributer.start()
             await self.dataserver.start()
+            if self.gateway is not None:
+                await self.gateway.start()
         except BaseException:
             # A failed startup (e.g. port already bound) will never reach
             # stop(): shut down whichever service DID start — a
@@ -90,6 +121,8 @@ class Coordinator:
             try:
                 await self.distributer.stop()
                 await self.dataserver.stop()
+                if self.gateway is not None:
+                    await self.gateway.stop()
             except Exception:
                 logger.exception("cleanup after failed startup")
             finally:
@@ -110,6 +143,11 @@ class Coordinator:
                 # services below from shutting down.
                 logger.exception("stats task had failed")
         try:
+            # Gateway first: its in-flight requests read through the store
+            # and await distributer saves, so it should stop serving before
+            # the services it depends on go away.
+            if self.gateway is not None:
+                await self.gateway.stop()
             await self.distributer.stop()
             await self.dataserver.stop()
         finally:
@@ -152,3 +190,7 @@ class Coordinator:
     @property
     def dataserver_port(self) -> int:
         return self.dataserver.port
+
+    @property
+    def gateway_port(self) -> Optional[int]:
+        return None if self.gateway is None else self.gateway.port

@@ -49,7 +49,8 @@ class Distributer:
                  port: int = proto.DEFAULT_DISTRIBUTER_PORT,
                  sweep_period: float = proto.DEFAULT_SWEEP_PERIOD,
                  read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
-                 counters: Optional[Counters] = None) -> None:
+                 counters: Optional[Counters] = None,
+                 on_chunk_saved=None) -> None:
         self.scheduler = scheduler
         self.store = store
         self.host = host
@@ -57,6 +58,10 @@ class Distributer:
         self.sweep_period = sweep_period
         self.read_timeout = read_timeout
         self.counters = counters if counters is not None else Counters()
+        # Optional ``callback(key)`` fired on this event loop after a chunk
+        # is durably persisted — the gateway's on-demand path hangs its
+        # arrival notification here.
+        self.on_chunk_saved = on_chunk_saved
         self._server: Optional[asyncio.Server] = None
         self._sweep_task: Optional[asyncio.Task] = None
         self._save_tasks: set[asyncio.Task] = set()
@@ -236,6 +241,12 @@ class Distributer:
                               int((time.monotonic() - t0) * 1e6))
             self.counters.inc("chunks_saved")
             logger.info("saved chunk %s", chunk.key)
+            if self.on_chunk_saved is not None:
+                try:
+                    self.on_chunk_saved(chunk.key)
+                except Exception:
+                    # A notification bug must not reopen a saved tile.
+                    logger.exception("on_chunk_saved callback failed")
         except Exception:
             # The result's bytes are lost; reopen the tile so it is granted
             # again rather than leaving a silent hole in a "complete" run.

@@ -23,7 +23,8 @@ class EmbeddedCoordinator:
 
     def __init__(self, data_dir_parent: str, level_settings, *,
                  lease_timeout: float = 3600.0, sweep_period: float = 300.0,
-                 read_timeout: float | None = _UNSET, clock=None) -> None:
+                 read_timeout: float | None = _UNSET, clock=None,
+                 gateway: bool = True, **gateway_kwargs) -> None:
         self._ready = threading.Event()
         self._stop: asyncio.Event | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -32,6 +33,13 @@ class EmbeddedCoordinator:
                             host="127.0.0.1", distributer_port=0,
                             dataserver_port=0, lease_timeout=lease_timeout,
                             sweep_period=sweep_period, clock=clock)
+        # The embedded form serves tests and benches, so the gateway is on
+        # by default (ephemeral port).  gateway_kwargs passes the admission
+        # knobs straight through (gateway_max_queue_depth, gateway_rate,
+        # gateway_burst, gateway_cache_tiles, ondemand_deadline).
+        if gateway:
+            self._kwargs["gateway_port"] = 0
+        self._kwargs.update(gateway_kwargs)
         if read_timeout is not _UNSET:
             self._kwargs["read_timeout"] = read_timeout
         self._level_settings = level_settings
@@ -74,6 +82,10 @@ class EmbeddedCoordinator:
     @property
     def dataserver_port(self) -> int:
         return self.coordinator.dataserver_port
+
+    @property
+    def gateway_port(self) -> int | None:
+        return self.coordinator.gateway_port
 
     @property
     def scheduler(self):

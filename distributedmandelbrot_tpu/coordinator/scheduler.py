@@ -211,7 +211,11 @@ class TileScheduler:
             return False
         if w.key not in self._completed:
             self._completed.add(w.key)
-            self._remaining -= 1
+            if self._in_grid(w.key):
+                # Only configured-grid tiles count toward is_complete();
+                # a foreign key slipping through the claim path must not
+                # drive _remaining negative and end the run early.
+                self._remaining -= 1
         return True
 
     def release_claim(self, w: Workload, token: int) -> None:
@@ -231,6 +235,26 @@ class TileScheduler:
         """
         token = self.claim(w)
         return token is not None and self.finish_claim(w, token)
+
+    def prioritize(self, w: Workload) -> bool:
+        """Move a tile to the front of the grant order (compute-on-read).
+
+        Returns False for tiles this run cannot produce (out of grid) and
+        for tiles already completed (the caller should read the store).
+        Returns True when the tile is either queued at the frontier head or
+        already in flight under an unexpired lease/claim — in both cases a
+        result is expected, so the caller may await its arrival.
+
+        A duplicate in the retry queue is harmless: grants re-check
+        ``_grantable`` at pop time, so stale entries are skipped.
+        """
+        if not self._in_grid(w.key):
+            return False
+        if w.key in self._completed:
+            return False
+        if self._grantable(w, self.clock.now()):
+            self._retry.appendleft(w)
+        return True
 
     def reopen(self, w: Workload) -> None:
         """Un-complete a tile whose persistence failed so it is granted again.

@@ -47,13 +47,28 @@ RESPONSE_REJECT = 0x21
 QUERY_ACCEPT = 0x00
 QUERY_REJECT = 0x01
 QUERY_NOT_AVAILABLE = 0x02
+# Gateway extension: admission control shed the request (token bucket dry
+# or serve queue saturated).  Clients should back off and retry; the legacy
+# DataServer never emits this, so reference-protocol clients are unaffected.
+QUERY_OVERLOADED = 0x03
+
+# Gateway batched multi-tile request: a query whose first u32 is this magic
+# is a batch header (u32 count + count x 12-byte queries), not a legacy
+# query.  The value is an impossible level (a level-4294967295 grid), so
+# the two framings can never collide.
+GATEWAY_BATCH_MAGIC = 0xFFFFFFFF
 
 DEFAULT_DISTRIBUTER_PORT = 59010
 DEFAULT_DATASERVER_PORT = 59011
+DEFAULT_GATEWAY_PORT = 59012
 
 # Scheduling defaults (reference: Distributer.cs:22,24 — 1 h lease, 5 min sweep)
 DEFAULT_LEASE_TIMEOUT = 3600.0
 DEFAULT_SWEEP_PERIOD = 300.0
+
+# Gateway on-demand compute: how long a read request may wait for the farm
+# to compute a missing tile before it is answered NOT_AVAILABLE.
+DEFAULT_ONDEMAND_DEADLINE = 120.0
 
 # Socket read deadline (reference: a 100 ms per-recv timeout on every client
 # socket, CLI-toggleable — Distributer.cs:17, DataServer.cs:11,

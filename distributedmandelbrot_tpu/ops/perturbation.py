@@ -877,7 +877,10 @@ def _compute_perturb(spec: DeepTileSpec, max_iter: int, scan_fn, *,
     # silently render wrong pixels.  Cheap spot check, not a full scan.
     # A data-contract check in library code, so a real raise (assert
     # would vanish under python -O and let every pixel render wrong).
-    if not ((dre[0] == dre[-1]).all() and (dim[:, 0] == dim[:, -1]).all()):
+    # Full-array comparison: first-vs-last row/column alone would miss
+    # interior-only jitter (e.g. a supersampling pattern that perturbs
+    # every row but the edges).
+    if not ((dre == dre[0]).all() and (dim == dim[:, :1]).all()):
         raise ValueError(
             "delta_grids produced a non-separable grid; the vector-upload "
             "broadcast path requires dre to vary by column only and dim "

@@ -1,0 +1,111 @@
+"""Tier-1 decoded-tile cache in front of the store's byte-payload LRU.
+
+The store already keeps a serialized-payload LRU (:class:`ChunkStore`'s
+``load_payload`` cache) so hot reads skip the index scan + file read +
+re-encode.  The gateway adds a second, richer tier on top of it: an LRU of
+:class:`CachedTile` entries holding the wire payload *and* (lazily) the
+decoded pixel array, keyed like the store on ``(level, index_real,
+index_imag)``.  A tier-1 hit serves a query with zero store traffic; a
+tier-1 miss that the store satisfies *promotes* the payload into tier 1.
+
+Every movement is counted through :class:`~distributedmandelbrot_tpu.utils.
+metrics.Counters` (``tile_cache_hits`` / ``tile_cache_misses`` /
+``tile_cache_evictions`` / ``tile_cache_promotions``) so the serving bench
+and the load-shed policy can see the cache working.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from distributedmandelbrot_tpu.core.chunk import Chunk
+from distributedmandelbrot_tpu.storage.store import ChunkStore
+from distributedmandelbrot_tpu.utils.metrics import Counters
+
+Key = tuple[int, int, int]
+
+
+class CachedTile:
+    """One resident tile: the wire payload, pixels decoded on first use."""
+
+    __slots__ = ("payload", "_pixels", "_decode_lock")
+
+    def __init__(self, payload: bytes) -> None:
+        self.payload = payload
+        self._pixels: Optional[np.ndarray] = None
+        self._decode_lock = threading.Lock()
+
+    @property
+    def pixels(self) -> np.ndarray:
+        """Decoded flat uint8 pixels, cached after the first decode."""
+        with self._decode_lock:
+            if self._pixels is None:
+                self._pixels = Chunk.deserialize_data(self.payload)
+                self._pixels.setflags(write=False)
+            return self._pixels
+
+
+class DecodedTileCache:
+    """LRU of :class:`CachedTile` over a :class:`ChunkStore`.
+
+    Thread-safe: the gateway's event loop reads inline while store lookups
+    run on worker threads.  ``capacity`` is in tiles (payloads are codec-
+    compressed, so byte-exact accounting would punish exactly the cheap
+    Never/Immediate tiles worth keeping resident).
+    """
+
+    def __init__(self, store: ChunkStore, *, capacity: int = 64,
+                 counters: Optional[Counters] = None) -> None:
+        self.store = store
+        self.capacity = capacity
+        self.counters = counters if counters is not None else Counters()
+        self._entries: OrderedDict[Key, CachedTile] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- tier 1 (no I/O) --------------------------------------------------
+
+    def get_cached(self, key: Key) -> Optional[CachedTile]:
+        """Tier-1 lookup only; never touches the store."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.counters.inc("tile_cache_misses")
+                return None
+            self._entries.move_to_end(key)
+            self.counters.inc("tile_cache_hits")
+            return entry
+
+    def put(self, key: Key, payload: bytes) -> CachedTile:
+        """Insert/refresh a tile, evicting LRU entries past capacity."""
+        entry = CachedTile(payload)
+        if self.capacity <= 0:
+            return entry
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.counters.inc("tile_cache_evictions")
+        return entry
+
+    # -- tier 1 -> tier 2 (store; blocking I/O) ---------------------------
+
+    def load(self, key: Key) -> Optional[CachedTile]:
+        """Tier-1 lookup, falling through to the store (payload LRU, then
+        disk) and promoting what it finds.  Blocking — call off-loop."""
+        entry = self.get_cached(key)
+        if entry is not None:
+            return entry
+        payload = self.store.load_payload(*key)
+        if payload is None:
+            return None
+        self.counters.inc("tile_cache_promotions")
+        return self.put(key, payload)

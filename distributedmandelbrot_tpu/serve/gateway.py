@@ -1,0 +1,256 @@
+"""Asyncio tile-serving gateway: cache + coalesce + compute-on-read.
+
+The read-side front door for heavy traffic.  Speaks two framings on one
+port:
+
+- **Legacy query** — the reference DataServer's 12-byte ``<III`` ``(level,
+  index_real, index_imag)`` query, answered with a status byte and, on
+  accept, a u32-length-prefixed codec payload.  Existing viewers work
+  against the gateway unmodified.
+- **Batched query** — a query whose first u32 is
+  :data:`~distributedmandelbrot_tpu.net.protocol.GATEWAY_BATCH_MAGIC`
+  (an impossible level) is instead ``magic, count, count x 12-byte
+  queries``; the reply is ``count`` single-query responses in request
+  order.  Items resolve concurrently, so a batch of neighbours rides the
+  coalescer and the store's readahead instead of serializing round trips.
+
+On top of the :class:`DataServer` semantics the gateway adds:
+
+- a tier-1 decoded-tile LRU (:mod:`.cache`) over the store's payload LRU,
+- single-flight coalescing (:mod:`.coalesce`) so a stampede on one tile
+  costs one store read / one farm compute,
+- compute-on-read (:mod:`.ondemand`): a miss for a tile the run is
+  configured to render is injected at the scheduler's frontier head and
+  the response waits (bounded by a deadline) for the worker upload,
+- admission control: a token bucket on request rate plus a cap on
+  concurrently serving queries; rejected work gets an explicit
+  ``QUERY_OVERLOADED`` byte instead of an unbounded queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+from typing import Callable, Optional
+
+from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.net import framing
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.serve.cache import DecodedTileCache
+from distributedmandelbrot_tpu.serve.coalesce import SingleFlight
+from distributedmandelbrot_tpu.serve.ondemand import OnDemandComputer
+from distributedmandelbrot_tpu.utils.metrics import Counters
+
+logger = logging.getLogger("dmtpu.gateway")
+
+_QUERY = struct.Struct("<III")
+
+MAX_BATCH_QUERIES = 4096  # mirrors the distributer's MAX_BATCH bound
+
+
+class TokenBucket:
+    """Classic token bucket; ``rate=None`` (or <= 0) admits everything."""
+
+    def __init__(self, rate: Optional[float], burst: float, *,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.rate = rate
+        self.burst = float(burst)
+        self._clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        if self.rate is None or self.rate <= 0:
+            return True
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+
+class TileGateway:
+    """The serving front door.  One instance per coordinator event loop.
+
+    ``max_queue_depth`` caps queries in service at once (load shedding);
+    ``rate``/``burst`` feed the token bucket.  Both default to permissive
+    values — the embedded coordinator's tests dial them down.
+    """
+
+    def __init__(self, cache: DecodedTileCache, *,
+                 ondemand: Optional[OnDemandComputer] = None,
+                 host: str = "0.0.0.0",
+                 port: int = proto.DEFAULT_GATEWAY_PORT,
+                 read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
+                 max_queue_depth: int = 1024,
+                 rate: Optional[float] = None,
+                 burst: float = 256.0,
+                 counters: Optional[Counters] = None) -> None:
+        self.cache = cache
+        self.ondemand = ondemand
+        self.host = host
+        self.port = port
+        self.read_timeout = read_timeout
+        self.max_queue_depth = max_queue_depth
+        self.counters = counters if counters is not None else Counters()
+        self.bucket = TokenBucket(rate, burst)
+        self.singleflight = SingleFlight(self.counters)
+        # Compute-on-read needs the depth the run renders each level at;
+        # the scheduler's work definition is the source of truth.
+        self._level_max_iter: dict[int, int] = {}
+        if ondemand is not None:
+            self._level_max_iter = {
+                s.level: s.max_iter
+                for s in ondemand.scheduler.level_settings}
+        self._active = 0
+        self._server: Optional[asyncio.Server] = None
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("gateway listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        # Connections may be parked in an on-demand wait (minutes); cancel
+        # them rather than letting wait_closed() (3.12+: waits for all
+        # handlers) stall shutdown for the deadline.
+        for task in list(self._conn_tasks):
+            task.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        flights = self.singleflight.cancel_inflight()
+        if flights:
+            await asyncio.gather(*flights, return_exceptions=True)
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    # -- connection handling ----------------------------------------------
+
+    async def _read(self, coro):
+        if self.read_timeout is None:
+            return await coro
+        return await asyncio.wait_for(coro, self.read_timeout)
+
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    first = await self._read(framing.read_u32(reader))
+                except (ConnectionError, TimeoutError, asyncio.TimeoutError):
+                    break  # clean EOF / idle close between queries
+                if first == proto.GATEWAY_BATCH_MAGIC:
+                    await self._serve_batch(reader, writer)
+                else:
+                    rest = await self._read(framing.read_exact(reader, 8))
+                    index_real, index_imag = struct.unpack("<II", rest)
+                    status, payload = await self._resolve_admitted(
+                        first, index_real, index_imag)
+                    self._write_response(writer, status, payload)
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            logger.exception("error serving %s", peer)
+        finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _serve_batch(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        count = await self._read(framing.read_u32(reader))
+        if count == 0 or count > MAX_BATCH_QUERIES:
+            raise framing.ProtocolError(f"bad batch count {count}")
+        raw = await self._read(framing.read_exact(reader, count * _QUERY.size))
+        queries = [_QUERY.unpack_from(raw, n * _QUERY.size)
+                   for n in range(count)]
+        self.counters.inc("gateway_batches")
+        # Resolve concurrently — neighbours coalesce and overlap their
+        # store reads — but reply strictly in request order.
+        results = await asyncio.gather(
+            *(self._resolve_admitted(*q) for q in queries))
+        for status, payload in results:
+            self._write_response(writer, status, payload)
+
+    def _write_response(self, writer: asyncio.StreamWriter, status: int,
+                        payload: Optional[bytes]) -> None:
+        framing.write_byte(writer, status)
+        if status == proto.QUERY_ACCEPT:
+            assert payload is not None
+            framing.write_u32(writer, len(payload))
+            writer.write(payload)
+
+    # -- the serve path ---------------------------------------------------
+
+    async def _resolve_admitted(
+            self, level: int, index_real: int,
+            index_imag: int) -> tuple[int, Optional[bytes]]:
+        """Admission control, then resolve; returns (status, payload)."""
+        self.counters.inc("gateway_queries")
+        if level < 1 or level == proto.GATEWAY_BATCH_MAGIC \
+                or index_real >= level or index_imag >= level:
+            self.counters.inc("gateway_rejected")
+            return proto.QUERY_REJECT, None
+        # Tier-1 hits are answered before admission: they cost no I/O and
+        # no compute, so shedding them would only push load onto retries.
+        entry = self.cache.get_cached((level, index_real, index_imag))
+        if entry is not None:
+            self.counters.inc("gateway_served")
+            return proto.QUERY_ACCEPT, entry.payload
+        if self._active >= self.max_queue_depth or not self.bucket.try_acquire():
+            self.counters.inc("gateway_overloaded")
+            logger.info("shed query (%d,%d,%d): %d in service",
+                        level, index_real, index_imag, self._active)
+            return proto.QUERY_OVERLOADED, None
+        self._active += 1
+        try:
+            payload = await self._resolve(level, index_real, index_imag)
+        finally:
+            self._active -= 1
+        if payload is None:
+            self.counters.inc("gateway_unavailable")
+            return proto.QUERY_NOT_AVAILABLE, None
+        self.counters.inc("gateway_served")
+        return proto.QUERY_ACCEPT, payload
+
+    async def _resolve(self, level: int, index_real: int,
+                       index_imag: int) -> Optional[bytes]:
+        """Store lookup falling through to compute-on-read, single-flight
+        per full workload identity ``(level, max_iter, i, j)``."""
+        key = (level, index_real, index_imag)
+        max_iter = self._level_max_iter.get(level)
+        flight_key = (level, max_iter, index_real, index_imag)
+
+        async def supplier() -> Optional[bytes]:
+            entry = await asyncio.to_thread(self.cache.load, key)
+            if entry is None and self.ondemand is not None \
+                    and max_iter is not None:
+                entry = await self.ondemand.compute(
+                    Workload(level, max_iter, index_real, index_imag))
+                if entry is not None:
+                    # Promote the fresh tile so follow-up requests are
+                    # tier-1 hits, not store reads.
+                    entry = self.cache.put(key, entry.payload)
+            return None if entry is None else entry.payload
+
+        return await self.singleflight.run(flight_key, supplier)

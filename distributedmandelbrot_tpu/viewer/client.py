@@ -32,6 +32,15 @@ class FetchStatus(enum.Enum):
     OK = "ok"
     NOT_AVAILABLE = "not_available"
     REJECTED = "rejected"
+    # Gateway only: admission control shed the request — back off and retry.
+    OVERLOADED = "overloaded"
+
+
+_STATUS_BY_BYTE = {
+    proto.QUERY_NOT_AVAILABLE: FetchStatus.NOT_AVAILABLE,
+    proto.QUERY_REJECT: FetchStatus.REJECTED,
+    proto.QUERY_OVERLOADED: FetchStatus.OVERLOADED,
+}
 
 
 class DataClient:
@@ -77,13 +86,43 @@ class DataClient:
                     ) -> tuple[Optional[np.ndarray], FetchStatus]:
         sock = self._connected()
         framing.send_all(sock, _QUERY.pack(level, index_real, index_imag))
+        return self._read_response(sock)
+
+    def _read_response(self, sock: socket.socket
+                       ) -> tuple[Optional[np.ndarray], FetchStatus]:
         status = framing.recv_byte(sock)
-        if status == proto.QUERY_NOT_AVAILABLE:
-            return None, FetchStatus.NOT_AVAILABLE
-        if status == proto.QUERY_REJECT:
-            return None, FetchStatus.REJECTED
+        miss = _STATUS_BY_BYTE.get(status)
+        if miss is not None:
+            return None, miss
         if status != proto.QUERY_ACCEPT:
             raise framing.ProtocolError(f"unknown query status {status:#x}")
         length = framing.recv_u32(sock)
         payload = framing.recv_exact(sock, length)
         return Chunk.deserialize_data(payload), FetchStatus.OK
+
+    def fetch_many(self, queries: list[tuple[int, int, int]]
+                   ) -> list[tuple[Optional[np.ndarray], FetchStatus]]:
+        """Batched fetch (gateway extension): one round trip for N tiles.
+
+        Sends ``GATEWAY_BATCH_MAGIC, count, count x 12-byte queries`` and
+        reads ``count`` standard responses back in request order.  Only
+        gateways understand this framing — a legacy DataServer would read
+        the magic as a (rejected) level — so point it at the gateway port.
+        """
+        if not queries:
+            return []
+        try:
+            return self._fetch_many_once(queries)
+        except (ConnectionError, OSError):
+            self.close()
+            return self._fetch_many_once(queries)
+
+    def _fetch_many_once(self, queries: list[tuple[int, int, int]]
+                         ) -> list[tuple[Optional[np.ndarray], FetchStatus]]:
+        sock = self._connected()
+        request = bytearray()
+        request += struct.pack("<II", proto.GATEWAY_BATCH_MAGIC, len(queries))
+        for level, index_real, index_imag in queries:
+            request += _QUERY.pack(level, index_real, index_imag)
+        framing.send_all(sock, bytes(request))
+        return [self._read_response(sock) for _ in queries]

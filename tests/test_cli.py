@@ -475,8 +475,17 @@ def test_enable_compile_cache_env_and_knob(tmp_path, monkeypatch):
     assert calls["jax_compilation_cache_dir"] == str(cache_dir)
     assert calls["jax_persistent_cache_min_compile_time_secs"] == 0.1
 
-    # Pre-existing operator configuration wins untouched.
+    # The explicit DMTPU knob outranks an inherited ambient setting (the
+    # more specific instruction must not be silently ignored)...
     calls.clear()
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/operator/choice")
+    cli._enable_compile_cache()
+    assert os.environ["JAX_COMPILATION_CACHE_DIR"] == str(cache_dir)
+    assert calls["jax_compilation_cache_dir"] == str(cache_dir)
+
+    # ...but with no DMTPU knob, ambient configuration wins untouched.
+    calls.clear()
+    monkeypatch.delenv("DMTPU_COMPILE_CACHE")
     monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/operator/choice")
     cli._enable_compile_cache()
     assert not calls

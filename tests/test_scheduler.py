@@ -317,3 +317,54 @@ def test_drain_at_level_512_scale_with_flat_grant_cost():
         assert sched.is_complete()
     dt = time.perf_counter() - t0
     assert dt < 0.5, f"10k is_complete() polls took {dt:.2f}s (not O(1))"
+
+
+def test_prioritize_moves_tile_to_front_of_grant_order():
+    """Compute-on-read: a prioritized tile is granted before the frontier
+    walk's natural next tile, and duplicates in the retry queue are
+    harmless (re-checked at grant time)."""
+    sched, _ = make()
+    hot = Workload(2, 64, 1, 1)  # naturally last in the level-2 walk
+    assert sched.prioritize(hot)
+    assert sched.prioritize(hot)  # idempotent (dup entry skipped at grant)
+    assert sched.acquire().key == hot.key
+    assert sched.acquire().key == (2, 0, 0)  # frontier resumes normally
+
+
+def test_prioritize_rejects_out_of_grid_and_completed():
+    sched, _ = make()
+    assert not sched.prioritize(Workload(9, 64, 0, 0))  # foreign level
+    w = sched.acquire()
+    assert sched.complete(w)
+    assert not sched.prioritize(w)  # already done: read the store instead
+
+
+def test_prioritize_inflight_tile_is_awaitable_not_requeued():
+    """A tile under an unexpired lease is already being computed: the
+    caller may await it, and no duplicate retry entry is planted that
+    would re-grant it to a second worker."""
+    sched, _ = make()
+    w = sched.acquire()
+    assert sched.prioritize(w)  # True: arrival is imminent
+    remaining = {sched.acquire().key for _ in range(3)}
+    assert w.key not in remaining  # not re-granted while leased
+    assert sched.acquire() is None
+
+
+def test_finish_claim_foreign_key_cannot_corrupt_remaining():
+    """A key outside the configured grid must never decrement _remaining
+    and fire is_complete() early (ADVICE round-5 finding).  White-box: a
+    foreign lease cannot arise through acquire(), so inject one."""
+    from distributedmandelbrot_tpu.coordinator.scheduler import Lease
+
+    sched, clock = make(levels=((1, 64),))
+    stray = Workload(7, 64, 3, 3)
+    sched._leases[stray.key] = Lease(stray, clock.now() + 3600.0)
+    token = sched.claim(stray)
+    assert token is not None
+    assert sched.finish_claim(stray, token)
+    assert sched.completed_count == 0  # grid untouched
+    assert not sched.is_complete()  # the single level-1 tile is still open
+    w = sched.acquire()
+    assert sched.complete(w)
+    assert sched.is_complete()
