@@ -949,6 +949,22 @@ def bench_tileshape(repeats: int) -> dict:
     }
 
 
+def _hist_fields(registry, fields: dict) -> dict:
+    """p50/p99 rows from a metrics Registry's histogram families — the
+    ONE copy of the field-naming rule, shared by the farm and serve
+    configs so BENCH artifacts stay comparable round over round.
+    Families with no observations are omitted, not zero-filled."""
+    out = {}
+    for key, family in fields.items():
+        p50 = registry.family_percentile(family, 50)
+        if p50 is None:
+            continue
+        out[f"{key}_p50_s"] = round(p50, 6)
+        out[f"{key}_p99_s"] = round(registry.family_percentile(family, 99),
+                                    6)
+    return out
+
+
 def bench_farm(repeats: int, *, levels: str = "3:1000",
                definition: int = 4096, batch_size: int = 3,
                backend_name: str = "auto") -> dict:
@@ -1004,6 +1020,13 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
         total = time.perf_counter() - t0
         wc = worker.counters.snapshot()
         cc = co.counters.snapshot()
+        from distributedmandelbrot_tpu.obs import names as obs_names
+        hist = _hist_fields(co.registry, {
+            "grant": obs_names.HIST_GRANT_SECONDS,
+            "persist": obs_names.HIST_PERSIST_SECONDS})
+        hist.update(_hist_fields(worker.counters.registry, {
+            "compute": obs_names.HIST_WORKER_COMPUTE_SECONDS,
+            "upload": obs_names.HIST_WORKER_UPLOAD_SECONDS}))
         phase1 = dict(getattr(backend, "phase_us", {}))
         backend_cls = type(backend).__name__
 
@@ -1041,6 +1064,7 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
              - phase0.get("materialize", 0)) / 1e6, 2)
     out["device_idle_frac"] = round(
         max(0.0, 1.0 - phases["compute"] / total), 3) if total else 0.0
+    out.update(hist)
     return out
 
 
@@ -1135,6 +1159,15 @@ def bench_serve(repeats: int, *, levels: str = "2:256",
             storm_s = time.perf_counter() - t0
             assert not errors, errors[:2]
             cc = co.counters.snapshot()
+            # Client-observed latency from the gateway's own histogram
+            # (all outcomes merged), plus the tier hit-ratio gauges —
+            # the acceptance signal that the telemetry pipeline saw the
+            # same traffic the bench generated.
+            from distributedmandelbrot_tpu.obs import names as obs_names
+            hist = _hist_fields(co.registry, {
+                "gateway": obs_names.HIST_GATEWAY_REQUEST_SECONDS})
+            gauges = co.registry.snapshot()["gauges"]
+            tier1 = gauges.get(obs_names.GAUGE_TIER1_HIT_RATIO, 0.0)
         finally:
             stop.set()
             wt.join(timeout=60)
@@ -1150,7 +1183,9 @@ def bench_serve(repeats: int, *, levels: str = "2:256",
                 _mpix(storm_clients * CHUNK_PIXELS, storm_s), 2),
             "coalesce_leaders": cc.get("coalesce_leaders", 0),
             "coalesce_followers": cc.get("coalesce_followers", 0),
-            "tile_cache_hits": cc.get("tile_cache_hits", 0)}
+            "tile_cache_hits": cc.get("tile_cache_hits", 0),
+            "tier1_hit_ratio": round(tier1, 3),
+            **hist}
 
 
 def _ensure_live_backend(probe_timeout: float = 120.0) -> bool:

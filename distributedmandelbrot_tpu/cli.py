@@ -17,6 +17,7 @@ import argparse
 import asyncio
 import logging
 import sys
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -505,6 +506,10 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
     parser.add_argument("--stats-period", type=float, default=60.0,
                         help="seconds between progress/throughput log "
                              "lines (0 disables)")
+    parser.add_argument("--exporter-port", type=int,
+                        default=proto.DEFAULT_EXPORTER_PORT,
+                        help="HTTP metrics port (/metrics, /varz, "
+                             "/healthz); 0 = ephemeral, -1 disables")
     # Per-channel log toggles (reference: -dli/-dle/-sli/-sle,
     # Program.cs:305-325,362-381).
     parser.add_argument("--distributer-log-info", choices=["true", "false"],
@@ -533,7 +538,9 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
             dataserver_port=args.dataserver_port,
             lease_timeout=args.lease_timeout, sweep_period=args.sweep_period,
             read_timeout=None if args.no_read_timeout else args.read_timeout,
-            fsync_index=args.fsync_index, stats_period=args.stats_period)
+            fsync_index=args.fsync_index, stats_period=args.stats_period,
+            exporter_port=(None if args.exporter_port < 0
+                           else args.exporter_port))
     except (DataDirError, LevelOwnedError) as e:
         # Clean pre-start failures (reference: Program.cs:159-176 prints
         # and exits on an unwritable -o): no traceback, exit code 1.
@@ -589,6 +596,10 @@ def cmd_serve(argv: Sequence[str]) -> int:
                         default=proto.DEFAULT_ONDEMAND_DEADLINE,
                         help="seconds a miss may wait for the farm to "
                              "compute the tile before NOT_AVAILABLE")
+    parser.add_argument("--exporter-port", type=int,
+                        default=proto.DEFAULT_EXPORTER_PORT,
+                        help="HTTP metrics port (/metrics, /varz, "
+                             "/healthz); 0 = ephemeral, -1 disables")
     parser.add_argument("--no-info-log", action="store_true")
     _add_common(parser)
     args = parser.parse_args(argv)
@@ -611,7 +622,9 @@ def cmd_serve(argv: Sequence[str]) -> int:
             gateway_cache_tiles=args.cache_tiles,
             gateway_max_queue_depth=args.max_queue_depth,
             gateway_rate=args.rate, gateway_burst=args.burst,
-            ondemand_deadline=args.ondemand_deadline)
+            ondemand_deadline=args.ondemand_deadline,
+            exporter_port=(None if args.exporter_port < 0
+                           else args.exporter_port))
     except (DataDirError, LevelOwnedError) as e:
         raise SystemExit(f"dmtpu serve: {e}")
     total = coordinator.scheduler.total_tiles
@@ -1176,9 +1189,96 @@ def cmd_compact(argv: Sequence[str]) -> int:
     return 0
 
 
+def _fetch_varz(host: str, port: int, timeout: float) -> dict:
+    import json
+    import urllib.request
+    url = f"http://{host}:{port}/varz"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _print_varz(varz: dict) -> None:
+    sched = varz.get("scheduler")
+    if sched:
+        print(f"progress: {sched.get('completed', 0)}/{sched.get('total', 0)}"
+              f" tiles complete, {sched.get('outstanding_leases', 0)} leased,"
+              f" frontier depth {sched.get('frontier_depth', 0)}")
+    gauges = varz.get("gauges", {})
+    if gauges:
+        print("gauges:")
+        for name in sorted(gauges):
+            print(f"  {name:<40} {gauges[name]:.4g}")
+    counters = varz.get("counters", {})
+    if counters:
+        print("counters:")
+        for name in sorted(counters):
+            print(f"  {name:<40} {counters[name]}")
+    hists = varz.get("histograms", {})
+    if hists:
+        print(f"histograms:{'':<36} count      p50      p90      p99")
+        for name in sorted(hists):
+            h = hists[name]
+            print(f"  {name:<40} {h.get('count', 0):>5}"
+                  f" {h.get('p50', 0.0):>8.4f} {h.get('p90', 0.0):>8.4f}"
+                  f" {h.get('p99', 0.0):>8.4f}")
+    trace = varz.get("trace")
+    if trace:
+        skew = (trace.get("worker_skew") or {}).get("skew")
+        print(f"trace: {trace.get('recorded', 0)} events "
+              f"({trace.get('dropped', 0)} dropped), "
+              f"{trace.get('complete_spans', 0)}/{trace.get('spans', 0)} "
+              f"complete spans, worker skew "
+              + (f"{skew:.2f}" if skew is not None else "n/a"))
+        workers = (trace.get("worker_skew") or {}).get("workers") or {}
+        for wid in sorted(workers):
+            w = workers[wid]
+            print(f"  {wid:<40} {w.get('tiles', 0)} tiles, "
+                  f"{w.get('busy_s', 0.0):.3f}s busy")
+
+
+def cmd_stats(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu stats",
+        description="Fetch and pretty-print a running coordinator's /varz "
+                    "(counters, gauges, histogram percentiles, trace "
+                    "summary) from its metrics exporter.")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int,
+                        default=proto.DEFAULT_EXPORTER_PORT)
+    parser.add_argument("--timeout", type=float, default=5.0,
+                        help="HTTP fetch timeout in seconds")
+    parser.add_argument("--watch", type=float, default=0.0, metavar="SECS",
+                        help="refresh every SECS seconds until interrupted")
+    parser.add_argument("--json", action="store_true",
+                        help="dump raw /varz JSON instead of pretty text")
+    args = parser.parse_args(argv)
+
+    import json
+
+    while True:
+        try:
+            varz = _fetch_varz(args.host, args.port, args.timeout)
+        except OSError as e:
+            raise SystemExit(
+                f"dmtpu stats: cannot fetch http://{args.host}:{args.port}"
+                f"/varz: {e}")
+        if args.json:
+            print(json.dumps(varz, indent=1, sort_keys=True), flush=True)
+        else:
+            _print_varz(varz)
+        if args.watch <= 0:
+            return 0
+        print(flush=True)
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
 COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
             "serve": cmd_serve, "viewer": cmd_viewer, "render": cmd_render,
-            "animate": cmd_animate, "compact": cmd_compact}
+            "animate": cmd_animate, "compact": cmd_compact,
+            "stats": cmd_stats}
 
 
 def _enable_compile_cache() -> None:
@@ -1235,8 +1335,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
-              "{coordinator|worker|serve|viewer|render|animate|compact} "
-              "[options]\n"
+              "{coordinator|worker|serve|viewer|render|animate|compact|"
+              "stats} [options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

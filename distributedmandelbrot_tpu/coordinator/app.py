@@ -19,6 +19,10 @@ from distributedmandelbrot_tpu.coordinator.distributer import Distributer
 from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
 from distributedmandelbrot_tpu.core.workload import LevelSetting
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.exporter import MetricsExporter
+from distributedmandelbrot_tpu.obs.metrics import Registry
+from distributedmandelbrot_tpu.obs.trace import TraceLog
 from distributedmandelbrot_tpu.serve.cache import DecodedTileCache
 from distributedmandelbrot_tpu.serve.gateway import TileGateway
 from distributedmandelbrot_tpu.serve.ondemand import OnDemandComputer
@@ -46,9 +50,16 @@ class Coordinator:
                  gateway_max_queue_depth: int = 1024,
                  gateway_rate: Optional[float] = None,
                  gateway_burst: float = 256.0,
-                 ondemand_deadline: float = proto.DEFAULT_ONDEMAND_DEADLINE) \
+                 ondemand_deadline: float = proto.DEFAULT_ONDEMAND_DEADLINE,
+                 exporter_port: Optional[int] = None) \
             -> None:
-        self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index)
+        # One registry + one trace ring feed every layer of this process;
+        # the exporter (opt-in like the gateway: exporter_port=None
+        # disables, 0 binds an ephemeral loopback port) serves both.
+        self.registry = Registry()
+        self.trace = TraceLog()
+        self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index,
+                                registry=self.registry)
         # Fail loudly if another live coordinator owns any of our levels
         # on this data dir (reference: the static claimed-levels set,
         # Distributer.cs:14,109-115 — file-based here because our
@@ -61,17 +72,31 @@ class Coordinator:
             if completed:
                 logger.info("resume: %d tiles already completed on disk",
                             len(completed))
-            self.counters = Counters()
+            self.counters = Counters(registry=self.registry)
             kwargs = {} if clock is None else {"clock": clock}
             self.scheduler = TileScheduler(level_settings,
                                            completed=completed,
                                            lease_timeout=lease_timeout,
+                                           registry=self.registry,
+                                           trace=self.trace,
                                            **kwargs)
+            # Live scheduler gauges, read at scrape time (plain ints under
+            # the GIL — no locking needed for a monitoring read).
+            self.registry.gauge(obs_names.GAUGE_FRONTIER_DEPTH,
+                                help="tiles grantable right now",
+                                fn=lambda: self.scheduler.frontier_depth)
+            self.registry.gauge(obs_names.GAUGE_OUTSTANDING_LEASES,
+                                help="unexpired leases",
+                                fn=lambda: self.scheduler.outstanding_leases)
+            self.registry.gauge(obs_names.GAUGE_COMPLETED_TILES,
+                                help="completed tiles of the configured grid",
+                                fn=lambda: self.scheduler.completed_count)
             self.distributer = Distributer(self.scheduler, self.store,
                                            host=host, port=distributer_port,
                                            sweep_period=sweep_period,
                                            read_timeout=read_timeout,
-                                           counters=self.counters)
+                                           counters=self.counters,
+                                           trace=self.trace)
             self.dataserver = DataServer(self.store, host=host,
                                          port=dataserver_port,
                                          read_timeout=read_timeout,
@@ -94,7 +119,13 @@ class Coordinator:
                     read_timeout=read_timeout,
                     max_queue_depth=gateway_max_queue_depth,
                     rate=gateway_rate, burst=gateway_burst,
-                    counters=self.counters)
+                    counters=self.counters, trace=self.trace)
+            self.exporter: Optional[MetricsExporter] = None
+            if exporter_port is not None:
+                self.exporter = MetricsExporter(
+                    self.registry, trace=self.trace,
+                    varz_extra=self._varz_extra,
+                    host=host, port=exporter_port)
         except BaseException:
             # Construction failed after the claim: release it, or the
             # level stays locked by this live process forever.
@@ -109,6 +140,8 @@ class Coordinator:
             await self.dataserver.start()
             if self.gateway is not None:
                 await self.gateway.start()
+            if self.exporter is not None:
+                await self.exporter.start()
         except BaseException:
             # A failed startup (e.g. port already bound) will never reach
             # stop(): shut down whichever service DID start — a
@@ -123,6 +156,8 @@ class Coordinator:
                 await self.dataserver.stop()
                 if self.gateway is not None:
                     await self.gateway.stop()
+                if self.exporter is not None:
+                    await self.exporter.stop()
             except Exception:
                 logger.exception("cleanup after failed startup")
             finally:
@@ -143,9 +178,12 @@ class Coordinator:
                 # services below from shutting down.
                 logger.exception("stats task had failed")
         try:
-            # Gateway first: its in-flight requests read through the store
+            # Exporter first (scrapes read live scheduler/cache state),
+            # then gateway: its in-flight requests read through the store
             # and await distributer saves, so it should stop serving before
             # the services it depends on go away.
+            if self.exporter is not None:
+                await self.exporter.stop()
             if self.gateway is not None:
                 await self.gateway.stop()
             await self.distributer.stop()
@@ -194,3 +232,18 @@ class Coordinator:
     @property
     def gateway_port(self) -> Optional[int]:
         return None if self.gateway is None else self.gateway.port
+
+    @property
+    def exporter_port(self) -> Optional[int]:
+        return None if self.exporter is None else self.exporter.port
+
+    def _varz_extra(self) -> dict:
+        """Scheduler frontier state for /varz (beyond the gauge family)."""
+        return {
+            "scheduler": {
+                "frontier_depth": self.scheduler.frontier_depth,
+                "outstanding_leases": self.scheduler.outstanding_leases,
+                "completed": self.scheduler.completed_count,
+                "total": self.scheduler.total_tiles,
+            },
+        }

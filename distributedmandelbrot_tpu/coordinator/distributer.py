@@ -35,12 +35,23 @@ from distributedmandelbrot_tpu.core.workload import (WORKLOAD_WIRE_SIZE,
                                                      Workload)
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.trace import TraceLog
 from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils.metrics import Counters
 
 logger = logging.getLogger("dmtpu.distributer")
 
 MAX_BATCH = 4096
+
+
+def _peer_id(writer: asyncio.StreamWriter) -> Optional[str]:
+    """Connection id for trace events — the per-worker key the skew
+    summary groups on (a worker keeps one connection per exchange loop)."""
+    peer = writer.get_extra_info("peername")
+    if isinstance(peer, (tuple, list)) and len(peer) >= 2:
+        return f"{peer[0]}:{peer[1]}"
+    return str(peer) if peer else None
 
 
 class Distributer:
@@ -50,6 +61,7 @@ class Distributer:
                  sweep_period: float = proto.DEFAULT_SWEEP_PERIOD,
                  read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
                  counters: Optional[Counters] = None,
+                 trace: Optional[TraceLog] = None,
                  on_chunk_saved=None) -> None:
         self.scheduler = scheduler
         self.store = store
@@ -58,6 +70,8 @@ class Distributer:
         self.sweep_period = sweep_period
         self.read_timeout = read_timeout
         self.counters = counters if counters is not None else Counters()
+        self.registry = self.counters.registry
+        self.trace = trace if trace is not None else TraceLog()
         # Optional ``callback(key)`` fired on this event loop after a chunk
         # is durably persisted — the gateway's on-demand path hangs its
         # arrival notification here.
@@ -153,30 +167,35 @@ class Distributer:
                 pass
 
     async def _handle_request(self, writer: asyncio.StreamWriter) -> None:
-        w = self.scheduler.acquire()
-        if w is None:
-            framing.write_byte(writer, proto.WORKLOAD_NOT_AVAILABLE)
-            self.counters.inc("requests_denied")
-        else:
-            framing.write_byte(writer, proto.WORKLOAD_AVAILABLE)
-            writer.write(w.to_wire())
-            self.counters.inc("workloads_granted")
-            logger.info("granted %s", w)
+        with self.registry.timed(obs_names.HIST_GRANT_SECONDS):
+            w = self.scheduler.acquire()
+            if w is None:
+                framing.write_byte(writer, proto.WORKLOAD_NOT_AVAILABLE)
+                self.counters.inc("requests_denied")
+            else:
+                framing.write_byte(writer, proto.WORKLOAD_AVAILABLE)
+                writer.write(w.to_wire())
+                self.counters.inc("workloads_granted")
+                self.trace.record("granted", w.key, worker=_peer_id(writer))
+                logger.info("granted %s", w)
 
     async def _handle_batch_request(self, reader: asyncio.StreamReader,
                                     writer: asyncio.StreamWriter) -> None:
         count = await self._read(framing.read_u32(reader))
-        grants = self.scheduler.acquire_batch(min(count, MAX_BATCH))
-        if not grants:
-            framing.write_byte(writer, proto.WORKLOAD_NOT_AVAILABLE)
-            self.counters.inc("requests_denied")
-            return
-        framing.write_byte(writer, proto.WORKLOAD_AVAILABLE)
-        framing.write_u32(writer, len(grants))
-        for w in grants:
-            writer.write(w.to_wire())
-        self.counters.inc("workloads_granted", len(grants))
-        logger.info("granted batch of %d tiles", len(grants))
+        with self.registry.timed(obs_names.HIST_GRANT_SECONDS):
+            grants = self.scheduler.acquire_batch(min(count, MAX_BATCH))
+            if not grants:
+                framing.write_byte(writer, proto.WORKLOAD_NOT_AVAILABLE)
+                self.counters.inc("requests_denied")
+                return
+            framing.write_byte(writer, proto.WORKLOAD_AVAILABLE)
+            framing.write_u32(writer, len(grants))
+            peer = _peer_id(writer)
+            for w in grants:
+                writer.write(w.to_wire())
+                self.trace.record("granted", w.key, worker=peer)
+            self.counters.inc("workloads_granted", len(grants))
+            logger.info("granted batch of %d tiles", len(grants))
 
     async def _handle_response(self, reader: asyncio.StreamReader,
                                writer: asyncio.StreamWriter) -> None:
@@ -194,6 +213,7 @@ class Distributer:
 
     async def _ingest_one(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
+        t_accept = time.monotonic()
         w = Workload.from_wire(
             await self._read(framing.read_exact(reader, WORKLOAD_WIRE_SIZE)))
         # Claim (consume) the lease at echo time, as the reference does
@@ -204,7 +224,7 @@ class Distributer:
         if token is None:
             framing.write_byte(writer, proto.RESPONSE_REJECT)
             await writer.drain()
-            self.counters.inc("results_rejected")
+            self.counters.inc(obs_names.COORD_RESULTS_REJECTED)
             logger.info("rejected result for %s (stale or unknown lease)", w)
             return
         framing.write_byte(writer, proto.RESPONSE_ACCEPT)
@@ -217,16 +237,21 @@ class Distributer:
             # arrived: make the tile grantable again now rather than
             # waiting out the claim's expiry.
             self.scheduler.release_claim(w, token)
-            self.counters.inc("results_dropped")
+            self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
             logger.info("dropped result for %s (upload stalled or "
                         "connection lost)", w)
             raise
         if not self.scheduler.finish_claim(w, token):
             # Claim expired between accept and payload arrival; drop.
-            self.counters.inc("results_dropped")
+            self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
             logger.info("dropped result for %s (lease expired mid-upload)", w)
             return
-        self.counters.inc("results_accepted")
+        self.counters.inc(obs_names.COORD_RESULTS_ACCEPTED)
+        # Accept latency: echo arrival -> payload fully landed (the
+        # upload leg of the pipeline as the coordinator sees it).
+        self.registry.observe(obs_names.HIST_ACCEPT_SECONDS,
+                              time.monotonic() - t_accept)
+        self.trace.record("result_received", w.key, worker=_peer_id(writer))
         chunk = Chunk(w.level, w.index_real, w.index_imag,
                       np.frombuffer(data, dtype=np.uint8))
         task = asyncio.create_task(self._save_chunk(w, chunk))
@@ -237,9 +262,11 @@ class Distributer:
         try:
             t0 = time.monotonic()
             await asyncio.to_thread(self.store.save, chunk)
-            self.counters.inc("persist_us",
-                              int((time.monotonic() - t0) * 1e6))
-            self.counters.inc("chunks_saved")
+            dt = time.monotonic() - t0
+            self.counters.inc(obs_names.COORD_PERSIST_US, int(dt * 1e6))
+            self.registry.observe(obs_names.HIST_PERSIST_SECONDS, dt)
+            self.counters.inc(obs_names.COORD_CHUNKS_SAVED)
+            self.trace.record("persisted", chunk.key)
             logger.info("saved chunk %s", chunk.key)
             if self.on_chunk_saved is not None:
                 try:

@@ -24,7 +24,8 @@ class EmbeddedCoordinator:
     def __init__(self, data_dir_parent: str, level_settings, *,
                  lease_timeout: float = 3600.0, sweep_period: float = 300.0,
                  read_timeout: float | None = _UNSET, clock=None,
-                 gateway: bool = True, **gateway_kwargs) -> None:
+                 gateway: bool = True, exporter: bool = True,
+                 **gateway_kwargs) -> None:
         self._ready = threading.Event()
         self._stop: asyncio.Event | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
@@ -39,6 +40,11 @@ class EmbeddedCoordinator:
         # gateway_burst, gateway_cache_tiles, ondemand_deadline).
         if gateway:
             self._kwargs["gateway_port"] = 0
+        # The metrics exporter rides along the same way: on by default at
+        # an ephemeral loopback port, so tests and benches can scrape
+        # /metrics and /varz without reserving a well-known port.
+        if exporter:
+            self._kwargs["exporter_port"] = 0
         self._kwargs.update(gateway_kwargs)
         if read_timeout is not _UNSET:
             self._kwargs["read_timeout"] = read_timeout
@@ -86,6 +92,18 @@ class EmbeddedCoordinator:
     @property
     def gateway_port(self) -> int | None:
         return self.coordinator.gateway_port
+
+    @property
+    def exporter_port(self) -> int | None:
+        return self.coordinator.exporter_port
+
+    @property
+    def registry(self):
+        return self.coordinator.registry
+
+    @property
+    def trace(self):
+        return self.coordinator.trace
 
     @property
     def scheduler(self):

@@ -27,11 +27,16 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass
-from typing import Iterator, Optional, Sequence
+from typing import TYPE_CHECKING, Iterator, Optional, Sequence
 
 from distributedmandelbrot_tpu.coordinator.clock import Clock, MonotonicClock
 from distributedmandelbrot_tpu.core.workload import LevelSetting, Workload
 from distributedmandelbrot_tpu.net.protocol import DEFAULT_LEASE_TIMEOUT
+from distributedmandelbrot_tpu.obs import names as obs_names
+
+if TYPE_CHECKING:
+    from distributedmandelbrot_tpu.obs.metrics import Registry
+    from distributedmandelbrot_tpu.obs.trace import TraceLog
 
 Key = tuple[int, int, int]
 
@@ -51,7 +56,9 @@ class TileScheduler:
     def __init__(self, level_settings: Sequence[LevelSetting], *,
                  completed: Optional[set[Key]] = None,
                  lease_timeout: float = DEFAULT_LEASE_TIMEOUT,
-                 clock: Optional[Clock] = None) -> None:
+                 clock: Optional[Clock] = None,
+                 registry: Optional["Registry"] = None,
+                 trace: Optional["TraceLog"] = None) -> None:
         if not level_settings:
             raise ValueError("at least one level setting required")
         seen_levels: set[int] = set()
@@ -81,6 +88,23 @@ class TileScheduler:
         self._retry: deque[Workload] = deque()
         self._cursor = self._grid_iter()
         self._cursor_done = False
+        # Passive telemetry hooks — the scheduler stays pure logic (no
+        # I/O, no real time); both default to None and cost nothing then.
+        self._registry = registry
+        self._trace = trace
+
+    def _record(self, event: str, key: Key) -> None:
+        if self._trace is not None:
+            self._trace.record(event, key)
+
+    def _count_requeue(self, key: Key, *, expired: bool = False) -> None:
+        if expired:
+            self._record("lease_expired", key)
+            if self._registry is not None:
+                self._registry.inc(obs_names.COORD_LEASES_EXPIRED)
+        self._record("requeued", key)
+        if self._registry is not None:
+            self._registry.inc(obs_names.COORD_REQUEUES)
 
     # -- state inspection -------------------------------------------------
 
@@ -99,6 +123,16 @@ class TileScheduler:
     def outstanding_leases(self) -> int:
         now = self.clock.now()
         return sum(1 for l in self._leases.values() if not l.expired(now))
+
+    @property
+    def frontier_depth(self) -> int:
+        """Tiles still to grant: not completed and not under a lease or
+        claim.  O(1) from maintained integers (the exporter's frontier
+        gauge reads this per scrape); expired-but-unswept leases make it
+        a slight undercount until the next sweep, which is the honest
+        view of what a worker asking right now would be offered."""
+        return max(0,
+                   self._remaining - len(self._leases) - len(self._claims))
 
     def is_complete(self) -> bool:
         """All tiles of all configured levels are done (O(1))."""
@@ -156,6 +190,7 @@ class TileScheduler:
                 w = self._next_needed(now)
             if w is None:
                 return None
+        self._record("scheduled", w.key)
         self._leases[w.key] = Lease(w, now + self.lease_timeout)
         return w
 
@@ -208,6 +243,7 @@ class TileScheduler:
         del self._claims[w.key]
         if entry[1].expired(self.clock.now()):
             self._retry.append(entry[1].workload)
+            self._count_requeue(w.key, expired=True)
             return False
         if w.key not in self._completed:
             self._completed.add(w.key)
@@ -226,6 +262,7 @@ class TileScheduler:
         del self._claims[w.key]
         if w.key not in self._completed:
             self._retry.append(entry[1].workload)
+            self._count_requeue(w.key)
 
     def complete(self, w: Workload) -> bool:
         """Record a completed tile; returns False for stale/unknown results.
@@ -271,6 +308,7 @@ class TileScheduler:
             self._completed.discard(w.key)
             self._remaining += 1
             self._retry.append(w)
+            self._count_requeue(w.key)
 
     # -- maintenance ------------------------------------------------------
 
@@ -283,11 +321,13 @@ class TileScheduler:
             lease = self._leases.pop(key)
             if key not in self._completed:
                 self._retry.append(lease.workload)
+                self._count_requeue(key, expired=True)
         swept += len(expired)
         expired = [k for k, (_, l) in self._claims.items() if l.expired(now)]
         for key in expired:
             _, lease = self._claims.pop(key)
             if key not in self._completed:
                 self._retry.append(lease.workload)
+                self._count_requeue(key, expired=True)
         swept += len(expired)
         return swept

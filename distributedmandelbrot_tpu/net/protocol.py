@@ -61,6 +61,9 @@ GATEWAY_BATCH_MAGIC = 0xFFFFFFFF
 DEFAULT_DISTRIBUTER_PORT = 59010
 DEFAULT_DATASERVER_PORT = 59011
 DEFAULT_GATEWAY_PORT = 59012
+# HTTP metrics/trace exporter (/metrics, /varz, /healthz) — not part of the
+# binary tile protocol, but allocated alongside its ports.
+DEFAULT_EXPORTER_PORT = 59013
 
 # Scheduling defaults (reference: Distributer.cs:22,24 — 1 h lease, 5 min sweep)
 DEFAULT_LEASE_TIMEOUT = 3600.0

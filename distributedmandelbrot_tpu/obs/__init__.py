@@ -1,0 +1,26 @@
+"""Observability: metrics registry, tile-lifecycle trace, HTTP exporter.
+
+The reference system has no instrumentation at all (survey §5.5); this
+package is the telemetry spine of the TPU build:
+
+- :mod:`.metrics` — thread-safe :class:`Registry` of counters, gauges and
+  log-bucketed histograms with percentile estimation, stdlib-only;
+- :mod:`.names` — the canonical metric names every layer emits, plus the
+  legacy-alias table that keeps pre-registry call sites working;
+- :mod:`.trace` — a bounded ring buffer of per-tile lifecycle events
+  (``scheduled -> granted -> result_received -> persisted -> served``)
+  joined into latency spans and a per-worker skew summary;
+- :mod:`.exporter` — an asyncio HTTP endpoint serving ``/metrics``
+  (Prometheus text exposition v0.0.4), ``/varz`` (JSON snapshot) and
+  ``/healthz``, enabled from the coordinator like the gateway is.
+"""
+
+from distributedmandelbrot_tpu.obs.exporter import (MetricsExporter,
+                                                    render_prometheus)
+from distributedmandelbrot_tpu.obs.metrics import (DEFAULT_BUCKETS, Counter,
+                                                   Gauge, Histogram, Registry)
+from distributedmandelbrot_tpu.obs.trace import TraceEvent, TraceLog
+
+__all__ = ["Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram",
+           "MetricsExporter", "Registry", "TraceEvent", "TraceLog",
+           "render_prometheus"]

@@ -1,0 +1,316 @@
+"""Thread-safe metrics registry: counters, gauges, log-bucketed histograms.
+
+Stdlib-only by constraint (pyproject depends on numpy + jax alone) and by
+taste: the whole farm needs maybe thirty instruments, and a registry this
+size is easier to reason about than a client library.  One lock guards
+every instrument — contention is irrelevant at coordinator request rates
+(thousands/s at most, each update a few dict operations), and a single
+lock makes ``snapshot()`` a consistent cut, which the tests pin.
+
+Histograms use fixed log-spaced bucket bounds (default ~100 µs to ~105 s,
+x2 per bucket) and ``observe()`` takes SECONDS; percentiles are estimated
+by linear interpolation inside the winning bucket, the standard
+Prometheus ``histogram_quantile`` rule, so ``/varz`` and a real scraper
+agree on p99 up to bucket resolution.
+
+Instruments may carry labels: ``registry.observe(name, dt, labels={
+"outcome": "tier1_hit"})`` materializes one child per distinct label set
+under the same family name, rendered the Prometheus way.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Iterator, Mapping, Optional, Sequence
+
+# ~100 µs .. ~105 s, x2 spacing: one histogram shape serves everything
+# from a tier-1 cache hit (tens of µs, clamped into the first bucket) to
+# a compute-on-read wait bounded by the two-minute on-demand deadline.
+DEFAULT_BUCKETS: tuple[float, ...] = tuple(1e-4 * 2 ** i for i in range(21))
+
+LabelKey = tuple[tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Mapping[str, str]]) -> LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic integer, incremented under the registry's lock."""
+
+    kind = "counter"
+    __slots__ = ("name", "labels", "value", "_lock")
+
+    def __init__(self, name: str, labels: LabelKey,
+                 lock: threading.Lock) -> None:
+        self.name = name
+        self.labels = labels
+        self.value = 0
+        self._lock = lock
+
+    def inc(self, by: int = 1) -> None:
+        with self._lock:
+            self.value += by
+
+
+class Gauge:
+    """Last-set value; ``fn`` makes it a live callback gauge, evaluated
+    at collection time (frontier depth and the cache hit ratios read
+    scheduler/cache state instead of being pushed on every mutation)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "labels", "value", "fn", "_lock")
+
+    def __init__(self, name: str, labels: LabelKey, lock: threading.Lock,
+                 fn: Optional[Callable[[], float]] = None) -> None:
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+        self.fn = fn
+        self._lock = lock
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def read(self) -> float:
+        if self.fn is not None:
+            try:
+                return float(self.fn())
+            except Exception:
+                return float("nan")  # a broken callback must not kill /metrics
+        with self._lock:
+            return self.value
+
+
+class Histogram:
+    """Fixed-bound histogram of durations in seconds."""
+
+    kind = "histogram"
+    __slots__ = ("name", "labels", "bounds", "counts", "sum", "count",
+                 "_lock")
+
+    def __init__(self, name: str, labels: LabelKey, lock: threading.Lock,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.name = name
+        self.labels = labels
+        self.bounds = bounds
+        # counts[i] observations <= bounds[i]; counts[-1] is the +Inf
+        # overflow bucket.
+        self.counts = [0] * (len(bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = lock
+
+    def observe(self, seconds: float) -> None:
+        seconds = float(seconds)
+        i = bisect.bisect_left(self.bounds, seconds)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += seconds
+            self.count += 1
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimated q-th percentile (0..100) by linear interpolation
+        inside the winning bucket; None with no observations.  The
+        overflow bucket reports its lower bound (the histogram cannot
+        see past its last boundary)."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return None
+        rank = max(q, 0.0) / 100.0 * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c > 0:
+                if i == len(self.bounds):
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (rank - (cum - c)) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        return self.bounds[-1]
+
+    def state(self) -> tuple[list[int], float, int]:
+        """Consistent (bucket counts, sum, count) cut for rendering."""
+        with self._lock:
+            return list(self.counts), self.sum, self.count
+
+
+class Registry:
+    """Get-or-create instrument registry; one per process/coordinator.
+
+    A name is bound to one kind forever — re-registering ``x`` as a gauge
+    after it was a counter raises, because a family rendered under two
+    TYPEs is the exposition-format bug scrapers choke on.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: dict[tuple[str, LabelKey],
+                                Counter | Gauge | Histogram] = {}
+        self._kinds: dict[str, str] = {}
+        self._help: dict[str, str] = {}
+        self._buckets: dict[str, tuple[float, ...]] = {}
+
+    # -- instrument access ------------------------------------------------
+
+    def _get(self, name: str, kind: str, labels: Optional[Mapping[str, str]],
+             factory) -> Counter | Gauge | Histogram:
+        key = (name, _label_key(labels))
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is not None:
+                if inst.kind != kind:
+                    raise ValueError(
+                        f"metric {name!r} is a {inst.kind}, not a {kind}")
+                return inst
+            bound = self._kinds.setdefault(name, kind)
+            if bound != kind:
+                raise ValueError(
+                    f"metric {name!r} is a {bound}, not a {kind}")
+            inst = factory(key[1])
+            self._instruments[key] = inst
+            return inst
+
+    def counter(self, name: str,
+                labels: Optional[Mapping[str, str]] = None,
+                help: Optional[str] = None) -> Counter:
+        if help:
+            self._help.setdefault(name, help)
+        return self._get(name, "counter", labels,
+                         lambda lk: Counter(name, lk, self._lock))
+
+    def gauge(self, name: str,
+              labels: Optional[Mapping[str, str]] = None,
+              help: Optional[str] = None,
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        if help:
+            self._help.setdefault(name, help)
+        g = self._get(name, "gauge", labels,
+                      lambda lk: Gauge(name, lk, self._lock, fn=fn))
+        if fn is not None:
+            g.fn = fn  # re-registering may attach/refresh the callback
+        return g
+
+    def histogram(self, name: str,
+                  labels: Optional[Mapping[str, str]] = None,
+                  buckets: Optional[Sequence[float]] = None,
+                  help: Optional[str] = None) -> Histogram:
+        if help:
+            self._help.setdefault(name, help)
+        # Every child of a family shares the first-registered bounds, or
+        # the merged family percentiles would be meaningless.
+        if buckets is not None:
+            self._buckets.setdefault(name, tuple(sorted(
+                float(b) for b in buckets)))
+        bounds = self._buckets.setdefault(name, DEFAULT_BUCKETS)
+        return self._get(name, "histogram", labels,
+                         lambda lk: Histogram(name, lk, self._lock, bounds))
+
+    # -- write helpers ----------------------------------------------------
+
+    def inc(self, name: str, by: int = 1,
+            labels: Optional[Mapping[str, str]] = None) -> None:
+        self.counter(name, labels).inc(by)
+
+    def set_gauge(self, name: str, value: float,
+                  labels: Optional[Mapping[str, str]] = None) -> None:
+        self.gauge(name, labels).set(value)
+
+    def observe(self, name: str, seconds: float,
+                labels: Optional[Mapping[str, str]] = None) -> None:
+        self.histogram(name, labels).observe(seconds)
+
+    @contextmanager
+    def timed(self, name: str,
+              labels: Optional[Mapping[str, str]] = None) -> Iterator[None]:
+        """``with registry.timed("store_write_seconds"): ...`` — observes
+        the block's duration even when it raises (a failing save is
+        exactly the latency an operator needs to see)."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.observe(name, time.monotonic() - t0, labels)
+
+    # -- read side --------------------------------------------------------
+
+    def counter_value(self, name: str,
+                      labels: Optional[Mapping[str, str]] = None
+                      ) -> Optional[int]:
+        """Counter value, or None if never registered — NEVER creates."""
+        with self._lock:
+            inst = self._instruments.get((name, _label_key(labels)))
+            if isinstance(inst, Counter):
+                return inst.value
+            return None
+
+    def collect(self) -> list[tuple[str, str, str,
+                                    list[Counter | Gauge | Histogram]]]:
+        """Families for exposition: (name, kind, help, children), children
+        in first-registration order, families sorted by name."""
+        with self._lock:
+            items = list(self._instruments.items())
+            kinds = dict(self._kinds)
+            helps = dict(self._help)
+        families: dict[str, list] = {}
+        for (name, _), inst in items:
+            families.setdefault(name, []).append(inst)
+        return [(name, kinds[name], helps.get(name, ""), children)
+                for name, children in sorted(families.items())]
+
+    def family_percentile(self, name: str, q: float) -> Optional[float]:
+        """Percentile over ALL children of a histogram family merged (the
+        children share bounds by construction), e.g. gateway request
+        latency across every outcome."""
+        children = [inst for (n, _), inst in self._iter_instruments()
+                    if n == name and isinstance(inst, Histogram)]
+        if not children:
+            return None
+        merged = Histogram(name, (), threading.Lock(), children[0].bounds)
+        for h in children:
+            counts, total, count = h.state()
+            for i, c in enumerate(counts):
+                merged.counts[i] += c
+            merged.sum += total
+            merged.count += count
+        return merged.percentile(q)
+
+    def _iter_instruments(self):
+        with self._lock:
+            return list(self._instruments.items())
+
+    def snapshot(self) -> dict:
+        """Structured JSON-ready snapshot (the /varz payload's core)."""
+        counters: dict[str, int] = {}
+        gauges: dict[str, float] = {}
+        histograms: dict[str, dict] = {}
+        for (name, lk), inst in self._iter_instruments():
+            label = name if not lk else (
+                name + "{" + ",".join(f"{k}={v}" for k, v in lk) + "}")
+            if isinstance(inst, Counter):
+                counters[label] = inst.value
+            elif isinstance(inst, Gauge):
+                gauges[label] = inst.read()
+            else:
+                _, total, count = inst.state()
+                histograms[label] = {
+                    "count": count,
+                    "sum": round(total, 6),
+                    "p50": inst.percentile(50),
+                    "p90": inst.percentile(90),
+                    "p99": inst.percentile(99),
+                }
+        return {"counters": counters, "gauges": gauges,
+                "histograms": histograms}

@@ -1,0 +1,96 @@
+"""Canonical metric names, in one place so layers can never drift apart.
+
+Counters predating the registry grew ad hoc, and two of them collided:
+``results_accepted`` counted *submissions the coordinator accepted* in
+``worker/worker.py`` but *results ingested* in ``coordinator/distributer
+.py`` — the same name for two ends of the same wire, which only worked
+because the two processes never shared a ``Counters`` instance.  The
+``worker_`` / ``coord_`` prefixes make the owner explicit; the legacy
+spellings live on as read-side aliases (:data:`LEGACY_ALIASES`) so the
+bench harness, the embedded coordinator's settle loop and third-party
+scrapers keep working against either name.
+"""
+
+from __future__ import annotations
+
+# -- coordinator: distributer ingest/grant path ---------------------------
+
+COORD_WORKLOADS_GRANTED = "workloads_granted"
+COORD_REQUESTS_DENIED = "requests_denied"
+COORD_READ_TIMEOUTS = "read_timeouts"
+COORD_RESULTS_ACCEPTED = "coord_results_accepted"
+COORD_RESULTS_REJECTED = "coord_results_rejected"
+COORD_RESULTS_DROPPED = "coord_results_dropped"
+COORD_CHUNKS_SAVED = "chunks_saved"
+COORD_SAVE_ERRORS = "save_errors"
+COORD_PERSIST_US = "persist_us"  # microsecond sum (legacy bench field)
+
+# -- coordinator: scheduler lease churn -----------------------------------
+
+COORD_REQUEUES = "coord_requeues"
+COORD_LEASES_EXPIRED = "coord_leases_expired"
+GAUGE_FRONTIER_DEPTH = "coord_frontier_depth"
+GAUGE_OUTSTANDING_LEASES = "coord_outstanding_leases"
+GAUGE_COMPLETED_TILES = "coord_completed_tiles"
+
+# -- coordinator latency histograms (seconds) -----------------------------
+
+HIST_GRANT_SECONDS = "coord_grant_seconds"
+HIST_ACCEPT_SECONDS = "coord_accept_seconds"
+HIST_PERSIST_SECONDS = "coord_persist_seconds"
+
+# -- worker ---------------------------------------------------------------
+
+WORKER_RESULTS_ACCEPTED = "worker_results_accepted"
+WORKER_RESULTS_REJECTED = "worker_results_rejected"
+WORKER_TILES_COMPUTED = "tiles_computed"
+WORKER_LEASE_US = "lease_us"
+WORKER_COMPUTE_US = "compute_us"
+WORKER_UPLOAD_US = "upload_us"
+HIST_WORKER_COMPUTE_SECONDS = "worker_compute_seconds"
+HIST_WORKER_UPLOAD_SECONDS = "worker_upload_seconds"
+
+# -- store ----------------------------------------------------------------
+
+HIST_STORE_READ_SECONDS = "store_read_seconds"
+HIST_STORE_WRITE_SECONDS = "store_write_seconds"
+
+# -- serving gateway + caches ---------------------------------------------
+
+GATEWAY_QUERIES = "gateway_queries"
+GATEWAY_SERVED = "gateway_served"
+GATEWAY_REJECTED = "gateway_rejected"
+GATEWAY_OVERLOADED = "gateway_overloaded"
+GATEWAY_UNAVAILABLE = "gateway_unavailable"
+GATEWAY_BATCHES = "gateway_batches"
+HIST_GATEWAY_REQUEST_SECONDS = "gateway_request_seconds"
+TILE_CACHE_HITS = "tile_cache_hits"
+TILE_CACHE_MISSES = "tile_cache_misses"
+TILE_CACHE_EVICTIONS = "tile_cache_evictions"
+TILE_CACHE_PROMOTIONS = "tile_cache_promotions"
+TILE_CACHE_STORE_MISSES = "tile_cache_store_misses"
+GAUGE_TIER1_HIT_RATIO = "tile_cache_tier1_hit_ratio"
+GAUGE_TIER2_HIT_RATIO = "tile_cache_tier2_hit_ratio"
+
+# Gateway per-request outcome label values (one histogram, split by how
+# the request resolved).
+OUTCOME_TIER1 = "tier1_hit"
+OUTCOME_STORE = "store_hit"
+OUTCOME_COMPUTED = "computed"
+OUTCOME_UNAVAILABLE = "unavailable"
+OUTCOME_REJECTED = "rejected"
+OUTCOME_OVERLOADED = "overloaded"
+
+# -- legacy aliases -------------------------------------------------------
+
+# canonical name -> the spelling pre-registry call sites read.  Reads of a
+# legacy name sum every canonical counter aliased to it, reproducing the
+# old shared-Counters semantics (a process that both granted and computed
+# saw one merged ``results_accepted``).
+LEGACY_ALIASES: dict[str, str] = {
+    COORD_RESULTS_ACCEPTED: "results_accepted",
+    WORKER_RESULTS_ACCEPTED: "results_accepted",
+    COORD_RESULTS_REJECTED: "results_rejected",
+    WORKER_RESULTS_REJECTED: "results_rejected",
+    COORD_RESULTS_DROPPED: "results_dropped",
+}

@@ -23,6 +23,7 @@ from typing import Optional
 import numpy as np
 
 from distributedmandelbrot_tpu.core.chunk import Chunk
+from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils.metrics import Counters
 
@@ -65,6 +66,26 @@ class DecodedTileCache:
         self.counters = counters if counters is not None else Counters()
         self._entries: OrderedDict[Key, CachedTile] = OrderedDict()
         self._lock = threading.Lock()
+        # Live hit-ratio gauges, derived from the movement counters at
+        # scrape time (callback gauges — nothing to update per request).
+        registry = self.counters.registry
+
+        def _ratio(hit_name: str, miss_name: str) -> float:
+            hits = registry.counter_value(hit_name) or 0
+            misses = registry.counter_value(miss_name) or 0
+            total = hits + misses
+            return hits / total if total else 0.0
+
+        registry.gauge(
+            obs_names.GAUGE_TIER1_HIT_RATIO,
+            help="decoded-tile LRU hits / lookups",
+            fn=lambda: _ratio(obs_names.TILE_CACHE_HITS,
+                              obs_names.TILE_CACHE_MISSES))
+        registry.gauge(
+            obs_names.GAUGE_TIER2_HIT_RATIO,
+            help="store (payload LRU + disk) hits / tier-1 misses",
+            fn=lambda: _ratio(obs_names.TILE_CACHE_PROMOTIONS,
+                              obs_names.TILE_CACHE_STORE_MISSES))
 
     def __len__(self) -> int:
         with self._lock:
@@ -106,6 +127,7 @@ class DecodedTileCache:
             return entry
         payload = self.store.load_payload(*key)
         if payload is None:
+            self.counters.inc(obs_names.TILE_CACHE_STORE_MISSES)
             return None
         self.counters.inc("tile_cache_promotions")
         return self.put(key, payload)

@@ -38,6 +38,8 @@ from typing import Callable, Optional
 from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.trace import TraceLog
 from distributedmandelbrot_tpu.serve.cache import DecodedTileCache
 from distributedmandelbrot_tpu.serve.coalesce import SingleFlight
 from distributedmandelbrot_tpu.serve.ondemand import OnDemandComputer
@@ -90,7 +92,8 @@ class TileGateway:
                  max_queue_depth: int = 1024,
                  rate: Optional[float] = None,
                  burst: float = 256.0,
-                 counters: Optional[Counters] = None) -> None:
+                 counters: Optional[Counters] = None,
+                 trace: Optional[TraceLog] = None) -> None:
         self.cache = cache
         self.ondemand = ondemand
         self.host = host
@@ -98,6 +101,8 @@ class TileGateway:
         self.read_timeout = read_timeout
         self.max_queue_depth = max_queue_depth
         self.counters = counters if counters is not None else Counters()
+        self.registry = self.counters.registry
+        self.trace = trace if trace is not None else TraceLog()
         self.bucket = TokenBucket(rate, burst)
         self.singleflight = SingleFlight(self.counters)
         # Compute-on-read needs the depth the run renders each level at;
@@ -205,52 +210,80 @@ class TileGateway:
     async def _resolve_admitted(
             self, level: int, index_real: int,
             index_imag: int) -> tuple[int, Optional[bytes]]:
-        """Admission control, then resolve; returns (status, payload)."""
+        """Admission control, then resolve; returns (status, payload).
+
+        One latency histogram (``gateway_request_seconds``) split by an
+        ``outcome`` label: tier-1 hit / store hit / computed-on-read /
+        unavailable / rejected / overloaded — the split is what makes a
+        p99 actionable (a slow p99 of ``computed`` is farm latency; of
+        ``store_hit``, disk).
+        """
+        t0 = time.monotonic()
+        status, payload, outcome = await self._resolve_outcome(
+            level, index_real, index_imag)
+        self.registry.observe(obs_names.HIST_GATEWAY_REQUEST_SECONDS,
+                              time.monotonic() - t0,
+                              labels={"outcome": outcome})
+        if status == proto.QUERY_ACCEPT:
+            self.trace.record("served", (level, index_real, index_imag))
+        return status, payload
+
+    async def _resolve_outcome(
+            self, level: int, index_real: int,
+            index_imag: int) -> tuple[int, Optional[bytes], str]:
         self.counters.inc("gateway_queries")
         if level < 1 or level == proto.GATEWAY_BATCH_MAGIC \
                 or index_real >= level or index_imag >= level:
             self.counters.inc("gateway_rejected")
-            return proto.QUERY_REJECT, None
+            return proto.QUERY_REJECT, None, obs_names.OUTCOME_REJECTED
         # Tier-1 hits are answered before admission: they cost no I/O and
         # no compute, so shedding them would only push load onto retries.
         entry = self.cache.get_cached((level, index_real, index_imag))
         if entry is not None:
             self.counters.inc("gateway_served")
-            return proto.QUERY_ACCEPT, entry.payload
+            return proto.QUERY_ACCEPT, entry.payload, obs_names.OUTCOME_TIER1
         if self._active >= self.max_queue_depth or not self.bucket.try_acquire():
             self.counters.inc("gateway_overloaded")
             logger.info("shed query (%d,%d,%d): %d in service",
                         level, index_real, index_imag, self._active)
-            return proto.QUERY_OVERLOADED, None
+            return proto.QUERY_OVERLOADED, None, obs_names.OUTCOME_OVERLOADED
         self._active += 1
         try:
-            payload = await self._resolve(level, index_real, index_imag)
+            payload, outcome = await self._resolve(level, index_real,
+                                                   index_imag)
         finally:
             self._active -= 1
         if payload is None:
             self.counters.inc("gateway_unavailable")
-            return proto.QUERY_NOT_AVAILABLE, None
+            return (proto.QUERY_NOT_AVAILABLE, None,
+                    obs_names.OUTCOME_UNAVAILABLE)
         self.counters.inc("gateway_served")
-        return proto.QUERY_ACCEPT, payload
+        return proto.QUERY_ACCEPT, payload, outcome
 
     async def _resolve(self, level: int, index_real: int,
-                       index_imag: int) -> Optional[bytes]:
+                       index_imag: int) -> tuple[Optional[bytes], str]:
         """Store lookup falling through to compute-on-read, single-flight
-        per full workload identity ``(level, max_iter, i, j)``."""
+        per full workload identity ``(level, max_iter, i, j)``; returns
+        ``(payload, outcome)`` (followers inherit the leader's outcome —
+        they paid the leader's resolution path's latency)."""
         key = (level, index_real, index_imag)
         max_iter = self._level_max_iter.get(level)
         flight_key = (level, max_iter, index_real, index_imag)
 
-        async def supplier() -> Optional[bytes]:
+        async def supplier() -> tuple[Optional[bytes], str]:
             entry = await asyncio.to_thread(self.cache.load, key)
+            outcome = obs_names.OUTCOME_STORE
             if entry is None and self.ondemand is not None \
                     and max_iter is not None:
                 entry = await self.ondemand.compute(
                     Workload(level, max_iter, index_real, index_imag))
+                outcome = obs_names.OUTCOME_COMPUTED
                 if entry is not None:
                     # Promote the fresh tile so follow-up requests are
                     # tier-1 hits, not store reads.
                     entry = self.cache.put(key, entry.payload)
-            return None if entry is None else entry.payload
+            if entry is None:
+                return None, obs_names.OUTCOME_UNAVAILABLE
+            return entry.payload, outcome
 
         return await self.singleflight.run(flight_key, supplier)

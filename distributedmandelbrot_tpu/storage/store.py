@@ -27,13 +27,18 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
-from typing import Iterable, Optional
+from typing import TYPE_CHECKING, Iterable, Optional
 
 from distributedmandelbrot_tpu.core.chunk import Chunk
 from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.storage.index import (EntryType, IndexEntry,
                                                      scan_entries)
+
+if TYPE_CHECKING:
+    from distributedmandelbrot_tpu.obs.metrics import Registry
 
 INDEX_FILENAME = "_index.dat"
 DATA_DIR_NAME = "Data"
@@ -48,7 +53,11 @@ class ChunkStore:
     """Durable chunk storage rooted at ``parent_dir/Data/``."""
 
     def __init__(self, parent_dir: str = "", *, fsync_index: bool = False,
-                 payload_cache_size: int = 64) -> None:
+                 payload_cache_size: int = 64,
+                 registry: Optional["Registry"] = None) -> None:
+        # Optional latency telemetry (store_read/write_seconds); None
+        # keeps the store dependency-free for scripts and tests.
+        self._registry = registry
         self.data_dir = os.path.join(parent_dir, DATA_DIR_NAME)
         self.index_path = os.path.join(self.data_dir, INDEX_FILENAME)
         self._fsync_index = fsync_index
@@ -120,6 +129,7 @@ class ChunkStore:
         entry pointing at nothing — the reverse of the reference's order,
         which can break resume.
         """
+        t0 = time.monotonic()
         if chunk.is_never:
             entry = IndexEntry(*chunk.key, EntryType.NEVER)
         elif chunk.is_immediate:
@@ -141,6 +151,9 @@ class ChunkStore:
                 f.flush()
                 if self._fsync_index:
                     os.fsync(f.fileno())
+        if self._registry is not None:
+            self._registry.observe(obs_names.HIST_STORE_WRITE_SECONDS,
+                                   time.monotonic() - t0)
         return entry
 
     # -- read path --------------------------------------------------------
@@ -189,7 +202,13 @@ class ChunkStore:
             if key in self._payload_cache:
                 self._payload_cache.move_to_end(key)
                 return self._payload_cache[key]
+        # Only the miss path is timed: it is the index scan + file read +
+        # re-encode an operator tunes the payload LRU to avoid.
+        t0 = time.monotonic()
         chunk = self.load(level, index_real, index_imag)
+        if chunk is not None and self._registry is not None:
+            self._registry.observe(obs_names.HIST_STORE_READ_SECONDS,
+                                   time.monotonic() - t0)
         if chunk is None:
             return None
         payload = chunk.serialize()

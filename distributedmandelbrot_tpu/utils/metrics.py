@@ -1,29 +1,61 @@
-"""Minimal thread-safe counters for coordinator/worker observability.
+"""Back-compat counter facade over :mod:`distributedmandelbrot_tpu.obs`.
 
-The reference has no metrics at all (survey §5.5); these power the
-coordinator's stats logging and the bench harness without pulling in a
-metrics stack.
+Historically this module WAS the metrics system: a lock and a
+``defaultdict(int)``.  It is now a thin shim over
+:class:`~distributedmandelbrot_tpu.obs.metrics.Registry` so every
+pre-registry ``counters.inc(...)`` call site lands in the same registry
+the HTTP exporter serves, without touching those call sites.
+
+Semantics preserved (and one bug fixed):
+
+- ``inc``/``get``/``snapshot`` keep their signatures;
+- ``get`` no longer MUTATES: the old ``defaultdict`` inserted every
+  probed key, so asking about ``save_errors`` made it appear in
+  ``snapshot()`` forever — now a missing name reads 0 and stays absent;
+- legacy spellings (:data:`~distributedmandelbrot_tpu.obs.names.
+  LEGACY_ALIASES`) remain readable: ``get("results_accepted")`` sums the
+  ``worker_``/``coord_``-prefixed canonical counters, and ``snapshot()``
+  carries both spellings, so the bench harness and the embedded
+  coordinator's settle loop work against either generation of names.
 """
 
 from __future__ import annotations
 
-import threading
-from collections import defaultdict
+from typing import Optional
+
+from distributedmandelbrot_tpu.obs.metrics import Registry
+from distributedmandelbrot_tpu.obs.names import LEGACY_ALIASES
 
 
 class Counters:
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counts: dict[str, int] = defaultdict(int)
+    """Counter-only facade; share a :class:`Registry` to share counters."""
+
+    def __init__(self, registry: Optional[Registry] = None) -> None:
+        self.registry = registry if registry is not None else Registry()
 
     def inc(self, name: str, by: int = 1) -> None:
-        with self._lock:
-            self._counts[name] += by
+        self.registry.inc(name, by)
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._counts[name]
+        value = self.registry.counter_value(name)
+        if value is not None:
+            return value
+        # Legacy spelling: sum the canonical counters behind it, which
+        # reproduces what the old shared-Counters instance reported.
+        total, found = 0, False
+        for canonical, legacy in LEGACY_ALIASES.items():
+            if legacy == name:
+                v = self.registry.counter_value(canonical)
+                if v is not None:
+                    total += v
+                    found = True
+        return total if found else 0
 
     def snapshot(self) -> dict[str, int]:
-        with self._lock:
-            return dict(self._counts)
+        snap = {name: value for name, value
+                in self.registry.snapshot()["counters"].items()
+                if "{" not in name}  # labeled children aren't plain counts
+        for canonical, legacy in LEGACY_ALIASES.items():
+            if canonical in snap:
+                snap[legacy] = snap.get(legacy, 0) + snap[canonical]
+        return snap

@@ -25,6 +25,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.utils.metrics import Counters
 from distributedmandelbrot_tpu.worker.backends import ComputeBackend
 from distributedmandelbrot_tpu.worker.client import DistributerClient
@@ -43,6 +44,10 @@ class Worker:
         self.batch_size = batch_size
         self.overlap_io = overlap_io
         self.counters = counters if counters is not None else Counters()
+        self.registry = self.counters.registry
+        # Histograms are labeled by backend class so a mixed farm's
+        # artifacts separate Pallas tiles from the numpy control.
+        self._hist_labels = {"backend": type(backend).__name__}
         self._upload_thread: Optional[threading.Thread] = None
         self._upload_error: Optional[BaseException] = None
 
@@ -64,11 +69,14 @@ class Worker:
         # feed the same counter (bench_farm's phase breakdown).
         # Microsecond units: sub-ms loopback events would floor to zero
         # in ms and hide exactly the overheads the breakdown exposes.
-        self.counters.inc("upload_us",
-                          int((time.monotonic() - t0) * 1e6))
+        upload_s = time.monotonic() - t0
+        self.counters.inc(obs_names.WORKER_UPLOAD_US, int(upload_s * 1e6))
+        self.registry.observe(obs_names.HIST_WORKER_UPLOAD_SECONDS,
+                              upload_s, labels=self._hist_labels)
         n_ok = sum(accepted)
-        self.counters.inc("results_accepted", n_ok)
-        self.counters.inc("results_rejected", len(accepted) - n_ok)
+        self.counters.inc(obs_names.WORKER_RESULTS_ACCEPTED, n_ok)
+        self.counters.inc(obs_names.WORKER_RESULTS_REJECTED,
+                          len(accepted) - n_ok)
         if n_ok < len(accepted):
             logger.info("%d of %d results rejected (stale leases)",
                         len(accepted) - n_ok, len(accepted))
@@ -95,7 +103,7 @@ class Worker:
         """One pull/compute/submit round; False when no work was available."""
         t_lease = time.monotonic()
         workloads = self._acquire()
-        self.counters.inc("lease_us",
+        self.counters.inc(obs_names.WORKER_LEASE_US,
                           int((time.monotonic() - t_lease) * 1e6))
         if not workloads:
             self._join_upload()
@@ -103,8 +111,10 @@ class Worker:
         t0 = time.monotonic()
         pixels = self.backend.compute_batch(workloads)
         compute_s = time.monotonic() - t0
-        self.counters.inc("tiles_computed", len(workloads))
-        self.counters.inc("compute_us", int(compute_s * 1e6))
+        self.counters.inc(obs_names.WORKER_TILES_COMPUTED, len(workloads))
+        self.counters.inc(obs_names.WORKER_COMPUTE_US, int(compute_s * 1e6))
+        self.registry.observe(obs_names.HIST_WORKER_COMPUTE_SECONDS,
+                              compute_s, labels=self._hist_labels)
         logger.info("computed %d tiles in %.2fs", len(workloads), compute_s)
         results = list(zip(workloads, pixels))
         self._join_upload()  # previous batch must land before the next starts

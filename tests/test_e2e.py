@@ -114,6 +114,41 @@ def test_native_backend_is_bit_exact(tmp_path):
         np.testing.assert_array_equal(pixels, golden_tile(1, 0, 0, 12))
 
 
+def test_trace_spans_complete_for_full_render(tmp_path):
+    """Telemetry end-to-end: a full embedded render leaves a complete,
+    ordered lifecycle span (scheduled -> granted -> result_received ->
+    persisted) with worker attribution for EVERY persisted tile, and the
+    coordinator's grant/persist latency histograms saw the traffic."""
+    from distributedmandelbrot_tpu.obs import names as obs_names
+
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, MAX_ITER)]) as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            JaxBackend(dtype=np.float32), batch_size=2, overlap_io=False)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=4)
+
+        persisted = farm.store.completed_keys(levels=[2])
+        assert len(persisted) == 4
+        spans = {s["key"]: s for s in farm.trace.spans()}
+        for key in persisted:
+            span = spans[key]
+            assert span["complete"], (key, span)
+            assert span["worker"] is not None
+            for stage in ("queue_s", "compute_s", "persist_s", "total_s"):
+                assert span[stage] >= 0.0
+            assert span["churn"] == 0
+        # One worker connection did everything: skew is exactly balanced.
+        skew = farm.trace.worker_skew()
+        assert sum(w["tiles"] for w in skew["workers"].values()) == 4
+        # The latency histograms the exporter serves are nonzero too.
+        for family in (obs_names.HIST_GRANT_SECONDS,
+                       obs_names.HIST_ACCEPT_SECONDS,
+                       obs_names.HIST_PERSIST_SECONDS):
+            assert farm.registry.family_percentile(family, 50) is not None, \
+                family
+
+
 def test_rgba_rendering_matches_reference_semantics():
     """In-set pixels (value 0) must render black; others via inverted jet."""
     values = np.zeros((8, 8), dtype=np.uint8)

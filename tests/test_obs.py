@@ -1,0 +1,334 @@
+"""Telemetry subsystem: registry/histograms, Prometheus rendering, the
+HTTP exporter, the tile-lifecycle trace, and the legacy Counters shim."""
+
+import importlib.util
+import json
+import math
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.exporter import render_prometheus
+from distributedmandelbrot_tpu.obs.metrics import DEFAULT_BUCKETS, Registry
+from distributedmandelbrot_tpu.obs.trace import TraceLog
+from distributedmandelbrot_tpu.utils.metrics import Counters
+
+
+def _load_check_metrics():
+    """tools/ is not a package; import the validator straight off disk so
+    the suite and the standalone tool can never diverge."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "check_metrics.py")
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- histograms ------------------------------------------------------------
+
+
+def test_histogram_bucket_boundaries():
+    reg = Registry()
+    h = reg.histogram("h")
+    assert h.bounds == tuple(sorted(DEFAULT_BUCKETS))
+    h.observe(DEFAULT_BUCKETS[0])       # exactly on a bound: that bucket
+    h.observe(DEFAULT_BUCKETS[0] * 1.5)  # strictly inside the next
+    h.observe(0.0)                       # below every bound: first bucket
+    h.observe(1e9)                       # past the last bound: overflow
+    assert h.counts[0] == 2
+    assert h.counts[1] == 1
+    assert h.counts[-1] == 1
+    assert h.count == 4
+    assert h.sum == pytest.approx(DEFAULT_BUCKETS[0] * 2.5 + 1e9)
+
+
+def test_histogram_percentiles_interpolate():
+    reg = Registry()
+    h = reg.histogram("h", buckets=[1.0, 2.0, 4.0])
+    assert h.percentile(50) is None  # no observations yet
+    for v in (0.5, 1.5, 2.5, 3.5):
+        h.observe(v)
+    # rank(p50) = 2: one obs <= 1.0, the second closes the (1, 2] bucket.
+    assert h.percentile(50) == pytest.approx(2.0)
+    assert h.percentile(25) == pytest.approx(1.0)
+    # p100 walks to the last finite bound.
+    assert h.percentile(100) == pytest.approx(4.0)
+
+
+def test_histogram_overflow_reports_last_bound():
+    reg = Registry()
+    h = reg.histogram("h", buckets=[1.0, 2.0])
+    h.observe(50.0)
+    # The histogram cannot see past its last boundary; it must say 2.0,
+    # not invent a number beyond its resolution.
+    assert h.percentile(50) == pytest.approx(2.0)
+
+
+def test_histogram_family_shares_first_registered_bounds():
+    reg = Registry()
+    reg.histogram("h", buckets=[1.0, 2.0])
+    child = reg.histogram("h", labels={"outcome": "x"},
+                          buckets=[7.0, 8.0, 9.0])  # ignored: family bound
+    assert child.bounds == (1.0, 2.0)
+    reg.observe("h", 0.5)
+    reg.observe("h", 1.5, labels={"outcome": "x"})
+    assert reg.family_percentile("h", 100) == pytest.approx(2.0)
+    assert reg.family_percentile("missing", 50) is None
+
+
+def test_registry_name_kind_binding_enforced():
+    reg = Registry()
+    reg.counter("x").inc()
+    with pytest.raises(ValueError, match="counter"):
+        reg.gauge("x")
+    with pytest.raises(ValueError, match="counter"):
+        reg.histogram("x")
+
+
+def test_timed_observes_even_on_exception():
+    reg = Registry()
+    with pytest.raises(RuntimeError):
+        with reg.timed("op_seconds", labels={"outcome": "boom"}):
+            raise RuntimeError("boom")
+    assert reg.histogram("op_seconds", labels={"outcome": "boom"}).count == 1
+
+
+def test_callback_gauge_failure_renders_nan_not_crash():
+    reg = Registry()
+    reg.gauge("broken", fn=lambda: 1 / 0)
+    snap = reg.snapshot()
+    assert math.isnan(snap["gauges"]["broken"])
+    text = render_prometheus(reg)
+    assert "broken NaN" in text
+
+
+def test_registry_thread_safety_under_concurrent_updates():
+    reg = Registry()
+    n_threads, per_thread = 8, 2000
+    start = threading.Barrier(n_threads + 1)
+
+    def writer(i):
+        start.wait()
+        for k in range(per_thread):
+            reg.inc("hits")
+            reg.observe("lat", 0.001 * (k % 7),
+                        labels={"outcome": str(i % 2)})
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    start.wait()
+    # Concurrent readers must see consistent cuts, never raise.
+    for _ in range(50):
+        snap = reg.snapshot()
+        assert snap["counters"].get("hits", 0) <= n_threads * per_thread
+        render_prometheus(reg)
+    for t in threads:
+        t.join()
+    assert reg.counter_value("hits") == n_threads * per_thread
+    total = sum(h["count"] for label, h in
+                reg.snapshot()["histograms"].items() if label.startswith("lat"))
+    assert total == n_threads * per_thread
+
+
+# -- Counters shim ---------------------------------------------------------
+
+
+def test_counters_get_does_not_mutate():
+    c = Counters()
+    assert c.get("never_written") == 0
+    # The old defaultdict inserted probed keys forever; the shim must not.
+    assert "never_written" not in c.snapshot()
+    assert c.registry.counter_value("never_written") is None
+
+
+def test_counters_legacy_alias_reads_sum_canonical():
+    c = Counters()
+    c.inc(obs_names.WORKER_RESULTS_ACCEPTED, 2)
+    c.inc(obs_names.COORD_RESULTS_ACCEPTED, 3)
+    c.inc(obs_names.COORD_RESULTS_REJECTED)
+    # The legacy spelling reads what a shared pre-split Counters instance
+    # would have reported: both sides merged.
+    assert c.get("results_accepted") == 5
+    assert c.get("results_rejected") == 1
+    snap = c.snapshot()
+    assert snap["results_accepted"] == 5
+    assert snap[obs_names.COORD_RESULTS_ACCEPTED] == 3
+    # Exact canonical names always win over the alias path.
+    assert c.get(obs_names.WORKER_RESULTS_ACCEPTED) == 2
+
+
+def test_counters_share_registry():
+    reg = Registry()
+    a, b = Counters(registry=reg), Counters(registry=reg)
+    a.inc("x")
+    b.inc("x")
+    assert a.get("x") == 2
+
+
+# -- Prometheus rendering --------------------------------------------------
+
+
+def test_render_prometheus_golden_text():
+    reg = Registry()
+    reg.counter("requests_total", help="total requests").inc(3)
+    reg.gauge("depth").set(2.5)
+    reg.observe("lat_seconds", 1.5, labels={"outcome": "hit"})
+    text = render_prometheus(reg)
+    lines = text.splitlines()
+    assert "# HELP requests_total total requests" in lines
+    assert "# TYPE requests_total counter" in lines
+    assert "requests_total 3" in lines
+    assert "depth 2.5" in lines
+    i0 = lines.index("# TYPE lat_seconds histogram")
+    bucket_lines = [l for l in lines if l.startswith("lat_seconds_bucket")]
+    assert bucket_lines[-1] == 'lat_seconds_bucket{outcome="hit",le="+Inf"} 1'
+    assert 'lat_seconds_count{outcome="hit"} 1' in lines
+    assert lines.index(bucket_lines[0]) > i0
+    assert text.endswith("\n")
+
+
+def test_render_prometheus_validates_against_spec_parser():
+    check = _load_check_metrics()
+    reg = check._sample_registry()
+    families = check.parse_exposition(render_prometheus(reg))
+    check.check_invariants(families)
+    assert families["latency_seconds"]["type"] == "histogram"
+
+
+def test_spec_parser_rejects_malformed_text():
+    check = _load_check_metrics()
+    with pytest.raises(check.MetricsFormatError):
+        check.parse_exposition("no_type_line 1\n")
+    with pytest.raises(check.MetricsFormatError):
+        check.parse_exposition("# TYPE x counter\nx 1")  # no trailing \n
+
+
+# -- trace ring ------------------------------------------------------------
+
+
+def _fake_clock():
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    return clock
+
+
+def test_trace_ring_bounds_memory_and_counts_drops():
+    log = TraceLog(capacity=4, clock=_fake_clock())
+    for i in range(10):
+        log.record("scheduled", (1, 0, i))
+    assert len(log.events()) == 4
+    assert log.recorded == 10
+    assert log.dropped == 6
+
+
+def test_trace_spans_join_lifecycle():
+    log = TraceLog(clock=_fake_clock())
+    key = (4, 1, 2)
+    log.record("scheduled", key)                   # t=1
+    log.record("granted", key, worker="w:1")       # t=2
+    log.record("result_received", key, worker="w:1")  # t=3
+    log.record("persisted", key)                   # t=4
+    log.record("scheduled", (4, 0, 0))             # incomplete neighbour
+    spans = {s["key"]: s for s in log.spans()}
+    s = spans[key]
+    assert s["complete"] is True
+    assert s["worker"] == "w:1"
+    assert s["queue_s"] == pytest.approx(1.0)
+    assert s["compute_s"] == pytest.approx(1.0)
+    assert s["persist_s"] == pytest.approx(1.0)
+    assert s["total_s"] == pytest.approx(3.0)
+    assert spans[(4, 0, 0)]["complete"] is False
+
+
+def test_trace_spans_count_churn():
+    log = TraceLog(clock=_fake_clock())
+    key = (2, 0, 0)
+    log.record("scheduled", key)
+    log.record("granted", key, worker="w:1")
+    log.record("lease_expired", key)
+    log.record("requeued", key)
+    log.record("granted", key, worker="w:2")
+    log.record("result_received", key, worker="w:2")
+    log.record("persisted", key)
+    (s,) = log.spans()
+    assert s["churn"] == 2
+    assert s["worker"] == "w:2"  # the worker that actually delivered
+    assert s["complete"] is True
+
+
+def test_trace_worker_skew():
+    log = TraceLog(clock=_fake_clock())
+    # w:1 takes 1 s per tile (grant at t, receive at t+1); w:2's single
+    # tile takes 3 s.
+    for i in range(2):
+        key = (4, 0, i)
+        log.record("granted", key, worker="w:1")
+        log.record("result_received", key, worker="w:1")
+    key = (4, 1, 0)
+    log.record("granted", key, worker="w:2")
+    log.record("result_received", key, worker="w:2")
+    skew = log.worker_skew()
+    assert skew["workers"]["w:1"]["tiles"] == 2
+    assert skew["workers"]["w:2"]["tiles"] == 1
+    assert skew["skew"] >= 1.0
+    assert TraceLog().worker_skew() == {"workers": {}, "skew": None}
+
+
+# -- the HTTP exporter -----------------------------------------------------
+
+
+def test_exporter_endpoints_on_embedded_coordinator(tmp_path):
+    from distributedmandelbrot_tpu.core.workload import LevelSetting
+
+    from harness import CoordinatorHarness
+
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, 16)]) as co:
+        assert co.exporter_port
+        base = f"http://127.0.0.1:{co.exporter_port}"
+        assert urllib.request.urlopen(base + "/healthz",
+                                      timeout=10).read() == b"ok\n"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            text = resp.read().decode()
+        check = _load_check_metrics()
+        families = check.parse_exposition(text)
+        check.check_invariants(families)
+        # The untouched frontier is fully grantable.
+        assert families[obs_names.GAUGE_FRONTIER_DEPTH][
+            "samples"][0][2] == 4.0
+        varz = json.loads(urllib.request.urlopen(
+            base + "/varz", timeout=10).read())
+        assert varz["scheduler"] == {"frontier_depth": 4,
+                                     "outstanding_leases": 0,
+                                     "completed": 0, "total": 4}
+        assert varz["trace"]["recorded"] == 0
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        assert err.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                urllib.request.Request(base + "/metrics", data=b"x"),
+                timeout=10)
+        assert err.value.code == 405
+
+
+def test_exporter_opt_out(tmp_path):
+    from distributedmandelbrot_tpu.core.workload import LevelSetting
+
+    from harness import CoordinatorHarness
+
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, 16)],
+                            exporter=False) as co:
+        assert co.exporter_port is None
