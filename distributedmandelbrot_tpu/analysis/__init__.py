@@ -1,0 +1,26 @@
+"""Project-native static analysis (``dmtpu check``).
+
+Stdlib-``ast`` checkers for the farm's hand-enforced invariants: lock
+discipline in the threaded layers, async hygiene in the event-loop
+layers, wire-format parity between every speaker of the protocol, and
+purity/precision rules inside JAX-traced functions.  Importing this
+package never imports jax (or the modules under analysis) — the tier-1
+gate runs it in a bare subprocess in well under a second.
+"""
+
+from distributedmandelbrot_tpu.analysis.engine import (Finding, Project,
+                                                       Report, Rule,
+                                                       SourceFile, all_rules,
+                                                       check_project,
+                                                       default_root,
+                                                       load_baseline,
+                                                       render_json,
+                                                       render_text, run_check,
+                                                       save_baseline)
+
+__all__ = [
+    "Finding", "Project", "Report", "Rule", "SourceFile",
+    "all_rules", "check_project", "default_root",
+    "load_baseline", "save_baseline",
+    "render_json", "render_text", "run_check",
+]

@@ -1,0 +1,82 @@
+"""Small ``ast`` helpers shared by the rule modules (stdlib only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+FunctionNode = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+def attr_chain(node: ast.expr) -> Optional[list[str]]:
+    """Dotted name parts of a Name/Attribute chain, outermost first:
+    ``self.store.load_payload`` -> ``["self", "store", "load_payload"]``.
+    None when the chain passes through anything else (a call, a
+    subscript), because then the receiver's identity isn't lexical."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def call_chain(call: ast.Call) -> Optional[list[str]]:
+    return attr_chain(call.func)
+
+
+def self_attr(node: ast.expr) -> Optional[str]:
+    """``attr`` when the expression is exactly ``self.<attr>``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def subscript_base_self_attr(node: ast.expr) -> Optional[str]:
+    """``attr`` when the expression is ``self.<attr>[...][...]``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return self_attr(node)
+
+
+def dotted_names(node: ast.AST) -> Iterator[str]:
+    """Every dotted name mentioned anywhere inside ``node`` (decorator
+    matching: ``partial(jax.jit, ...)`` yields ``partial`` and
+    ``jax.jit``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            chain = attr_chain(sub)
+            if chain:
+                yield ".".join(chain)
+
+
+def class_defs(tree: ast.Module) -> Iterator[ast.ClassDef]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def methods_of(cls: ast.ClassDef) -> Iterator[FunctionNode]:
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def walk_skipping_nested_async(node: ast.AST) -> Iterator[ast.AST]:
+    """Like ``ast.walk`` over a function body, but does not descend into
+    nested ``async def``s (each async def is analyzed as its own scope).
+    Nested *sync* defs and lambdas ARE descended into: lexically they run
+    wherever they are called from, which for our rules is the enclosing
+    coroutine unless shipped off-loop (and then the call node we flag
+    does not appear — ``asyncio.to_thread(f, x)`` passes ``f`` uncalled)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, ast.AsyncFunctionDef):
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))

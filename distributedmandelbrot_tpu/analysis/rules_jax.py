@@ -1,0 +1,160 @@
+"""JAX tracing purity/precision rules for ops/ and parallel/.
+
+A function is *traced* when it is decorated with anything whose dotted
+name ends in ``jit`` / ``pjit`` (this sees through ``@partial(jax.jit,
+static_argnames=...)`` — the repo's idiom), when its name is passed to
+``jit()`` explicitly, or when it is handed to ``pallas_call`` as the
+kernel.  Inside a traced function the Python interpreter runs ONCE, at
+trace time, so:
+
+``jax-impure`` — ``print``, ``time.*``, ``random.*`` / ``np.random.*``,
+and ``global`` statements execute at trace time only (or worse, retrace
+per call) and silently vanish from the compiled computation.
+
+``jax-host-sync`` — ``np.asarray`` / ``np.array`` on a tracer,
+``.block_until_ready()``, and ``float()`` force a device->host transfer
+mid-trace; they either fail under jit or destroy async dispatch.
+
+``jax-dtype`` — 64-bit dtype literals (``float64`` & co.) silently
+downgrade to 32-bit unless x64 mode is on; modules must route through
+utils/precision (``ensure_x64``).  The warning fires only in modules
+that do NOT import ``ensure_x64`` — escape_time.py and families.py
+import it and their host wrappers call it before dispatching into jit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from distributedmandelbrot_tpu.analysis.astutil import (FunctionNode,
+                                                        call_chain,
+                                                        dotted_names)
+from distributedmandelbrot_tpu.analysis.engine import (Finding, Project, Rule,
+                                                       SourceFile)
+
+RULES = (
+    Rule("jax-impure", "jax", "error",
+         "Python side effect inside a jit/pjit/pallas-traced function"),
+    Rule("jax-host-sync", "jax", "error",
+         "host synchronization inside a traced function"),
+    Rule("jax-dtype", "jax", "warning",
+         "64-bit dtype literal in a traced function bypassing "
+         "utils/precision"),
+)
+
+SCOPE_DIRS = ("ops", "parallel")
+
+JIT_NAMES = ("jit", "pjit")
+
+DTYPE_64 = frozenset({"float64", "int64", "uint64", "complex128"})
+
+NUMPY_HEADS = ("np", "numpy", "jnp")
+
+
+def _is_traced_decorator(dec: ast.expr) -> bool:
+    return any(d.rsplit(".", 1)[-1] in JIT_NAMES for d in dotted_names(dec))
+
+
+def _traced_functions(sf: SourceFile) -> Iterator[FunctionNode]:
+    """Functions compiled by XLA: jit-decorated, jit-wrapped by name, or
+    passed to pallas_call as the kernel."""
+    wrapped: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = call_chain(node)
+        if not chain:
+            continue
+        last = chain[-1]
+        if (last in JIT_NAMES or last == "pallas_call") and node.args \
+                and isinstance(node.args[0], ast.Name):
+            wrapped.add(node.args[0].id)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in wrapped \
+                    or any(_is_traced_decorator(d)
+                           for d in node.decorator_list):
+                yield node
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.in_dirs(*SCOPE_DIRS):
+        has_precision = _imports_ensure_x64(sf)
+        for fn in _traced_functions(sf):
+            findings.extend(_check_traced(sf, fn, has_precision))
+    return findings
+
+
+def _imports_ensure_x64(sf: SourceFile) -> bool:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.endswith("precision"):
+            if any(a.name == "ensure_x64" for a in node.names):
+                return True
+    return any(d.endswith("precision.ensure_x64")
+               for d in dotted_names(sf.tree))
+
+
+def _check_traced(sf: SourceFile, fn: FunctionNode,
+                  has_precision: bool) -> list[Finding]:
+    out: list[Finding] = []
+
+    def flag(rule: str, severity: str, line: int, msg: str) -> None:
+        out.append(Finding(rule, severity, sf.relpath, line,
+                           f"{msg} (in traced function {fn.name})"))
+
+    # Nested defs inside a traced function are traced too -> full walk,
+    # but skip the decorator list (it runs at def time, outside the trace).
+    for stmt in fn.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Global):
+                flag("jax-impure", "error", node.lineno,
+                     "global statement: mutation happens at trace time, "
+                     "not per call")
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_chain(node)
+            if not chain:
+                continue
+            dotted = ".".join(chain)
+            if chain == ["print"]:
+                flag("jax-impure", "error", node.lineno,
+                     "print() runs at trace time only (use jax.debug.print)")
+            elif chain[0] == "time":
+                flag("jax-impure", "error", node.lineno,
+                     f"{dotted}() is evaluated once at trace time")
+            elif chain[0] == "random" or (len(chain) >= 2
+                                          and chain[0] in ("np", "numpy")
+                                          and chain[1] == "random"):
+                flag("jax-impure", "error", node.lineno,
+                     f"{dotted}() is host randomness, frozen at trace time "
+                     f"(use jax.random with an explicit key)")
+            elif chain[-1] == "block_until_ready":
+                flag("jax-host-sync", "error", node.lineno,
+                     ".block_until_ready() forces a device sync mid-trace")
+            elif chain[0] in ("np", "numpy") and chain[-1] in ("asarray",
+                                                              "array"):
+                flag("jax-host-sync", "error", node.lineno,
+                     f"{dotted}() materializes a tracer on the host")
+            elif chain == ["float"]:
+                flag("jax-host-sync", "error", node.lineno,
+                     "float() on a tracer forces a host transfer")
+    if not has_precision:
+        for stmt in fn.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value in DTYPE_64:
+                    flag("jax-dtype", "warning", node.lineno,
+                         f'dtype literal "{node.value}" without '
+                         f"utils/precision.ensure_x64 in the module")
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr in DTYPE_64 \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in NUMPY_HEADS:
+                    flag("jax-dtype", "warning", node.lineno,
+                         f"dtype literal {node.value.id}.{node.attr} "
+                         f"without utils/precision.ensure_x64 in the module")
+    return out

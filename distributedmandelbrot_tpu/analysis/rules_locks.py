@@ -1,0 +1,284 @@
+"""Lock-discipline rules for the threaded layers.
+
+``lock-guard`` — per-class inference: an attribute accessed under a
+``with self.<lock>:`` block anywhere in the class is *guarded* by that
+lock; a mutation of a guarded attribute while NOT holding its lock
+(outside ``__init__`` — construction is single-threaded) is flagged.
+The inference is deliberately evidence-based rather than annotation-
+based: the codebase's convention IS the spec, and the rule catches the
+one call site that forgets it.
+
+``lock-order`` — a global lock-acquisition-order graph: acquiring lock B
+while holding lock A adds edge ``A -> B`` (lexical ``with`` nesting,
+plus one level of same-class call propagation: ``self.m()`` under A
+contributes edges from A to every lock ``m`` acquires directly).  Any
+cycle is a deadlock risk.  Nodes are ``ClassName.lockattr``, so an
+order inversion *across* classes is caught as long as both acquisitions
+are lexically visible.
+
+Scope: coordinator/, storage/, serve/, obs/ — the modules where the
+asyncio loop and worker/client threads genuinely share state.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter as _TallyCounter
+from typing import Optional
+
+from distributedmandelbrot_tpu.analysis.astutil import (FunctionNode,
+                                                        call_chain,
+                                                        class_defs,
+                                                        methods_of, self_attr,
+                                                        subscript_base_self_attr)
+from distributedmandelbrot_tpu.analysis.engine import (Finding, Project, Rule,
+                                                       SourceFile)
+
+RULES = (
+    Rule("lock-guard", "locks", "error",
+         "mutation of a lock-guarded attribute without holding its lock"),
+    Rule("lock-order", "locks", "warning",
+         "cycle in the lock acquisition-order graph (deadlock risk)"),
+)
+
+SCOPE_DIRS = ("coordinator", "storage", "serve", "obs")
+
+# Method calls that mutate their receiver in place.
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "move_to_end", "sort", "reverse",
+})
+
+
+class _ClassAnalysis:
+    """Everything the two rules need from one class body."""
+
+    def __init__(self, sf: SourceFile, cls: ast.ClassDef) -> None:
+        self.sf = sf
+        self.cls = cls
+        self.lock_attrs = self._find_lock_attrs()
+        # attr -> tally of the lock(s) held when it was accessed under one
+        self.guard_evidence: dict[str, _TallyCounter] = {}
+        # (attr, line, held, method) for every mutation site
+        self.mutations: list[tuple[str, int, tuple[str, ...], str]] = []
+        # lock -> lock lexical acquisition edges, with first line seen
+        self.edges: dict[tuple[str, str], int] = {}
+        # locks each method acquires directly (for call propagation)
+        self.method_locks: dict[str, set[str]] = {}
+        # (held locks, same-class callee, line) — call made under a lock
+        self.calls_held: list[tuple[tuple[str, ...], str, int]] = []
+        for meth in methods_of(cls):
+            self.method_locks.setdefault(meth.name, set())
+            self._walk(meth, meth)
+
+    def _find_lock_attrs(self) -> set[str]:
+        """An attribute used as a bare ``with self.X:`` context anywhere
+        in the class is a lock (covers both ``self._lock = Lock()`` and
+        locks injected through ``__init__`` parameters)."""
+        locks: set[str] = set()
+        for node in ast.walk(self.cls):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = self_attr(item.context_expr)
+                    if attr is not None:
+                        locks.add(attr)
+        return locks
+
+    # -- the walk ---------------------------------------------------------
+
+    def _walk(self, meth: FunctionNode, root: FunctionNode) -> None:
+        def visit(node: ast.AST, held: tuple[str, ...]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner_held = held
+                for item in node.items:
+                    attr = self_attr(item.context_expr)
+                    if attr is not None and attr in self.lock_attrs:
+                        for outer in inner_held:
+                            self.edges.setdefault(
+                                (outer, attr), item.context_expr.lineno)
+                        self.method_locks[meth.name].add(attr)
+                        inner_held = inner_held + (attr,)
+                    else:
+                        visit(item.context_expr, held)
+                    if item.optional_vars is not None:
+                        visit(item.optional_vars, held)
+                for stmt in node.body:
+                    visit(stmt, inner_held)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not root:
+                # A nested def's body runs at some later call, not under
+                # the locks lexically around its definition — analyzing
+                # it here would produce both false hits and false passes.
+                return
+            self._inspect(node, held, meth.name)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in meth.body:
+            visit(stmt, ())
+
+    def _inspect(self, node: ast.AST, held: tuple[str, ...],
+                 method: str) -> None:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                self._mutation_target(target, held, method)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if getattr(node, "value", None) is not None \
+                    or isinstance(node, ast.AugAssign):
+                self._mutation_target(node.target, held, method)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                self._mutation_target(target, held, method)
+        elif isinstance(node, ast.Call):
+            chain = call_chain(node)
+            if chain and chain[0] == "self" and len(chain) >= 3 \
+                    and chain[-1] in MUTATORS:
+                self._record_mutation(chain[1], node.lineno, held, method)
+            elif chain and chain[0] == "self" and len(chain) == 2:
+                if held:
+                    self.calls_held.append((held, chain[1], node.lineno))
+        elif isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load) and held:
+            attr = self_attr(node)
+            if attr is not None and attr not in self.lock_attrs:
+                tally = self.guard_evidence.setdefault(attr, _TallyCounter())
+                tally[held[-1]] += 0  # presence only; reads don't pick a lock
+                tally.update([held[-1]])
+
+    def _mutation_target(self, target: ast.expr, held: tuple[str, ...],
+                         method: str) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._mutation_target(elt, held, method)
+            return
+        attr = self_attr(target)
+        if attr is None:
+            attr = subscript_base_self_attr(target)
+        if attr is not None and attr not in self.lock_attrs:
+            self._record_mutation(attr, target.lineno, held, method)
+
+    def _record_mutation(self, attr: str, line: int, held: tuple[str, ...],
+                         method: str) -> None:
+        self.mutations.append((attr, line, held, method))
+        if held:
+            self.guard_evidence.setdefault(
+                attr, _TallyCounter()).update([held[-1]])
+
+
+def check(project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    # Global acquisition-order graph: node "Class.lock" -> successors,
+    # with the (path, line) of the first edge for reporting.
+    graph: dict[str, set[str]] = {}
+    edge_site: dict[tuple[str, str], tuple[str, int]] = {}
+
+    for sf in project.in_dirs(*SCOPE_DIRS):
+        for cls in class_defs(sf.tree):
+            info = _ClassAnalysis(sf, cls)
+            if not info.lock_attrs:
+                continue
+            findings.extend(_guard_findings(sf, cls, info))
+            for (outer, inner), line in info.edges.items():
+                a, b = f"{cls.name}.{outer}", f"{cls.name}.{inner}"
+                graph.setdefault(a, set()).add(b)
+                edge_site.setdefault((a, b), (sf.relpath, line))
+            for held, callee, line in info.calls_held:
+                for inner in info.method_locks.get(callee, ()):
+                    for outer in held:
+                        a = f"{cls.name}.{outer}"
+                        b = f"{cls.name}.{inner}"
+                        if a != b:
+                            graph.setdefault(a, set()).add(b)
+                            edge_site.setdefault((a, b), (sf.relpath, line))
+
+    findings.extend(_order_findings(graph, edge_site))
+    return findings
+
+
+def _guard_findings(sf: SourceFile, cls: ast.ClassDef,
+                    info: _ClassAnalysis) -> list[Finding]:
+    out: list[Finding] = []
+    guard_lock = {attr: tally.most_common(1)[0][0]
+                  for attr, tally in info.guard_evidence.items() if tally}
+    for attr, line, held, method in info.mutations:
+        if method == "__init__":
+            continue
+        lock = guard_lock.get(attr)
+        if lock is None or lock in held:
+            continue
+        out.append(Finding(
+            "lock-guard", "error", sf.relpath, line,
+            f"{cls.name}.{attr} is guarded by self.{lock} elsewhere in the "
+            f"class but mutated in {method}() without holding it"))
+    return out
+
+
+def _order_findings(graph: dict[str, set[str]],
+                    edge_site: dict[tuple[str, str], tuple[str, int]]
+                    ) -> list[Finding]:
+    """Report each strongly connected component with a cycle once."""
+    out: list[Finding] = []
+    for scc in _sccs(graph):
+        nodes = sorted(scc)
+        has_cycle = len(nodes) > 1 or (
+            nodes and nodes[0] in graph.get(nodes[0], ()))
+        if not has_cycle:
+            continue
+        site = min((edge_site[(a, b)] for a in nodes
+                    for b in graph.get(a, ()) if b in scc
+                    and (a, b) in edge_site), default=("<unknown>", 1))
+        out.append(Finding(
+            "lock-order", "warning", site[0], site[1],
+            "lock acquisition-order cycle (deadlock risk): "
+            + " -> ".join(nodes + [nodes[0]])))
+    return out
+
+
+def _sccs(graph: dict[str, set[str]]) -> list[set[str]]:
+    """Tarjan's strongly connected components, iterative."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[set[str]] = []
+    counter = [0]
+    all_nodes = set(graph) | {b for succ in graph.values() for b in succ}
+
+    for start in sorted(all_nodes):
+        if start in index:
+            continue
+        work: list[tuple[str, Optional[str], int]] = [(start, None, 0)]
+        while work:
+            node, parent, child_i = work.pop()
+            if child_i == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recurse = False
+            successors = sorted(graph.get(node, ()))
+            for i in range(child_i, len(successors)):
+                succ = successors[i]
+                if succ not in index:
+                    work.append((node, parent, i + 1))
+                    work.append((succ, node, 0))
+                    recurse = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if recurse:
+                continue
+            if low[node] == index[node]:
+                scc: set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+            if parent is not None:
+                low[parent] = min(low[parent], low[node])
+    return sccs

@@ -1275,10 +1275,83 @@ def cmd_stats(argv: Sequence[str]) -> int:
             return 0
 
 
+def cmd_check(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu check",
+        description="Run the project-native static analysis suite "
+                    "(lock discipline, async hygiene, wire-format parity, "
+                    "JAX purity) over the package.  Exits 0 when clean, "
+                    "1 when there are unsuppressed findings.")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the versioned JSON report instead of text")
+    parser.add_argument("--rules", nargs="+", metavar="RULE",
+                        help="run only these rule ids")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    parser.add_argument("--root", default=None,
+                        help="repo root to scan (default: the checkout "
+                             "containing the installed package)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON path (default: "
+                             "<root>/tools/lint_baseline.json if present)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline file to grandfather "
+                             "every current finding, then exit 0")
+    args = parser.parse_args(argv)
+
+    # Imported lazily so `dmtpu coordinator` & co. never pay for it; the
+    # analysis package itself never imports jax (gated by the tier-1 test).
+    from distributedmandelbrot_tpu import analysis
+
+    if args.list_rules:
+        for rule in sorted(analysis.all_rules().values(),
+                           key=lambda r: (r.family, r.id)):
+            print(f"{rule.id:20} {rule.severity:8} [{rule.family}] "
+                  f"{rule.doc}")
+        return 0
+
+    root = args.root or analysis.default_root()
+    import os
+    baseline_path = args.baseline or os.path.join(
+        str(root), "tools", "lint_baseline.json")
+    project = analysis.Project.from_root(root)
+
+    try:
+        if args.update_baseline:
+            findings = analysis.check_project(project, args.rules)
+            kept = [f for f in findings
+                    if not (project.file(f.path) or _NO_FILE)
+                    .is_suppressed(f.line, f.rule)]
+            analysis.save_baseline(baseline_path, kept)
+            print(f"dmtpu check: baseline rewritten with {len(kept)} "
+                  f"finding(s) -> {baseline_path}")
+            return 0
+        baseline = (analysis.load_baseline(baseline_path)
+                    if os.path.exists(baseline_path) else set())
+        report = analysis.run_check(project, args.rules, baseline)
+    except ValueError as e:
+        print(f"dmtpu check: {e}", file=sys.stderr)
+        return 2
+    print(analysis.render_json(report) if args.json
+          else analysis.render_text(report))
+    return 0 if report.clean else 1
+
+
+class _NoFile:
+    """Stand-in for findings on unparseable files (no suppressions)."""
+
+    @staticmethod
+    def is_suppressed(line: int, rule: str) -> bool:
+        return False
+
+
+_NO_FILE = _NoFile()
+
+
 COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
             "serve": cmd_serve, "viewer": cmd_viewer, "render": cmd_render,
             "animate": cmd_animate, "compact": cmd_compact,
-            "stats": cmd_stats}
+            "stats": cmd_stats, "check": cmd_check}
 
 
 def _enable_compile_cache() -> None:
@@ -1336,7 +1409,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
               "{coordinator|worker|serve|viewer|render|animate|compact|"
-              "stats} [options]\n"
+              "stats|check} [options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

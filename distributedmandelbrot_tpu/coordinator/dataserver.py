@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import struct
 from typing import Optional
 
 from distributedmandelbrot_tpu.net import framing
@@ -24,8 +23,6 @@ from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils.metrics import Counters
 
 logger = logging.getLogger("dmtpu.dataserver")
-
-_QUERY = struct.Struct("<III")
 
 
 class DataServer:
@@ -60,15 +57,15 @@ class DataServer:
                     # Same per-read deadline as the write side (reference:
                     # DataServer.cs:11): idle or stalled clients are closed
                     # and re-dial instead of pinning this task.
-                    raw = await framing.read_exact(reader, _QUERY.size) \
+                    raw = await framing.read_exact(reader, proto.QUERY.size) \
                         if self.read_timeout is None else \
                         await asyncio.wait_for(
-                            framing.read_exact(reader, _QUERY.size),
+                            framing.read_exact(reader, proto.QUERY.size),
                             self.read_timeout)
                 except (ConnectionError, TimeoutError,
                         asyncio.TimeoutError):
                     break  # clean EOF / idle close between queries
-                level, index_real, index_imag = _QUERY.unpack(raw)
+                level, index_real, index_imag = proto.QUERY.unpack(raw)
                 await self._serve_query(writer, level, index_real, index_imag)
                 await writer.drain()
         except (ConnectionError, asyncio.CancelledError):

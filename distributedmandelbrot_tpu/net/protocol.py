@@ -29,6 +29,13 @@ or ``QUERY_NOT_AVAILABLE``.
 
 from __future__ import annotations
 
+import struct
+
+# Re-exported so every protocol speaker can take the workload frame size
+# from one module; the format itself lives with the Workload dataclass.
+from distributedmandelbrot_tpu.core.workload import \
+    WORKLOAD_WIRE_SIZE  # noqa: F401  (canonical re-export)
+
 # Distributer: connection purpose
 PURPOSE_REQUEST = 0x00
 PURPOSE_RESPONSE = 0x01
@@ -57,6 +64,23 @@ QUERY_OVERLOADED = 0x03
 # query.  The value is an impossible level (a level-4294967295 grid), so
 # the two framings can never collide.
 GATEWAY_BATCH_MAGIC = 0xFFFFFFFF
+
+# Canonical precompiled wire structs.  These are THE definitions: server
+# and client modules import them instead of re-typing format strings (the
+# reference's DataChunk.cs:14-15 drift, mechanically excluded here — the
+# wire-literal/wire-parity checkers in analysis/ flag any copy).
+#
+# DataServer/gateway query: (level, index_real, index_imag), 3 x u32 LE.
+QUERY = struct.Struct("<III")
+QUERY_WIRE_SIZE = 12
+# The query minus its leading u32: what the gateway still has to read
+# after sniffing the first u32 for GATEWAY_BATCH_MAGIC.  Must compose
+# with QUERY byte-for-byte (checked by the wire-size rule).
+QUERY_TAIL = struct.Struct("<II")
+QUERY_TAIL_WIRE_SIZE = 8
+# Gateway batch header: (GATEWAY_BATCH_MAGIC, count), 2 x u32 LE.
+BATCH_HEADER = struct.Struct("<II")
+BATCH_HEADER_WIRE_SIZE = 8
 
 DEFAULT_DISTRIBUTER_PORT = 59010
 DEFAULT_DATASERVER_PORT = 59011

@@ -187,7 +187,8 @@ class Registry:
                 labels: Optional[Mapping[str, str]] = None,
                 help: Optional[str] = None) -> Counter:
         if help:
-            self._help.setdefault(name, help)
+            with self._lock:
+                self._help.setdefault(name, help)
         return self._get(name, "counter", labels,
                          lambda lk: Counter(name, lk, self._lock))
 
@@ -196,7 +197,8 @@ class Registry:
               help: Optional[str] = None,
               fn: Optional[Callable[[], float]] = None) -> Gauge:
         if help:
-            self._help.setdefault(name, help)
+            with self._lock:
+                self._help.setdefault(name, help)
         g = self._get(name, "gauge", labels,
                       lambda lk: Gauge(name, lk, self._lock, fn=fn))
         if fn is not None:
@@ -207,14 +209,15 @@ class Registry:
                   labels: Optional[Mapping[str, str]] = None,
                   buckets: Optional[Sequence[float]] = None,
                   help: Optional[str] = None) -> Histogram:
-        if help:
-            self._help.setdefault(name, help)
         # Every child of a family shares the first-registered bounds, or
         # the merged family percentiles would be meaningless.
-        if buckets is not None:
-            self._buckets.setdefault(name, tuple(sorted(
-                float(b) for b in buckets)))
-        bounds = self._buckets.setdefault(name, DEFAULT_BUCKETS)
+        with self._lock:
+            if help:
+                self._help.setdefault(name, help)
+            if buckets is not None:
+                self._buckets.setdefault(name, tuple(sorted(
+                    float(b) for b in buckets)))
+            bounds = self._buckets.setdefault(name, DEFAULT_BUCKETS)
         return self._get(name, "histogram", labels,
                          lambda lk: Histogram(name, lk, self._lock, bounds))
 

@@ -55,6 +55,12 @@ HIST_WORKER_UPLOAD_SECONDS = "worker_upload_seconds"
 HIST_STORE_READ_SECONDS = "store_read_seconds"
 HIST_STORE_WRITE_SECONDS = "store_write_seconds"
 
+# -- coordinator: legacy dataserver ---------------------------------------
+
+DATASERVER_QUERIES_SERVED = "queries_served"
+DATASERVER_QUERIES_REJECTED = "queries_rejected"
+DATASERVER_QUERIES_UNAVAILABLE = "queries_unavailable"
+
 # -- serving gateway + caches ---------------------------------------------
 
 GATEWAY_QUERIES = "gateway_queries"
@@ -71,6 +77,12 @@ TILE_CACHE_PROMOTIONS = "tile_cache_promotions"
 TILE_CACHE_STORE_MISSES = "tile_cache_store_misses"
 GAUGE_TIER1_HIT_RATIO = "tile_cache_tier1_hit_ratio"
 GAUGE_TIER2_HIT_RATIO = "tile_cache_tier2_hit_ratio"
+
+COALESCE_LEADERS = "coalesce_leaders"
+COALESCE_FOLLOWERS = "coalesce_followers"
+ONDEMAND_REQUESTS = "ondemand_requests"
+ONDEMAND_TIMEOUTS = "ondemand_timeouts"
+ONDEMAND_SERVED = "ondemand_served"
 
 # Gateway per-request outcome label values (one histogram, split by how
 # the request resolved).

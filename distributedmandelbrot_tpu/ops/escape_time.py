@@ -758,6 +758,7 @@ def _escape_smooth_jit(zr0: jax.Array, zi0: jax.Array,
     # which would corrupt nu (to -inf/NaN, rendered as in-set).  Pinning
     # both to the dtype max costs a bounded correction error on exactly
     # those saturated lanes.
+    # dmtpu: ignore[jax-host-sync] — finfo(dtype).max is static metadata, not a tracer
     big = float(jnp.finfo(dtype).max)
     mag2 = jnp.clip(jnp.nan_to_num(zr * zr + zi * zi, nan=big, posinf=big),
                     b2, big)

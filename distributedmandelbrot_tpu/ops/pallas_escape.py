@@ -991,6 +991,7 @@ def _pallas_smooth(params, mrd=None, *, height: int, width: int,
     kernel = partial(_smooth_block_kernel, max_iter=max_iter,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
                      block_h=block_h, block_w=block_w,
+                     # dmtpu: ignore[jax-host-sync] — bailout is a static_argnames python float
                      bailout=float(bailout), extra=extra,
                      interior_check=interior_check,
                      cycle_check=cycle_check, julia=julia, power=power,

@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import struct
 import time
 from typing import Callable, Optional
 
@@ -46,8 +45,6 @@ from distributedmandelbrot_tpu.serve.ondemand import OnDemandComputer
 from distributedmandelbrot_tpu.utils.metrics import Counters
 
 logger = logging.getLogger("dmtpu.gateway")
-
-_QUERY = struct.Struct("<III")
 
 MAX_BATCH_QUERIES = 4096  # mirrors the distributer's MAX_BATCH bound
 
@@ -162,8 +159,9 @@ class TileGateway:
                 if first == proto.GATEWAY_BATCH_MAGIC:
                     await self._serve_batch(reader, writer)
                 else:
-                    rest = await self._read(framing.read_exact(reader, 8))
-                    index_real, index_imag = struct.unpack("<II", rest)
+                    rest = await self._read(framing.read_exact(
+                        reader, proto.QUERY_TAIL.size))
+                    index_real, index_imag = proto.QUERY_TAIL.unpack(rest)
                     status, payload = await self._resolve_admitted(
                         first, index_real, index_imag)
                     self._write_response(writer, status, payload)
@@ -186,8 +184,9 @@ class TileGateway:
         count = await self._read(framing.read_u32(reader))
         if count == 0 or count > MAX_BATCH_QUERIES:
             raise framing.ProtocolError(f"bad batch count {count}")
-        raw = await self._read(framing.read_exact(reader, count * _QUERY.size))
-        queries = [_QUERY.unpack_from(raw, n * _QUERY.size)
+        raw = await self._read(framing.read_exact(
+            reader, count * proto.QUERY.size))
+        queries = [proto.QUERY.unpack_from(raw, n * proto.QUERY.size)
                    for n in range(count)]
         self.counters.inc("gateway_batches")
         # Resolve concurrently — neighbours coalesce and overlap their

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import enum
 import socket
-import struct
 from typing import Optional
 
 import numpy as np
@@ -24,8 +23,6 @@ import numpy as np
 from distributedmandelbrot_tpu.core.chunk import Chunk
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
-
-_QUERY = struct.Struct("<III")
 
 
 class FetchStatus(enum.Enum):
@@ -85,7 +82,7 @@ class DataClient:
     def _fetch_once(self, level: int, index_real: int, index_imag: int
                     ) -> tuple[Optional[np.ndarray], FetchStatus]:
         sock = self._connected()
-        framing.send_all(sock, _QUERY.pack(level, index_real, index_imag))
+        framing.send_all(sock, proto.QUERY.pack(level, index_real, index_imag))
         return self._read_response(sock)
 
     def _read_response(self, sock: socket.socket
@@ -121,8 +118,9 @@ class DataClient:
                          ) -> list[tuple[Optional[np.ndarray], FetchStatus]]:
         sock = self._connected()
         request = bytearray()
-        request += struct.pack("<II", proto.GATEWAY_BATCH_MAGIC, len(queries))
+        request += proto.BATCH_HEADER.pack(proto.GATEWAY_BATCH_MAGIC,
+                                           len(queries))
         for level, index_real, index_imag in queries:
-            request += _QUERY.pack(level, index_real, index_imag)
+            request += proto.QUERY.pack(level, index_real, index_imag)
         framing.send_all(sock, bytes(request))
         return [self._read_response(sock) for _ in queries]

@@ -14,10 +14,10 @@ from typing import Optional, Sequence
 import numpy as np
 
 from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
-from distributedmandelbrot_tpu.core.workload import (WORKLOAD_WIRE_SIZE,
-                                                     Workload)
+from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.protocol import WORKLOAD_WIRE_SIZE
 
 
 class DistributerClient:

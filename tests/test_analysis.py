@@ -1,0 +1,488 @@
+"""Unit tests for the static-analysis package (``dmtpu check``).
+
+Every rule id gets at least one firing fixture and one clean fixture,
+plus engine behavior: inline suppressions, baseline matching (including
+stale entries), the JSON report schema, and parse-error reporting.
+All fixtures go through ``Project.from_sources`` — no disk, no jax.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from distributedmandelbrot_tpu import analysis
+from distributedmandelbrot_tpu.analysis import (Project, all_rules,
+                                                check_project, run_check)
+
+P = "distributedmandelbrot_tpu"
+
+
+def findings_for(sources: dict[str, str], rule: str) -> list:
+    project = Project.from_sources(sources)
+    return [f for f in check_project(project) if f.rule == rule]
+
+
+# -- catalogue -------------------------------------------------------------
+
+def test_rule_catalogue_covers_all_four_families():
+    rules = all_rules()
+    families = {r.family for r in rules.values()}
+    assert {"locks", "async", "wire", "jax", "engine"} <= families
+    for rule in rules.values():
+        assert rule.severity in ("error", "warning")
+        assert rule.doc
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(ValueError, match="unknown rule ids"):
+        check_project(Project.from_sources({}), ["no-such-rule"])
+
+
+# -- locks -----------------------------------------------------------------
+
+LOCK_GUARD_FIRE = f"{P}/serve/stateful.py"
+
+LOCK_CLASS = '''
+import threading
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def rogue(self, k):
+        self._items.pop(k, None)
+'''
+
+
+def test_lock_guard_fires_on_unlocked_mutation():
+    found = findings_for({LOCK_GUARD_FIRE: LOCK_CLASS}, "lock-guard")
+    assert len(found) == 1
+    f = found[0]
+    assert f.severity == "error"
+    assert "_items" in f.message and "rogue" in f.message
+
+
+def test_lock_guard_clean_when_mutation_is_locked():
+    src = LOCK_CLASS.replace(
+        "        self._items.pop(k, None)",
+        "        with self._lock:\n            self._items.pop(k, None)")
+    assert findings_for({LOCK_GUARD_FIRE: src}, "lock-guard") == []
+
+
+def test_lock_guard_ignores_init_and_out_of_scope_dirs():
+    # __init__ writes without the lock by design; and the same rogue
+    # class outside coordinator/storage/serve/obs is not scanned.
+    assert findings_for({f"{P}/core/stateful.py": LOCK_CLASS},
+                        "lock-guard") == []
+
+
+LOCK_ORDER_CYCLE = f'''
+class A:
+    def f(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def g(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+
+def test_lock_order_reports_cycle():
+    found = findings_for({f"{P}/storage/locks.py": LOCK_ORDER_CYCLE},
+                         "lock-order")
+    assert len(found) == 1
+    assert "A._a" in found[0].message and "A._b" in found[0].message
+
+
+def test_lock_order_clean_on_consistent_order():
+    src = LOCK_ORDER_CYCLE.replace(
+        "        with self._b:\n            with self._a:",
+        "        with self._a:\n            with self._b:")
+    assert findings_for({f"{P}/storage/locks.py": src}, "lock-order") == []
+
+
+def test_lock_order_sees_through_same_class_calls():
+    src = '''
+class A:
+    def outer(self):
+        with self._a:
+            self.inner()
+
+    def inner(self):
+        with self._b:
+            pass
+
+    def inverted(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+    found = findings_for({f"{P}/obs/locks.py": src}, "lock-order")
+    assert len(found) == 1
+
+
+# -- async -----------------------------------------------------------------
+
+def test_async_blocking_fires_on_time_sleep_and_sync_framing():
+    src = '''
+import time
+from distributedmandelbrot_tpu.net import framing
+
+class Handler:
+    async def handle(self, sock):
+        time.sleep(0.1)
+        framing.send_u32(sock, 1)
+'''
+    found = findings_for({f"{P}/serve/h.py": src}, "async-blocking")
+    assert len(found) == 2
+    assert any("time.sleep" in f.message for f in found)
+    assert any("send_u32" in f.message for f in found)
+
+
+def test_async_blocking_clean_via_to_thread_and_async_framing():
+    src = '''
+import asyncio
+from distributedmandelbrot_tpu.net import framing
+
+class Handler:
+    async def handle(self, reader):
+        await asyncio.sleep(0.1)
+        n = await framing.read_u32(reader)
+        payload = await asyncio.to_thread(self.store.load_payload, n, 0, 0)
+        return payload
+'''
+    assert findings_for({f"{P}/serve/h.py": src}, "async-blocking") == []
+
+
+def test_async_blocking_only_inside_async_defs():
+    src = '''
+import time
+
+def sync_helper():
+    time.sleep(0.1)
+'''
+    assert findings_for({f"{P}/serve/h.py": src}, "async-blocking") == []
+
+
+def test_async_unawaited_fires_on_bare_coroutine_call():
+    src = '''
+class G:
+    async def go(self):
+        pass
+
+    async def run(self):
+        self.go()
+'''
+    found = findings_for({f"{P}/serve/g.py": src}, "async-unawaited")
+    assert len(found) == 1
+    assert "self.go" in found[0].message
+
+
+def test_async_unawaited_clean_when_awaited_or_scheduled():
+    src = '''
+import asyncio
+
+class G:
+    async def go(self):
+        pass
+
+    async def run(self):
+        await self.go()
+        task = asyncio.create_task(self.go())
+        self._tasks.add(task)
+'''
+    assert findings_for({f"{P}/serve/g.py": src}, "async-unawaited") == []
+
+
+def test_async_dropped_task_fires_and_kept_task_is_clean():
+    fire = '''
+import asyncio
+
+async def work():
+    pass
+
+async def main():
+    asyncio.create_task(work())
+'''
+    kept = '''
+import asyncio
+
+async def work():
+    pass
+
+async def main():
+    task = asyncio.create_task(work())
+    tasks.add(task)
+    task.add_done_callback(tasks.discard)
+'''
+    assert len(findings_for({f"{P}/serve/t.py": fire},
+                            "async-dropped-task")) == 1
+    assert findings_for({f"{P}/serve/t.py": kept},
+                        "async-dropped-task") == []
+
+
+# -- wire ------------------------------------------------------------------
+
+def test_wire_literal_fires_outside_canonical_modules():
+    src = 'import struct\nHEADER = struct.Struct("<II")\n'
+    found = findings_for({f"{P}/serve/proto_copy.py": src}, "wire-literal")
+    assert len(found) == 1
+    assert '"<II"' in found[0].message
+
+
+def test_wire_literal_clean_in_canonical_modules():
+    src = 'import struct\n_FMT = struct.Struct("<II")\n'
+    for canonical in (f"{P}/net/protocol.py", f"{P}/codecs/custom.py"):
+        assert findings_for({canonical: src}, "wire-literal") == []
+
+
+def test_wire_size_fires_on_mismatched_constant():
+    src = ('import struct\n'
+           'QUERY = struct.Struct("<III")\n'
+           'QUERY_WIRE_SIZE = 16\n')
+    found = findings_for({f"{P}/net/protocol.py": src}, "wire-size")
+    assert len(found) == 1
+    assert "16" in found[0].message and "12" in found[0].message
+
+
+def test_wire_size_fires_on_broken_query_tail_composition():
+    src = ('import struct\n'
+           'QUERY = struct.Struct("<III")\n'
+           'QUERY_WIRE_SIZE = 12\n'
+           'QUERY_TAIL = struct.Struct("<IQ")\n')
+    found = findings_for({f"{P}/net/protocol.py": src}, "wire-size")
+    assert len(found) == 1
+    assert "byte-for-byte" in found[0].message
+
+
+def test_wire_size_clean_on_consistent_constants():
+    src = ('import struct\n'
+           'QUERY = struct.Struct("<III")\n'
+           'QUERY_WIRE_SIZE = 12\n'
+           'QUERY_TAIL = struct.Struct("<II")\n'
+           'QUERY_TAIL_WIRE_SIZE = 8\n')
+    assert findings_for({f"{P}/net/protocol.py": src}, "wire-size") == []
+
+
+def test_wire_parity_fires_when_speaker_retypes_format():
+    src = ('import struct\n'
+           '_QUERY = struct.Struct("<III")\n')
+    found = findings_for({f"{P}/coordinator/dataserver.py": src},
+                         "wire-parity")
+    assert len(found) == 1
+    assert "QUERY" in found[0].message
+
+
+def test_wire_parity_clean_when_canonical_struct_used():
+    src = ('from distributedmandelbrot_tpu.net import protocol as proto\n'
+           'SIZE = proto.QUERY.size\n')
+    assert findings_for({f"{P}/coordinator/dataserver.py": src},
+                        "wire-parity") == []
+    # Modules absent from the project are skipped, not reported.
+    assert findings_for({f"{P}/serve/other.py": "x = 1\n"},
+                        "wire-parity") == []
+
+
+# -- jax -------------------------------------------------------------------
+
+JIT_HEADER = ('from functools import partial\n'
+              'import jax\n'
+              'import jax.numpy as jnp\n'
+              'import numpy as np\n')
+
+
+def test_jax_impure_fires_on_print_time_random():
+    src = JIT_HEADER + '''
+import time, random
+
+@partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    print(x)
+    time.time()
+    random.random()
+    return x
+'''
+    found = findings_for({f"{P}/ops/kern.py": src}, "jax-impure")
+    assert len(found) == 3
+
+
+def test_jax_impure_clean_in_pure_jit_and_host_code():
+    src = JIT_HEADER + '''
+@partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return jnp.sin(x) * n
+
+def host_wrapper(x):
+    print("host side is allowed to print")
+    return f(x, 2)
+'''
+    assert findings_for({f"{P}/ops/kern.py": src}, "jax-impure") == []
+
+
+def test_jax_impure_fires_inside_pallas_kernel():
+    src = JIT_HEADER + '''
+def kernel(x_ref, o_ref):
+    print("trace me once")
+    o_ref[...] = x_ref[...]
+
+def run(pl, x):
+    return pl.pallas_call(kernel, out_shape=x)(x)
+'''
+    found = findings_for({f"{P}/ops/pk.py": src}, "jax-impure")
+    assert len(found) == 1
+
+
+def test_jax_host_sync_fires_on_asarray_and_float():
+    src = JIT_HEADER + '''
+@jax.jit
+def f(x):
+    y = np.asarray(x)
+    return float(x) + y.sum()
+'''
+    found = findings_for({f"{P}/parallel/sync.py": src}, "jax-host-sync")
+    assert len(found) == 2
+
+
+def test_jax_host_sync_clean_outside_traced_functions():
+    src = JIT_HEADER + '''
+def host(x):
+    return float(np.asarray(x).sum())
+'''
+    assert findings_for({f"{P}/parallel/sync.py": src}, "jax-host-sync") == []
+
+
+def test_jax_dtype_fires_without_precision_import():
+    src = JIT_HEADER + '''
+@jax.jit
+def f(x):
+    return x.astype("float64") + jnp.zeros((), np.int64)
+'''
+    found = findings_for({f"{P}/ops/dt.py": src}, "jax-dtype")
+    assert len(found) == 2
+    assert all(f.severity == "warning" for f in found)
+
+
+def test_jax_dtype_clean_when_module_routes_through_precision():
+    src = (JIT_HEADER
+           + 'from distributedmandelbrot_tpu.utils.precision import '
+             'ensure_x64\n'
+           + '''
+@jax.jit
+def f(x):
+    return x.astype("float64")
+''')
+    assert findings_for({f"{P}/ops/dt.py": src}, "jax-dtype") == []
+
+
+# -- engine: suppressions, baseline, reporters -----------------------------
+
+def test_inline_suppression_same_line_and_line_above():
+    same_line = LOCK_CLASS.replace(
+        "        self._items.pop(k, None)",
+        "        self._items.pop(k, None)  # dmtpu: ignore[lock-guard] ok")
+    line_above = LOCK_CLASS.replace(
+        "        self._items.pop(k, None)",
+        "        # dmtpu: ignore[lock-guard] single-threaded teardown\n"
+        "        self._items.pop(k, None)")
+    for src in (same_line, line_above):
+        report = run_check(Project.from_sources({LOCK_GUARD_FIRE: src}))
+        assert report.clean
+        assert [f.rule for f in report.suppressed] == ["lock-guard"]
+
+
+def test_suppression_wildcard_and_wrong_rule():
+    wildcard = LOCK_CLASS.replace(
+        "        self._items.pop(k, None)",
+        "        self._items.pop(k, None)  # dmtpu: ignore[*]")
+    wrong = LOCK_CLASS.replace(
+        "        self._items.pop(k, None)",
+        "        self._items.pop(k, None)  # dmtpu: ignore[wire-literal]")
+    assert run_check(Project.from_sources({LOCK_GUARD_FIRE: wildcard})).clean
+    report = run_check(Project.from_sources({LOCK_GUARD_FIRE: wrong}))
+    assert [f.rule for f in report.findings] == ["lock-guard"]
+
+
+def test_baseline_grandfathers_and_reports_stale(tmp_path):
+    project = Project.from_sources({LOCK_GUARD_FIRE: LOCK_CLASS})
+    finding = check_project(project, ["lock-guard"])[0]
+    path = tmp_path / "baseline.json"
+    analysis.save_baseline(path, [finding])
+    baseline = analysis.load_baseline(path)
+    report = run_check(project, baseline=baseline)
+    assert report.clean
+    assert [f.fingerprint() for f in report.baselined] == sorted(baseline)
+    # An entry matching nothing is stale and must be reported.
+    report = run_check(Project.from_sources({}), baseline={"gone::x.py::y"})
+    assert report.stale_baseline == ["gone::x.py::y"]
+
+
+def test_baseline_survives_line_drift():
+    project = Project.from_sources(
+        {LOCK_GUARD_FIRE: "# a new leading comment\n" + LOCK_CLASS})
+    shifted = check_project(project, ["lock-guard"])[0]
+    original = check_project(
+        Project.from_sources({LOCK_GUARD_FIRE: LOCK_CLASS}),
+        ["lock-guard"])[0]
+    assert shifted.line != original.line
+    assert shifted.fingerprint() == original.fingerprint()
+
+
+def test_parse_error_reported_as_finding():
+    report = run_check(Project.from_sources(
+        {f"{P}/serve/broken.py": "def f(:\n"}))
+    assert [f.rule for f in report.findings] == ["parse-error"]
+    assert report.findings[0].severity == "error"
+
+
+def test_json_report_schema():
+    report = run_check(Project.from_sources({LOCK_GUARD_FIRE: LOCK_CLASS}))
+    doc = json.loads(analysis.render_json(report))
+    assert doc["version"] == 1
+    assert set(doc["counts"]) == {"error", "warning", "total",
+                                  "suppressed", "baselined"}
+    assert doc["counts"]["total"] == len(doc["findings"]) == 1
+    assert set(doc["findings"][0]) == {"rule", "severity", "path",
+                                       "line", "message"}
+    assert doc["stale_baseline"] == []
+
+
+def test_text_report_format_is_clickable():
+    report = run_check(Project.from_sources({LOCK_GUARD_FIRE: LOCK_CLASS}))
+    line = analysis.render_text(report).splitlines()[0]
+    assert line.startswith(f"{LOCK_GUARD_FIRE}:")
+    assert ": error: [lock-guard]" in line
+
+
+# -- CLI: --update-baseline round trip -------------------------------------
+
+def test_cli_update_baseline_round_trip(tmp_path, capsys):
+    from distributedmandelbrot_tpu.cli import main
+    pkg = tmp_path / P / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "stateful.py").write_text(LOCK_CLASS)
+    baseline = tmp_path / "baseline.json"
+
+    # Dirty tree exits 1...
+    assert main(["check", "--root", str(tmp_path),
+                 "--baseline", str(baseline)]) == 1
+    # ...--update-baseline grandfathers it...
+    assert main(["check", "--root", str(tmp_path),
+                 "--baseline", str(baseline), "--update-baseline"]) == 0
+    # ...after which the same tree is clean and the entry is live (not
+    # stale).
+    assert main(["check", "--root", str(tmp_path),
+                 "--baseline", str(baseline), "--json"]) == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out[out.index('{'):])
+    assert doc["counts"]["baselined"] == 1
+    assert doc["stale_baseline"] == []

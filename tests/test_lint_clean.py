@@ -1,0 +1,52 @@
+"""Tier-1 gate: the repo itself passes ``dmtpu check`` with zero
+unsuppressed findings, fast, and without ever importing jax.
+
+This is the enforcement end of the analysis package: every future PR
+that breaks lock discipline, re-types a wire format, blocks the event
+loop, or dirties a traced function fails here, in a sub-second
+subprocess.  Runs the real CLI in a fresh interpreter so the no-jax
+claim is measured, not assumed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GATE_SCRIPT = """\
+import json, sys
+from distributedmandelbrot_tpu.cli import main
+rc = main(["check", "--json"])
+assert "jax" not in sys.modules, "dmtpu check must not import jax"
+sys.exit(rc)
+"""
+
+
+def test_repo_is_lint_clean_fast_and_jax_free():
+    t0 = time.monotonic()
+    result = subprocess.run(
+        [sys.executable, "-c", GATE_SCRIPT],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    elapsed = time.monotonic() - t0
+    assert result.returncode == 0, \
+        f"dmtpu check found problems:\n{result.stdout}\n{result.stderr}"
+    doc = json.loads(result.stdout)
+    assert doc["counts"]["total"] == 0, doc["findings"]
+    assert doc["stale_baseline"] == []
+    assert elapsed < 5.0, f"gate took {elapsed:.1f}s (budget 5s)"
+
+
+def test_metric_name_literals_are_registered():
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_metrics.py"),
+         "--offline", "--names"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert result.returncode == 0, \
+        f"check_metrics --names failed:\n{result.stdout}\n{result.stderr}"
+    assert "names:" in result.stdout

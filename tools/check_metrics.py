@@ -221,6 +221,76 @@ def check_live(url: str | None) -> None:
     print(f"live: {len(families)} families OK")
 
 
+# -- --names: instrumentation-site name audit ------------------------------
+
+# Methods that take a metric name as their first argument, on a Counters
+# facade or Registry receiver.
+_INSTRUMENT_METHODS = frozenset({
+    "inc", "get", "observe", "set_gauge", "timed",
+    "counter", "gauge", "histogram",
+})
+# Receiver spellings that identify a metrics object (so dict.get("key")
+# and friends don't trip the scan).
+_RECEIVER_HINTS = ("counter", "registry", "reg")
+
+
+def _known_metric_names() -> set[str]:
+    from distributedmandelbrot_tpu.obs import names as obs_names
+    known = {v for k, v in vars(obs_names).items()
+             if k.isupper() and isinstance(v, str)}
+    known.update(obs_names.LEGACY_ALIASES.values())
+    return known
+
+
+def check_names() -> int:
+    """Cross-check every metric-name string literal at an instrumentation
+    site (``counters.inc("...")``, ``registry.observe("...")``, ...)
+    against the canonical registry in obs/names.py.  A literal that is
+    not a registered name is exactly how the results_accepted collision
+    happened — two spellings, no arbiter."""
+    import ast
+    known = _known_metric_names()
+    pkg = os.path.join(REPO, "distributedmandelbrot_tpu")
+    unknown: list[tuple[str, int, str]] = []
+    sites = 0
+    for dirpath, _, filenames in os.walk(pkg):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+            rel = os.path.relpath(path, REPO)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _INSTRUMENT_METHODS
+                        and isinstance(node.func.value, ast.Attribute
+                                       | ast.Name)):
+                    continue
+                recv = (node.func.value.attr
+                        if isinstance(node.func.value, ast.Attribute)
+                        else node.func.value.id).lower()
+                if not any(h in recv for h in _RECEIVER_HINTS):
+                    continue
+                if not (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                sites += 1
+                name = node.args[0].value
+                if name not in known:
+                    unknown.append((rel, node.args[0].lineno, name))
+    for rel, line, name in unknown:
+        print(f"{rel}:{line}: metric name {name!r} is not registered "
+              f"in obs/names.py", file=sys.stderr)
+    if unknown:
+        raise MetricsFormatError(
+            f"{len(unknown)} unregistered metric-name literal(s)")
+    print(f"names: {sites} instrumentation literals OK "
+          f"against {len(known)} registered names")
+    return sites
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(
         description="Render and validate Prometheus exposition text.")
@@ -229,8 +299,13 @@ def main() -> int:
     parser.add_argument("--url", default=None,
                         help="validate a running exporter's /metrics "
                              "instead of spinning up an embedded one")
+    parser.add_argument("--names", action="store_true",
+                        help="also audit metric-name literals at "
+                             "instrumentation sites against obs/names.py")
     args = parser.parse_args()
     check_rendered()
+    if args.names:
+        check_names()
     if not args.offline:
         check_live(args.url)
     print("check_metrics: OK")
