@@ -965,18 +965,39 @@ def _hist_fields(registry, fields: dict) -> dict:
     return out
 
 
+def _phase_sums(registry, family: str, label: str) -> dict:
+    """Per-label-value time sums (seconds) of a histogram family — how
+    the bench reads the backend's dispatch/materialize split out of the
+    registry now that the racy ``phase_us`` dict is gone."""
+    out: dict = {}
+    for name, kind, _help, children in registry.collect():
+        if name != family or kind != "histogram":
+            continue
+        for child in children:
+            _, total, _count = child.state()
+            lv = dict(child.labels).get(label, "")
+            out[lv] = out.get(lv, 0.0) + total
+    return out
+
+
 def bench_farm(repeats: int, *, levels: str = "3:1000",
                definition: int = 4096, batch_size: int = 3,
-               backend_name: str = "auto") -> dict:
+               backend_name: str = "auto", window: int = 8,
+               depth: int = 2) -> dict:
     """Production shape: coordinator + worker over loopback TCP, 4096^2
     chunks, batched dispatch, full pipeline (lease -> compute -> upload ->
     persist).  Real materialization everywhere — on this rig the device->
     host tunnel (~35 MB/s) dominates; on a co-located TPU host the same
     path runs at PCIe rates.
 
-    The JSON line carries a per-phase breakdown (lease / compute / upload
-    / persist seconds and shares, plus the device idle fraction) so the
-    tunnel cost is separable from the framework cost; run with
+    The worker runs the pipelined executor by default (``window`` tiles
+    in flight across lease/dispatch/materialize/upload, ``depth`` kernels
+    per device); ``window=0`` (CLI: ``--farm-window 0``) is the legacy
+    two-stage-overlap control, so the delta between the two IS the
+    pipelining win.  The JSON line carries a per-phase breakdown (lease /
+    compute / upload / persist seconds and shares, plus the device idle
+    fraction) and, when pipelined, the per-stage occupancy/bubble split
+    that localizes any remaining gap to one stage; run with
     ``backend_name="native"`` (CLI: ``--farm-backend native``) as the
     no-device control — any phase share that persists there is framework
     overhead, not tunnel."""
@@ -1001,52 +1022,71 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
                                     definition=definition)
         client = DistributerClient("127.0.0.1", co.distributer_port)
         worker = Worker(client, backend, batch_size=batch_size,
-                        overlap_io=True)
+                        overlap_io=True, window=window, depth=depth)
         # warmup: compile the kernel outside the timed window
         from distributedmandelbrot_tpu.core.workload import Workload
         backend.compute_batch([Workload(settings[0].level,
                                         settings[0].max_iter, 0, 0)])
-        phase0 = dict(getattr(backend, "phase_us", {}))
+        from distributedmandelbrot_tpu.obs import names as obs_names
+        wreg = worker.counters.registry
+        phase0 = _phase_sums(wreg, obs_names.HIST_BACKEND_PHASE_SECONDS,
+                             "phase")
         t0 = time.perf_counter()
-        while True:
-            r0 = time.perf_counter()
-            done_before = worker.counters.get("tiles_computed")
-            got = worker.run_once()
-            if not got:
-                break
-            n_round = worker.counters.get("tiles_computed") - done_before
-            per_round.append((time.perf_counter() - r0, n_round))
+        if window > 0:
+            worker.run_until_drained()
+        else:
+            while True:
+                r0 = time.perf_counter()
+                done_before = worker.counters.get("tiles_computed")
+                got = worker.run_once()
+                if not got:
+                    break
+                n_round = worker.counters.get("tiles_computed") - done_before
+                per_round.append((time.perf_counter() - r0, n_round))
         co.wait_saves_settled(expected_accepted=n_tiles, timeout=600)
         total = time.perf_counter() - t0
         wc = worker.counters.snapshot()
         cc = co.counters.snapshot()
-        from distributedmandelbrot_tpu.obs import names as obs_names
         hist = _hist_fields(co.registry, {
             "grant": obs_names.HIST_GRANT_SECONDS,
             "persist": obs_names.HIST_PERSIST_SECONDS})
-        hist.update(_hist_fields(worker.counters.registry, {
+        hist.update(_hist_fields(wreg, {
             "compute": obs_names.HIST_WORKER_COMPUTE_SECONDS,
             "upload": obs_names.HIST_WORKER_UPLOAD_SECONDS}))
-        phase1 = dict(getattr(backend, "phase_us", {}))
+        phase1 = _phase_sums(wreg, obs_names.HIST_BACKEND_PHASE_SECONDS,
+                             "phase")
+        stage_stats = (worker.pipeline.stage_stats()
+                       if worker.pipeline is not None else None)
         backend_cls = type(backend).__name__
 
-    # One per-tile sample per tile actually leased that round (the last
-    # round is usually short).
-    per_tile = sorted(dt / k for dt, k in per_round if k for _ in range(k))
-    p50 = per_tile[len(per_tile) // 2] if per_tile else float("nan")
+    if window > 0:
+        # Per-tile turnaround = dispatch->materialized, straight from the
+        # executor's per-tile histogram.
+        p50 = wreg.family_percentile(
+            obs_names.HIST_WORKER_COMPUTE_SECONDS, 50) or float("nan")
+        mode = f"pipelined w{window}d{depth}"
+    else:
+        # One per-tile sample per tile actually leased that round (the
+        # last round is usually short).
+        per_tile = sorted(dt / k for dt, k in per_round if k
+                          for _ in range(k))
+        p50 = per_tile[len(per_tile) // 2] if per_tile else float("nan")
+        mode = "classic overlap"
     pixels = n_tiles * definition * definition
     out = {"metric": f"farm e2e {levels} {n_tiles}x{definition}^2 "
-                     f"batched-dispatch ({backend_cls}, incl. upload + "
-                     f"persist)",
+                     f"batched-dispatch ({backend_cls}, {mode}, incl. "
+                     f"upload + persist)",
            "value": round(_mpix(pixels, total), 2), "unit": "Mpix/s",
            "p50_tile_turnaround_s": round(p50, 3),
            "total_s": round(total, 2)}
-    # Phase breakdown.  lease/compute are on the worker's critical path;
-    # upload rides the overlap-IO thread and persist the coordinator's
-    # save tasks, so their shares can exceed what the wall clock shows —
-    # a share > ~1.0 of either means the pipeline is hiding it well, not
-    # that the clock is wrong.  Device idle fraction ~= the critical
-    # path's non-compute share (only meaningful for device backends).
+    # Phase breakdown.  lease/compute are on the worker's critical path
+    # in classic mode; upload rides the overlap-IO thread and persist the
+    # coordinator's save tasks, so their shares can exceed what the wall
+    # clock shows — a share > ~1.0 of any of them means the pipeline is
+    # hiding it well, not that the clock is wrong.  (Pipelined, ALL four
+    # run off the critical path of each other; the stage occupancies
+    # below are the honest account.)  Device idle fraction ~= the
+    # critical path's non-compute share (device backends only).
     phases = {"lease": wc.get("lease_us", 0) / 1e6,
               "compute": wc.get("compute_us", 0) / 1e6,
               "upload": wc.get("upload_us", 0) / 1e6,
@@ -1057,13 +1097,23 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
     if phase1:
         # PallasBackend's split of compute: host dispatch vs materialize
         # (device completion wait + D2H — the tunnel, on this rig).
+        # Warmup ran before t0, so the pre-run sums are subtracted.
         out["compute_dispatch_s"] = round(
-            (phase1.get("dispatch", 0) - phase0.get("dispatch", 0)) / 1e6, 2)
+            phase1.get("dispatch", 0.0) - phase0.get("dispatch", 0.0), 2)
         out["compute_materialize_s"] = round(
-            (phase1.get("materialize", 0)
-             - phase0.get("materialize", 0)) / 1e6, 2)
+            phase1.get("materialize", 0.0)
+            - phase0.get("materialize", 0.0), 2)
     out["device_idle_frac"] = round(
         max(0.0, 1.0 - phases["compute"] / total), 3) if total else 0.0
+    if stage_stats is not None:
+        # The tentpole's acceptance metric: where the remaining bubbles
+        # are.  A stage at occupancy ~1.0 is the bottleneck; every other
+        # stage's bubble is time it spent waiting on it.
+        out["pipe_wall_s"] = stage_stats["wall_s"]
+        for name, st in stage_stats["stages"].items():
+            out[f"pipe_{name}_busy_s"] = st["busy_s"]
+            out[f"pipe_{name}_occupancy"] = st["occupancy"]
+            out[f"pipe_{name}_bubble"] = st["bubble"]
     out.update(hist)
     return out
 
@@ -1228,6 +1278,14 @@ def main() -> int:
                         help="compute backend for the farm config; 'native' "
                              "is the no-device control that isolates "
                              "framework overhead from tunnel/device cost")
+    parser.add_argument("--farm-window", type=int, default=8,
+                        help="pipelined-executor window for the farm "
+                             "config (tiles in flight across all four "
+                             "stages); 0 = legacy two-stage overlap — "
+                             "the control leg for the pipelining delta")
+    parser.add_argument("--farm-depth", type=int, default=2,
+                        help="kernels in flight per device for the farm "
+                             "config's pipelined executor")
     parser.add_argument("--serve", action="store_true",
                         help="run only the serving-gateway config "
                              "(cold-miss, warm-hit, coalesced-storm)")
@@ -1252,7 +1310,8 @@ def main() -> int:
         print(json.dumps(result), flush=True)
 
     if args.farm:
-        emit(bench_farm(args.repeats, backend_name=args.farm_backend))
+        emit(bench_farm(args.repeats, backend_name=args.farm_backend,
+                        window=args.farm_window, depth=args.farm_depth))
         return 0
 
     if args.serve:

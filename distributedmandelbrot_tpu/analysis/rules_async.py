@@ -4,11 +4,14 @@
 ``async def`` body: ``time.sleep``, the *sync* socket framing helpers
 (``recv_*`` / ``send_*`` from :mod:`net.framing` — the async side is
 ``read_*`` / ``write_*``), raw socket ops, ``open()`` / file reads, a
-``threading.Lock``-style ``.acquire()``, and direct store/cache disk
-reads (``load_payload`` / ``load`` / ``save``).  The sanctioned escape
-hatch — ``asyncio.to_thread(self.store.load_payload, ...)`` — passes
-the function *uncalled*, so no flagged Call node exists and it needs no
-special-casing.
+``threading.Lock``-style ``.acquire()``, direct store/cache disk
+reads (``load_payload`` / ``load`` / ``save``), and un-awaited
+``.get()`` / ``.put()`` on a queue-named receiver (a sync
+``queue.Queue`` — the worker pipeline's stage coupling — parks the
+whole event loop; the asyncio flavor is awaited, which exempts it).
+The sanctioned escape hatch — ``asyncio.to_thread(
+self.store.load_payload, ...)`` — passes the function *uncalled*, so
+no flagged Call node exists and it needs no special-casing.
 
 ``async-unawaited`` — a call to a coroutine function (an ``async def``
 visible in the same file) used as a bare expression statement: the
@@ -147,6 +150,15 @@ def _blocking_message(call: ast.Call, is_awaited: bool) -> str | None:
             and chain[-2] in ("store", "cache", "index", "path"):
         return (f"direct {chain[-2]}.{last}() does disk I/O on the event "
                 f"loop (wrap in asyncio.to_thread)")
+    if last in ("get", "put") and not is_awaited and len(chain) >= 2:
+        recv = chain[-2].lower()
+        # Queue-named receivers only: a bare dict .get() is everywhere
+        # and harmless; a sync queue.Queue .get() parks the loop until
+        # a pipeline thread feeds it.
+        if recv in ("q", "queue") or recv.endswith("_q") \
+                or "queue" in recv:
+            return (f"sync queue .{last}() blocks the event loop "
+                    f"(use asyncio.Queue and await, or _nowait)")
     return None
 
 

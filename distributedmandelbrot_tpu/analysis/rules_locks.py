@@ -16,8 +16,17 @@ cycle is a deadlock risk.  Nodes are ``ClassName.lockattr``, so an
 order inversion *across* classes is caught as long as both acquisitions
 are lexically visible.
 
-Scope: coordinator/, storage/, serve/, obs/ — the modules where the
-asyncio loop and worker/client threads genuinely share state.
+``lock-held-blocking`` — a call that can block indefinitely (queue
+``get``/``put``, thread ``join``, semaphore ``acquire``, client
+request/submit network exchanges, ``time.sleep``, event ``wait``) made
+while a lock is held.  The stage-queue pipeline's discipline is that
+every blocking wait happens OUTSIDE the window lock — one queue ``get``
+under it and the whole executor convoys.  Calls on the held lock itself
+(``cond.wait`` / ``notify`` — which release it) are sanctioned.
+
+Scope: coordinator/, storage/, serve/, obs/, worker/ — the modules
+where the asyncio loop and worker/pipeline threads genuinely share
+state.
 """
 
 from __future__ import annotations
@@ -39,9 +48,11 @@ RULES = (
          "mutation of a lock-guarded attribute without holding its lock"),
     Rule("lock-order", "locks", "warning",
          "cycle in the lock acquisition-order graph (deadlock risk)"),
+    Rule("lock-held-blocking", "locks", "error",
+         "potentially unbounded blocking call while holding a lock"),
 )
 
-SCOPE_DIRS = ("coordinator", "storage", "serve", "obs")
+SCOPE_DIRS = ("coordinator", "storage", "serve", "obs", "worker")
 
 # Method calls that mutate their receiver in place.
 MUTATORS = frozenset({
@@ -49,6 +60,36 @@ MUTATORS = frozenset({
     "setdefault", "pop", "popleft", "popitem", "remove", "discard",
     "clear", "move_to_end", "sort", "reverse",
 })
+
+
+def _blocking_under_lock(chain: list[str]) -> Optional[str]:
+    """Message when the call chain is a recognizably blocking operation
+    (receiver-name heuristics keep ``dict.get`` and scheduler
+    ``acquire`` — pure in-memory — out of it); None otherwise."""
+    if chain == ["time", "sleep"]:
+        return "time.sleep() under a lock stalls every other holder"
+    if len(chain) < 2:
+        return None
+    recv = chain[-2].lower()
+    last = chain[-1]
+    if last in ("get", "put") and (
+            recv in ("q", "queue") or recv.endswith("_q")
+            or "queue" in recv):
+        return (f"queue .{last}() can block indefinitely; move it "
+                f"outside the lock")
+    if last == "join" and "thread" in recv:
+        return "thread .join() under a lock invites a deadlock"
+    if last == "acquire" and "sem" in recv:
+        return ("semaphore .acquire() under a lock blocks every other "
+                "holder until a permit frees")
+    if last == "wait" and ("stop" in recv or "event" in recv):
+        return ("event .wait() under a lock stalls every other holder "
+                "for the full wait")
+    if last in ("request", "request_batch", "submit", "submit_batch") \
+            and "client" in recv:
+        return (f"network exchange .{last}() under a lock serializes "
+                f"the pipeline on the round-trip")
+    return None
 
 
 class _ClassAnalysis:
@@ -68,6 +109,8 @@ class _ClassAnalysis:
         self.method_locks: dict[str, set[str]] = {}
         # (held locks, same-class callee, line) — call made under a lock
         self.calls_held: list[tuple[tuple[str, ...], str, int]] = []
+        # (line, innermost lock, message) — blocking call under a lock
+        self.blocking: list[tuple[int, str, str]] = []
         for meth in methods_of(cls):
             self.method_locks.setdefault(meth.name, set())
             self._walk(meth, meth)
@@ -133,6 +176,14 @@ class _ClassAnalysis:
                 self._mutation_target(target, held, method)
         elif isinstance(node, ast.Call):
             chain = call_chain(node)
+            if chain and held:
+                # Calls on a lock we HOLD are the sanctioned Condition
+                # protocol (wait/notify release-and-reacquire).
+                on_held_lock = (chain[0] == "self" and len(chain) >= 3
+                                and chain[1] in held)
+                msg = None if on_held_lock else _blocking_under_lock(chain)
+                if msg is not None:
+                    self.blocking.append((node.lineno, held[-1], msg))
             if chain and chain[0] == "self" and len(chain) >= 3 \
                     and chain[-1] in MUTATORS:
                 self._record_mutation(chain[1], node.lineno, held, method)
@@ -180,6 +231,10 @@ def check(project: Project) -> list[Finding]:
             if not info.lock_attrs:
                 continue
             findings.extend(_guard_findings(sf, cls, info))
+            for line, lock, msg in info.blocking:
+                findings.append(Finding(
+                    "lock-held-blocking", "error", sf.relpath, line,
+                    f"{msg} (holding {cls.name}.{lock})"))
             for (outer, inner), line in info.edges.items():
                 a, b = f"{cls.name}.{outer}", f"{cls.name}.{inner}"
                 graph.setdefault(a, set()).add(b)

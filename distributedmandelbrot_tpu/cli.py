@@ -694,6 +694,16 @@ def cmd_worker(argv: Sequence[str]) -> int:
     parser.add_argument("--poll", type=float, default=0.0,
                         help="keep polling every N seconds after the "
                              "coordinator drains (default: exit)")
+    parser.add_argument("--window", type=int, default=-1,
+                        help="pipelined executor: max tiles leased-but-"
+                             "unsubmitted across the lease/dispatch/"
+                             "materialize/upload stages; 0 = classic "
+                             "two-stage overlap (default: 2*depth per "
+                             "local device for backends with per-tile "
+                             "dispatch handles, else 0)")
+    parser.add_argument("--depth", type=int, default=2,
+                        help="pipelined executor: kernels in flight per "
+                             "device (default: 2 — double-buffered)")
     parser.add_argument("--kernel", choices=["auto", "xla", "pallas"],
                         default="auto",
                         help="compute kernel for the mesh backend")
@@ -769,8 +779,17 @@ def cmd_worker(argv: Sequence[str]) -> int:
             batch_size = jax.local_device_count()
         else:
             batch_size = 1
+    window = args.window
+    if window < 0:
+        # Auto: pipeline backends with per-tile dispatch handles (they
+        # profit from all four overlaps); classic overlap otherwise —
+        # the mesh backend already fuses its own device-chained batch.
+        if hasattr(backend, "dispatch_tile"):
+            window = 2 * args.depth * max(1, len(backend.devices()))
+        else:
+            window = 0
     worker = Worker(DistributerClient(args.host, args.port), backend,
-                    batch_size=batch_size)
+                    batch_size=batch_size, window=window, depth=args.depth)
     profiling = False
     if args.profile:
         import jax
@@ -785,6 +804,14 @@ def cmd_worker(argv: Sequence[str]) -> int:
             print(f"worker: drained after {rounds} round(s); "
                   f"{stats.get('tiles_computed', 0)} tiles computed, "
                   f"{stats.get('results_accepted', 0)} accepted", flush=True)
+            if worker.pipeline is not None:
+                ss = worker.pipeline.stage_stats()
+                occ = "  ".join(
+                    f"{name}={s['occupancy']:.0%}"
+                    for name, s in ss["stages"].items())
+                print(f"pipeline stage occupancy: {occ} "
+                      f"(window={worker.window}, depth={worker.depth})",
+                      flush=True)
     except KeyboardInterrupt:
         pass
     except OSError as e:

@@ -50,6 +50,34 @@ WORKER_UPLOAD_US = "upload_us"
 HIST_WORKER_COMPUTE_SECONDS = "worker_compute_seconds"
 HIST_WORKER_UPLOAD_SECONDS = "worker_upload_seconds"
 
+# -- worker: pipelined executor -------------------------------------------
+
+# Per-item stage service time (labels: stage=lease|dispatch|materialize|
+# upload) and the end-of-run occupancy/bubble gauges the farm bench
+# reads.  Occupancy is busy/wall for the stage thread; bubble is its
+# complement — the fraction of the run the stage spent waiting on its
+# neighbours (1.0 means the stage never limited throughput).
+HIST_PIPELINE_STAGE_SECONDS = "worker_pipeline_stage_seconds"
+GAUGE_PIPELINE_STAGE_OCCUPANCY = "worker_pipeline_stage_occupancy"
+GAUGE_PIPELINE_WINDOW_FILL = "worker_pipeline_window_fill"
+PIPELINE_LEASE_EXCHANGES = "worker_pipeline_lease_exchanges"
+PIPELINE_TILES_ABANDONED = "worker_pipeline_tiles_abandoned"
+
+# Stage label values, in pipeline order.
+STAGE_LEASE = "lease"
+STAGE_DISPATCH = "dispatch"
+STAGE_MATERIALIZE = "materialize"
+STAGE_UPLOAD = "upload"
+PIPELINE_STAGES = (STAGE_LEASE, STAGE_DISPATCH, STAGE_MATERIALIZE,
+                   STAGE_UPLOAD)
+
+# Backend-internal phase split (labels: phase=dispatch|materialize) —
+# replaces PallasBackend's unsynchronized ``phase_us`` dict, which was
+# racy the moment two pipeline threads shared a backend.
+HIST_BACKEND_PHASE_SECONDS = "worker_backend_phase_seconds"
+PHASE_DISPATCH = "dispatch"
+PHASE_MATERIALIZE = "materialize"
+
 # -- store ----------------------------------------------------------------
 
 HIST_STORE_READ_SECONDS = "store_read_seconds"

@@ -1129,10 +1129,17 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
                                interior_check: bool = True,
                                cycle_check: bool | None = None,
                                power: int = 2, burning: bool = False,
-                               julia_c: complex | None = None) -> jax.Array:
+                               julia_c: complex | None = None,
+                               device: jax.Device | None = None) -> jax.Array:
     """Dispatch one tile's kernel; returns the (height, width) uint8 tile
     still on device.  Callers that pipeline (dispatch batch, then
     materialize) overlap compute with device->host transfers.
+
+    ``device`` targets the dispatch at a specific local chip: the scalar
+    inputs are committed there, so the kernel (and its output buffer)
+    land on that device — how the pipelined executor round-robins tiles
+    over every local device instead of queueing all of them on
+    ``jax.devices()[0]``.  ``None`` keeps the default placement.
 
     The single dispatch body for every integer-kernel variant —
     Mandelbrot, Julia (``julia_c``), Multibrot/Burning Ship
@@ -1148,6 +1155,11 @@ def compute_tile_pallas_device(spec: TileSpec, max_iter: int, *,
     params = jnp.asarray([_params_row(spec, julia_c)], jnp.float32)
     cap = bucket_cap(max_iter)
     mrd = jnp.asarray([[max_iter]], jnp.int32)
+    if device is not None:
+        # Committed inputs pin the whole dispatch (and the output tile)
+        # to this chip; the transfer is two tiny SMEM rows.
+        params = jax.device_put(params, device)
+        mrd = jax.device_put(mrd, device)
     # Probe policy follows the tile's ACTUAL budget, not the padded
     # compile cap: a shallow tile whose bucket rounds up past the probe
     # threshold must not pay the probe's per-step compares and snapshot

@@ -30,12 +30,20 @@ def local_devices() -> list[jax.Device]:
     return jax.local_devices()
 
 
-def tile_mesh(n_devices: Optional[int] = None) -> Mesh:
-    """1-D mesh over local devices for tile-batch data parallelism."""
+def device_ring(n_devices: Optional[int] = None) -> list[jax.Device]:
+    """Local devices in canonical placement order — the ONE ordering
+    shared by the mesh backend (:func:`tile_mesh`) and the pipelined
+    worker executor's round-robin dispatch, so a host running both
+    assigns tile ``i`` of a batch to the same chip either way."""
     devices = local_devices()
     if n_devices is not None:
         devices = devices[:n_devices]
-    return Mesh(np.array(devices), (TILE_AXIS,))
+    return devices
+
+
+def tile_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over local devices for tile-batch data parallelism."""
+    return Mesh(np.array(device_ring(n_devices)), (TILE_AXIS,))
 
 
 def tile_row_mesh(tiles: int, rows: int,

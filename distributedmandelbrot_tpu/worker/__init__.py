@@ -7,7 +7,10 @@ from distributedmandelbrot_tpu.worker.backends import (ComputeBackend,
                                                        PallasBackend,
                                                        auto_backend)
 from distributedmandelbrot_tpu.worker.client import DistributerClient
+from distributedmandelbrot_tpu.worker.pipeline import (PipelineExecutor,
+                                                       as_dispatcher)
 from distributedmandelbrot_tpu.worker.worker import Worker
 
 __all__ = ["ComputeBackend", "JaxBackend", "NativeBackend", "NumpyBackend",
-           "PallasBackend", "auto_backend", "DistributerClient", "Worker"]
+           "PallasBackend", "auto_backend", "DistributerClient", "Worker",
+           "PipelineExecutor", "as_dispatcher"]

@@ -20,7 +20,7 @@ into one device dispatch; scalar backends just loop.
 from __future__ import annotations
 
 import time
-from typing import Protocol, Sequence
+from typing import Optional, Protocol, Sequence
 
 import numpy as np
 
@@ -28,6 +28,8 @@ from distributedmandelbrot_tpu.core.geometry import (CHUNK_WIDTH,
                                                      TileSpec,
                                                      spec_f32_resolvable)
 from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.metrics import Registry
 from distributedmandelbrot_tpu.ops import escape_time
 from distributedmandelbrot_tpu.ops import reference as ref_ops
 
@@ -107,51 +109,92 @@ class PallasBackend:
     """TPU throughput path: the Pallas block-early-exit kernel (f32 only;
     coordinates generated in-kernel, so nothing but three scalars crosses
     host->device per tile).  Falls back to interpret mode off-TPU, which
-    is correct but slow — use :func:`auto_backend` unless testing."""
+    is correct but slow — use :func:`auto_backend` unless testing.
+
+    The phase split (host-side dispatch/queue time vs materialize — the
+    latter includes the wait for device completion AND the device->host
+    transfer) is recorded as registry histograms under
+    :data:`~distributedmandelbrot_tpu.obs.names.HIST_BACKEND_PHASE_SECONDS`
+    with a ``phase`` label.  This replaced an unsynchronized ``phase_us``
+    dict, which lost updates the moment two pipeline threads shared the
+    backend; the registry's instruments take its lock per observation.
+
+    Beyond the batch protocol, the backend exposes the per-tile
+    dispatch/materialize pair the pipelined executor
+    (:mod:`distributedmandelbrot_tpu.worker.pipeline`) schedules over
+    every local device.
+    """
 
     def __init__(self, definition: int = CHUNK_WIDTH,
-                 clamp: bool = False) -> None:
+                 clamp: bool = False,
+                 registry: Optional[Registry] = None) -> None:
         from distributedmandelbrot_tpu.ops.pallas_escape import (
             compute_tile_pallas_device)
         self._dispatch = compute_tile_pallas_device
         self.definition = definition
         self.clamp = clamp
-        # Cumulative phase split for the farm bench's breakdown: host-side
-        # dispatch/queue time vs materialize time (the latter includes the
-        # wait for device completion AND the device->host transfer — on a
-        # tunneled rig it measures the tunnel).
-        self.phase_us = {"dispatch": 0, "materialize": 0}
+        self.registry = registry if registry is not None else Registry()
+
+    def bind_registry(self, registry: Registry) -> None:
+        """Adopt the worker's registry so the phase histograms land where
+        the exporter scrapes.  Called at worker construction, before any
+        compute thread exists, so no observation can straddle the swap."""
+        self.registry = registry
+
+    def _observe_phase(self, phase: str, seconds: float) -> None:
+        self.registry.observe(obs_names.HIST_BACKEND_PHASE_SECONDS,
+                              seconds, labels={"phase": phase})
+
+    def devices(self) -> list:
+        """Dispatch targets, in the shared mesh placement order."""
+        from distributedmandelbrot_tpu.parallel.mesh import device_ring
+        return device_ring()
+
+    def dispatch_tile(self, workload: Workload, device=None):
+        """Enqueue one tile's kernel on ``device``; returns the handle to
+        pass to :meth:`materialize_tile` (an on-device array, or a host
+        array when the tile fell back to the XLA path)."""
+        from distributedmandelbrot_tpu.ops.pallas_escape import (
+            PallasUnsupported)
+        spec = _spec_for(workload, self.definition)
+        t0 = time.monotonic()
+        try:
+            handle = self._dispatch(spec, workload.max_iter,
+                                    clamp=self.clamp, device=device)
+        except PallasUnsupported:
+            # Intentional rejections only (granule, int32 cap, or
+            # sub-f32-resolution pitch); other errors propagate.  A
+            # pitch the kernel declined would alias identically on
+            # the XLA f32 path, so those tiles fall back to f64 —
+            # honoring the rejection's point, not just re-routing it.
+            dt = (np.float32 if spec_f32_resolvable(spec)
+                  else np.float64)
+            handle = escape_time.compute_tile(spec, workload.max_iter,
+                                              clamp=self.clamp, dtype=dt)
+        self._observe_phase(obs_names.PHASE_DISPATCH,
+                            time.monotonic() - t0)
+        return handle
+
+    def materialize_tile(self, handle) -> np.ndarray:
+        """Device->host transfer of one dispatched tile -> flat uint8.
+
+        Dropping the device reference here (the handle dies with this
+        frame) is what makes output buffers recycle: with the executor's
+        bounded per-device window, the allocator holds at most ``depth``
+        output tiles per chip and reuses them across dispatches instead
+        of growing with the batch."""
+        t0 = time.monotonic()
+        out = np.asarray(handle).reshape(-1)
+        self._observe_phase(obs_names.PHASE_MATERIALIZE,
+                            time.monotonic() - t0)
+        return out
 
     def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
         # Two-phase: dispatch every tile's kernel first (the device queue
         # runs them back to back), then materialize — compute of tile k
         # overlaps the device->host transfer of tile k-1.
-        from distributedmandelbrot_tpu.ops.pallas_escape import (
-            PallasUnsupported)
-        t0 = time.monotonic()
-        pending: list = []
-        for w in workloads:
-            spec = _spec_for(w, self.definition)
-            try:
-                pending.append(self._dispatch(spec, w.max_iter,
-                                              clamp=self.clamp))
-            except PallasUnsupported:
-                # Intentional rejections only (granule, int32 cap, or
-                # sub-f32-resolution pitch); other errors propagate.  A
-                # pitch the kernel declined would alias identically on
-                # the XLA f32 path, so those tiles fall back to f64 —
-                # honoring the rejection's point, not just re-routing it.
-                dt = (np.float32 if spec_f32_resolvable(spec)
-                      else np.float64)
-                pending.append(escape_time.compute_tile(spec, w.max_iter,
-                                                        clamp=self.clamp,
-                                                        dtype=dt))
-        t1 = time.monotonic()
-        out = [np.asarray(p).ravel() for p in pending]
-        self.phase_us["dispatch"] += int((t1 - t0) * 1e6)
-        self.phase_us["materialize"] += int(
-            (time.monotonic() - t1) * 1e6)
-        return out
+        pending = [self.dispatch_tile(w) for w in workloads]
+        return [self.materialize_tile(p) for p in pending]
 
 
 def recompute_unresolvable_f32(workloads: Sequence[Workload],

@@ -1,0 +1,526 @@
+"""Pipelined multi-device worker executor.
+
+``Worker.run_once`` overlaps exactly two things: the previous batch's
+upload rides a background thread while the next batch computes.  Every
+round still pays the lease round-trip serially, materializes the whole
+batch before the first byte uploads, and drives only the default device
+outside the mesh backend.  BENCH_r05 put the cost at 58% of the device
+rate (1461 Mpix/s chained vs 610 Mpix/s end-to-end).
+
+This module replaces that two-stage overlap with a bounded in-flight
+window across four stages, one thread each, coupled by queues::
+
+    lease ──> dispatch ──> materialize ──> upload
+      │           │             │             │
+      │           └ round-robins tiles over every local device,
+      │             at most ``depth`` in flight per device
+      ├ acquires batch N+1 while batch N computes (the round-trip
+      │ hides behind device time), never holding more than ``window``
+      │ tiles leased-but-unsubmitted (no lease hoarding)
+      │                         ├ D2H of tile k overlaps compute of k+1
+      │                         │ (one-step ``copy_to_host_async``
+      │                         │ lookahead), and drops the device
+      │                         │ reference immediately, so the
+      │                         │ allocator recycles at most ``depth``
+      │                         │ output buffers per chip
+      │                                       └ feeds ``submit_batch``
+      │                                         from a queue instead of
+      │                                         one join-before-next-
+      │                                         round thread
+
+    A crash in any stage stops the pipeline, flows shutdown sentinels
+    through the queues, and re-raises from :meth:`PipelineExecutor.run`
+    with the in-flight account drained to zero (abandoned tiles simply
+    expire coordinator-side and are re-leased).
+
+Per-stage service-time histograms and end-of-run occupancy/bubble
+gauges land in the worker's metrics registry (obs/names.py pipeline
+section), which is what ``bench.py --farm`` prints as the stage
+breakdown and ``dmtpu stats`` serves.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Optional, Protocol, Sequence
+
+import numpy as np
+
+from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.utils.metrics import Counters
+from distributedmandelbrot_tpu.worker.client import DistributerClient
+
+logger = logging.getLogger("dmtpu.worker.pipeline")
+
+# Shutdown sentinel flowed through every stage queue; each stage's
+# ``finally`` forwards it downstream no matter how the stage exited, so
+# joins never deadlock on a dead neighbour.
+_EOS = object()
+
+# Slice width for interruptible blocking waits (semaphore acquire, poll
+# sleep): long waits are chopped so a stop/error elsewhere is noticed
+# within this many seconds.
+_WAIT_SLICE_S = 0.1
+
+
+class TileDispatcher(Protocol):
+    """How the pipeline drives a backend, one tile at a time."""
+
+    label: str
+
+    def devices(self) -> list:
+        """Placement targets for round-robin; opaque to the pipeline."""
+        ...
+
+    def dispatch(self, workload: Workload, device):
+        """Enqueue one tile's compute; returns a handle."""
+        ...
+
+    def materialize(self, handle) -> np.ndarray:
+        """Resolve a handle to flat uint8 pixels (blocks on the device)."""
+        ...
+
+
+class DeviceDispatcher:
+    """Adapter over a backend with per-tile dispatch handles
+    (``dispatch_tile``/``materialize_tile``/``devices`` — the
+    PallasBackend shape)."""
+
+    def __init__(self, backend) -> None:
+        self._backend = backend
+        self.label = type(backend).__name__
+
+    def devices(self) -> list:
+        return list(self._backend.devices()) or [None]
+
+    def dispatch(self, workload: Workload, device):
+        return self._backend.dispatch_tile(workload, device=device)
+
+    def materialize(self, handle) -> np.ndarray:
+        return self._backend.materialize_tile(handle)
+
+
+class SyncDispatcher:
+    """Adapter over any plain :class:`ComputeBackend`: one pseudo-device,
+    compute happens synchronously in the dispatch stage, materialize is a
+    pass-through.  The pipeline still hides the lease round-trip and the
+    upload behind compute — the two overlaps a synchronous backend can
+    profit from."""
+
+    def __init__(self, backend) -> None:
+        self._backend = backend
+        self.label = type(backend).__name__
+
+    def devices(self) -> list:
+        return [None]
+
+    def dispatch(self, workload: Workload, device):
+        return self._backend.compute_batch([workload])[0]
+
+    def materialize(self, handle) -> np.ndarray:
+        return handle
+
+
+def as_dispatcher(backend) -> TileDispatcher:
+    """The dispatcher for a backend: native per-tile handles when the
+    backend exposes them, the synchronous wrapper otherwise."""
+    if hasattr(backend, "dispatch_tile") \
+            and hasattr(backend, "materialize_tile"):
+        return DeviceDispatcher(backend)
+    return SyncDispatcher(backend)
+
+
+class _StageStats:
+    """Busy-time account for one stage thread (single writer; readers
+    tolerate a torn float — gauges are advisory)."""
+
+    __slots__ = ("name", "busy_s", "items")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.busy_s = 0.0
+        self.items = 0
+
+    def add(self, seconds: float, items: int = 1) -> None:
+        self.busy_s += seconds
+        self.items += items
+
+
+class PipelineExecutor:
+    """Bounded-window staged executor over one coordinator connection.
+
+    ``window`` caps tiles leased-but-unsubmitted across the whole
+    pipeline (the lease stage's prefetch credit — what keeps one fat
+    worker from hoarding leases a second worker could run).  ``depth``
+    caps kernels in flight per device.  ``batch_size`` is the wire
+    granularity for lease and submit exchanges.
+
+    ``clock`` is the time source for stage accounting (injectable so the
+    virtual-clock tests measure overlap deterministically); it never
+    drives real blocking waits.
+    """
+
+    def __init__(self, client: DistributerClient,
+                 dispatcher: TileDispatcher, *,
+                 window: int = 8, depth: int = 2, batch_size: int = 1,
+                 counters: Optional[Counters] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self.client = client
+        self.dispatcher = dispatcher
+        self.window = window
+        self.depth = depth
+        self.batch_size = batch_size
+        self.counters = counters if counters is not None else Counters()
+        self.registry = self.counters.registry
+        self._hist_labels = {"backend": dispatcher.label}
+
+        self._dispatch_q: queue.Queue = queue.Queue()
+        self._mat_q: queue.Queue = queue.Queue()
+        self._upload_q: queue.Queue = queue.Queue()
+        # _cond guards the window account and the error list; every
+        # blocking queue/semaphore/client call happens OUTSIDE it.
+        self._cond = threading.Condition()
+        self._in_flight = 0
+        self._errors: list[BaseException] = []
+        self._stop = threading.Event()
+        self._rounds = 0
+        self._stats = {name: _StageStats(name)
+                       for name in obs_names.PIPELINE_STAGES}
+        self._t_start: Optional[float] = None
+        self._t_end: Optional[float] = None
+        self.clock = clock
+        # Created here, not in a stage thread: both the dispatch and the
+        # materialize stages use them from their first item on.
+        self._devices = list(dispatcher.devices()) or [None]
+        self._dev_sems = [threading.Semaphore(self.depth)
+                          for _ in self._devices]
+
+    # -- window + error accounting -----------------------------------------
+
+    @property
+    def in_flight(self) -> int:
+        """Tiles leased but not yet submitted (or abandoned); 0 after
+        :meth:`run` returns, crash or not."""
+        with self._cond:
+            return self._in_flight
+
+    def _retire(self, n: int) -> None:
+        with self._cond:
+            self._in_flight -= n
+            self._cond.notify_all()
+
+    def _abandon(self, n: int) -> None:
+        """Account tiles dropped on shutdown/error; their leases expire
+        coordinator-side and the scheduler re-issues them."""
+        if n:
+            self.counters.inc(obs_names.PIPELINE_TILES_ABANDONED, n)
+            self._retire(n)
+
+    def _fail(self, err: BaseException) -> None:
+        logger.error("pipeline stage failed: %r", err)
+        self._stop.set()
+        with self._cond:
+            self._errors.append(err)
+            self._cond.notify_all()
+
+    def _stopping(self, stop: Optional[threading.Event] = None) -> bool:
+        return self._stop.is_set() \
+            or (stop is not None and stop.is_set())
+
+    # -- stages ------------------------------------------------------------
+
+    def _acquire(self, want: int) -> list[Workload]:
+        if want == 1:
+            w = self.client.request()
+            return [w] if w is not None else []
+        return self.client.request_batch(want)
+
+    def _lease_loop(self, poll_interval: float,
+                    stop: Optional[threading.Event]) -> None:
+        st = self._stats[obs_names.STAGE_LEASE]
+        while not self._stopping(stop):
+            with self._cond:
+                while self._in_flight >= self.window \
+                        and not self._stopping(stop):
+                    # Sliced so an EXTERNAL stop event (which notifies
+                    # nothing) is still noticed promptly.
+                    self._cond.wait(timeout=_WAIT_SLICE_S)
+                if self._stopping(stop):
+                    return
+                room = self.window - self._in_flight
+            # Lease outside the lock: only this thread ever *adds* to the
+            # window, so ``room`` can only have grown meanwhile and the
+            # prefetch can never exceed ``window`` leases outstanding.
+            want = min(self.batch_size, room)
+            t0 = self.clock()
+            got = self._acquire(want)
+            dt = self.clock() - t0
+            st.add(dt)
+            self.counters.inc(obs_names.WORKER_LEASE_US, int(dt * 1e6))
+            self.counters.inc(obs_names.PIPELINE_LEASE_EXCHANGES)
+            self.registry.observe(
+                obs_names.HIST_PIPELINE_STAGE_SECONDS, dt,
+                labels={"stage": obs_names.STAGE_LEASE})
+            if not got:
+                if poll_interval <= 0:
+                    return  # coordinator drained; let the window flush
+                waited = 0.0
+                while waited < poll_interval and not self._stopping(stop):
+                    slice_s = min(_WAIT_SLICE_S, poll_interval - waited)
+                    if (stop.wait(slice_s) if stop is not None
+                            else self._stop.wait(slice_s)):
+                        return
+                    waited += slice_s
+                continue
+            self._rounds += 1
+            with self._cond:
+                self._in_flight += len(got)
+            for w in got:
+                self._dispatch_q.put(w)
+
+    def _dispatch_loop(self) -> None:
+        st = self._stats[obs_names.STAGE_DISPATCH]
+        devices = self._devices
+        sems = self._dev_sems
+        i = 0
+        while True:
+            item = self._dispatch_q.get()
+            if item is _EOS:
+                return
+            if self._stop.is_set():
+                self._abandon(1)
+                continue
+            d = i % len(devices)
+            i += 1
+            while not sems[d].acquire(timeout=_WAIT_SLICE_S):
+                if self._stop.is_set():
+                    break
+            if self._stop.is_set():
+                # May hold the permit here; the run is over either way,
+                # and permits die with the executor.
+                self._abandon(1)
+                continue
+            t0 = self.clock()
+            try:
+                handle = self.dispatcher.dispatch(item, devices[d])
+            except BaseException:
+                sems[d].release()
+                self._abandon(1)
+                raise
+            dt = self.clock() - t0
+            st.add(dt)
+            self.registry.observe(
+                obs_names.HIST_PIPELINE_STAGE_SECONDS, dt,
+                labels={"stage": obs_names.STAGE_DISPATCH})
+            self._mat_q.put((item, d, handle, t0))
+
+    @staticmethod
+    def _start_host_copy(handle) -> None:
+        start = getattr(handle, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:
+                pass  # best-effort prefetch; materialize still copies
+
+    def _materialize_loop(self) -> None:
+        st = self._stats[obs_names.STAGE_MATERIALIZE]
+        sems = self._dev_sems
+        nxt = None
+        while True:
+            item = nxt if nxt is not None else self._mat_q.get()
+            nxt = None
+            if item is _EOS:
+                return
+            workload, d, handle, t_disp = item
+            # One-step lookahead: start the NEXT tile's D2H before
+            # blocking on this one, so transfer overlaps compute.
+            self._start_host_copy(handle)
+            try:
+                nxt = self._mat_q.get_nowait()
+            except queue.Empty:
+                nxt = None
+            if nxt is not None and nxt is not _EOS:
+                self._start_host_copy(nxt[2])
+            if self._stop.is_set():
+                sems[d].release()
+                self._abandon(1)
+                continue
+            t0 = self.clock()
+            try:
+                pixels = self.dispatcher.materialize(handle)
+            except BaseException:
+                self._abandon(1)
+                raise
+            finally:
+                sems[d].release()
+            dt = self.clock() - t0
+            st.add(dt)
+            tile_s = self.clock() - t_disp
+            self.counters.inc(obs_names.WORKER_TILES_COMPUTED)
+            self.counters.inc(obs_names.WORKER_COMPUTE_US,
+                              int(tile_s * 1e6))
+            self.registry.observe(
+                obs_names.HIST_PIPELINE_STAGE_SECONDS, dt,
+                labels={"stage": obs_names.STAGE_MATERIALIZE})
+            self.registry.observe(obs_names.HIST_WORKER_COMPUTE_SECONDS,
+                                  tile_s, labels=self._hist_labels)
+            self._upload_q.put((workload, pixels))
+
+    def _submit(self, results: Sequence[tuple[Workload, np.ndarray]]) -> None:
+        st = self._stats[obs_names.STAGE_UPLOAD]
+        t0 = self.clock()
+        if len(results) == 1:
+            accepted = [self.client.submit(*results[0])]
+        else:
+            accepted = self.client.submit_batch(results)
+        dt = self.clock() - t0
+        st.add(dt, len(results))
+        self.counters.inc(obs_names.WORKER_UPLOAD_US, int(dt * 1e6))
+        self.registry.observe(
+            obs_names.HIST_PIPELINE_STAGE_SECONDS, dt,
+            labels={"stage": obs_names.STAGE_UPLOAD})
+        self.registry.observe(obs_names.HIST_WORKER_UPLOAD_SECONDS, dt,
+                              labels=self._hist_labels)
+        n_ok = sum(accepted)
+        self.counters.inc(obs_names.WORKER_RESULTS_ACCEPTED, n_ok)
+        self.counters.inc(obs_names.WORKER_RESULTS_REJECTED,
+                          len(accepted) - n_ok)
+        if n_ok < len(accepted):
+            logger.info("%d of %d results rejected (stale leases)",
+                        len(accepted) - n_ok, len(accepted))
+
+    def _upload_loop(self) -> None:
+        while True:
+            item = self._upload_q.get()
+            if item is _EOS:
+                return
+            if self._stop.is_set():
+                self._abandon(1)
+                continue
+            batch = [item]
+            saw_eos = False
+            while len(batch) < self.batch_size:
+                try:
+                    more = self._upload_q.get_nowait()
+                except queue.Empty:
+                    break
+                if more is _EOS:
+                    saw_eos = True
+                    break
+                batch.append(more)
+            try:
+                self._submit(batch)
+            except BaseException:
+                self._abandon(len(batch))
+                raise
+            self._retire(len(batch))
+            if saw_eos:
+                return
+
+    # -- orchestration -----------------------------------------------------
+
+    def _run_stage(self, fn, downstream: Optional[queue.Queue]) -> None:
+        try:
+            fn()
+        except BaseException as e:  # re-raised from run()
+            self._fail(e)
+        finally:
+            if downstream is not None:
+                downstream.put(_EOS)
+            else:
+                # Terminal stage gone: nothing will retire tiles anymore;
+                # wake the lease stage so it can notice the stop.
+                with self._cond:
+                    self._cond.notify_all()
+
+    def _register_gauges(self) -> None:
+        def occupancy_fn(stats: _StageStats) -> Callable[[], float]:
+            def read() -> float:
+                end = self._t_end if self._t_end is not None \
+                    else self.clock()
+                wall = max(1e-9, end - (self._t_start or end))
+                return min(1.0, stats.busy_s / wall)
+            return read
+
+        for name in obs_names.PIPELINE_STAGES:
+            self.registry.gauge(obs_names.GAUGE_PIPELINE_STAGE_OCCUPANCY,
+                                labels={"stage": name},
+                                fn=occupancy_fn(self._stats[name]))
+        self.registry.gauge(obs_names.GAUGE_PIPELINE_WINDOW_FILL,
+                            fn=lambda: self.in_flight / self.window)
+
+    def run(self, *, poll_interval: float = 0.0,
+            stop: Optional[threading.Event] = None) -> int:
+        """Run the pipeline until the coordinator drains (or, with
+        ``poll_interval > 0``, until ``stop`` is set), flushing every
+        in-flight tile; returns the number of non-empty lease exchanges.
+        The first stage error is re-raised after shutdown completes."""
+        self._register_gauges()
+        self._t_start = self.clock()
+        self._t_end = None
+        threads = [
+            threading.Thread(
+                target=self._run_stage,
+                args=(lambda: self._lease_loop(poll_interval, stop),
+                      self._dispatch_q),
+                name="dmtpu-pipe-lease", daemon=True),
+            threading.Thread(
+                target=self._run_stage, args=(self._dispatch_loop,
+                                              self._mat_q),
+                name="dmtpu-pipe-dispatch", daemon=True),
+            threading.Thread(
+                target=self._run_stage, args=(self._materialize_loop,
+                                              self._upload_q),
+                name="dmtpu-pipe-materialize", daemon=True),
+            threading.Thread(
+                target=self._run_stage, args=(self._upload_loop, None),
+                name="dmtpu-pipe-upload", daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self._t_end = self.clock()
+        # Residual accounting: anything still sitting in a queue after a
+        # crash is a leased tile the pipeline abandoned.
+        for q in (self._dispatch_q, self._mat_q, self._upload_q):
+            while True:
+                try:
+                    leftover = q.get_nowait()
+                except queue.Empty:
+                    break
+                if leftover is not _EOS:
+                    self._abandon(1)
+        with self._cond:
+            errors = list(self._errors)
+        if errors:
+            raise errors[0]
+        return self._rounds
+
+    def stage_stats(self) -> dict:
+        """Occupancy/bubble per stage over the last run — what the farm
+        bench prints.  ``bubble`` is the fraction of the run the stage
+        thread spent NOT servicing items (waiting on its neighbours)."""
+        end = self._t_end if self._t_end is not None else self.clock()
+        wall = max(1e-9, end - (self._t_start if self._t_start is not None
+                                else end))
+        stages = {}
+        for name in obs_names.PIPELINE_STAGES:
+            st = self._stats[name]
+            occ = min(1.0, st.busy_s / wall)
+            stages[name] = {"busy_s": round(st.busy_s, 6),
+                            "items": st.items,
+                            "occupancy": round(occ, 4),
+                            "bubble": round(1.0 - occ, 4)}
+        return {"wall_s": round(wall, 6), "stages": stages}

@@ -12,7 +12,13 @@ TPU-first extensions:
   all of them in a single device dispatch
 - *compute/IO overlap*: while batch N uploads on a background thread, batch
   N+1 is already computing — the moral equivalent of the reference farm's
-  many concurrent worker processes, folded into one fat worker.
+  many concurrent worker processes, folded into one fat worker
+- *pipelined executor* (``window > 0``): the loops delegate to
+  :class:`~distributedmandelbrot_tpu.worker.pipeline.PipelineExecutor`,
+  which overlaps all four stages (lease-prefetch / per-device dispatch /
+  materialize / upload) under a bounded in-flight window instead of the
+  two-stage overlap above.  ``run_once`` stays the single-round
+  primitive; anything loop-shaped should run pipelined.
 """
 
 from __future__ import annotations
@@ -36,20 +42,33 @@ logger = logging.getLogger("dmtpu.worker")
 class Worker:
     def __init__(self, client: DistributerClient, backend: ComputeBackend, *,
                  batch_size: int = 1, overlap_io: bool = True,
-                 counters: Optional[Counters] = None) -> None:
+                 counters: Optional[Counters] = None,
+                 window: int = 0, depth: int = 2) -> None:
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
+        if window < 0:
+            raise ValueError("window must be >= 0 (0 = classic overlap)")
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
         self.client = client
         self.backend = backend
         self.batch_size = batch_size
         self.overlap_io = overlap_io
+        self.window = window
+        self.depth = depth
         self.counters = counters if counters is not None else Counters()
         self.registry = self.counters.registry
+        # Backends that keep their own phase instruments adopt the
+        # worker's registry, so one scrape sees the whole picture.
+        bind = getattr(backend, "bind_registry", None)
+        if bind is not None:
+            bind(self.registry)
         # Histograms are labeled by backend class so a mixed farm's
         # artifacts separate Pallas tiles from the numpy control.
         self._hist_labels = {"backend": type(backend).__name__}
         self._upload_thread: Optional[threading.Thread] = None
         self._upload_error: Optional[BaseException] = None
+        self.pipeline = None  # last PipelineExecutor (stage stats)
 
     # -- single round -----------------------------------------------------
 
@@ -126,8 +145,22 @@ class Worker:
 
     # -- loops ------------------------------------------------------------
 
+    def _run_pipelined(self, *, poll_interval: float = 0.0,
+                       stop: Optional[threading.Event] = None) -> int:
+        from distributedmandelbrot_tpu.worker.pipeline import (
+            PipelineExecutor, as_dispatcher)
+        pipe = PipelineExecutor(self.client, as_dispatcher(self.backend),
+                                window=self.window, depth=self.depth,
+                                batch_size=self.batch_size,
+                                counters=self.counters)
+        self.pipeline = pipe
+        return pipe.run(poll_interval=poll_interval, stop=stop)
+
     def run_until_drained(self) -> int:
-        """Work until the coordinator has nothing to hand out; returns rounds."""
+        """Work until the coordinator has nothing to hand out; returns rounds
+        (non-empty lease exchanges)."""
+        if self.window > 0:
+            return self._run_pipelined()
         rounds = 0
         while self.run_once():
             rounds += 1
@@ -138,9 +171,18 @@ class Worker:
                     stop: Optional[threading.Event] = None) -> None:
         """Work, then keep polling — the elastic-farm mode (workers may join
         while other workers' leases are still pending expiry)."""
+        if self.window > 0:
+            self._run_pipelined(poll_interval=poll_interval, stop=stop)
+            return
         try:
             while stop is None or not stop.is_set():
                 if not self.run_once():
+                    # The in-flight upload must land BEFORE the poll sleep
+                    # — stated here, not inherited from run_once's empty-
+                    # lease path, so a computed batch can never sit
+                    # unsubmitted across a full poll_interval however the
+                    # round above it is restructured.
+                    self._join_upload()
                     if stop is not None and stop.wait(poll_interval):
                         return
                     if stop is None:

@@ -131,6 +131,82 @@ class A:
     assert len(found) == 1
 
 
+LOCK_BLOCKING_CLASS = '''
+import queue
+import threading
+
+class Pipe:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._q = queue.Queue()
+
+    def bad(self):
+        with self._cond:
+            item = self._q.get()
+        return item
+
+    def good(self):
+        with self._cond:
+            self._cond.wait(timeout=0.1)
+        return self._q.get()
+'''
+
+
+def test_lock_held_blocking_fires_on_queue_get_under_lock():
+    found = findings_for({f"{P}/worker/pipe.py": LOCK_BLOCKING_CLASS},
+                         "lock-held-blocking")
+    assert len(found) == 1
+    f = found[0]
+    assert f.severity == "error"
+    assert ".get()" in f.message and "Pipe._cond" in f.message
+
+
+def test_lock_held_blocking_covers_join_sem_event_and_client():
+    src = '''
+class W:
+    def a(self):
+        with self._lock:
+            self.client.request_batch(4)
+
+    def b(self):
+        with self._lock:
+            self._upload_thread.join()
+
+    def c(self):
+        with self._lock:
+            self._dev_sem.acquire()
+
+    def d(self):
+        with self._lock:
+            self._stop.wait(1.0)
+'''
+    found = findings_for({f"{P}/worker/w.py": src}, "lock-held-blocking")
+    assert len(found) == 4
+
+
+def test_lock_held_blocking_clean_cases():
+    # Outside any lock; cond.wait on the HELD lock (the sanctioned
+    # Condition protocol); dict .get under a lock; and the whole class
+    # out of the scoped dirs.
+    src = '''
+class W:
+    def a(self):
+        item = self._q.get()
+        with self._lock:
+            self._seen = self._index.get(item)
+        self._cond_other = 1
+
+    def b(self):
+        with self._cond:
+            self._cond.wait(timeout=0.5)
+            self._cond.notify_all()
+'''
+    assert findings_for({f"{P}/worker/w.py": src},
+                        "lock-held-blocking") == []
+    assert findings_for({f"{P}/core/pipe.py": LOCK_BLOCKING_CLASS},
+                        "lock-held-blocking") == []
+
+
 # -- async -----------------------------------------------------------------
 
 def test_async_blocking_fires_on_time_sleep_and_sync_framing():
@@ -172,6 +248,31 @@ def sync_helper():
     time.sleep(0.1)
 '''
     assert findings_for({f"{P}/serve/h.py": src}, "async-blocking") == []
+
+
+def test_async_blocking_fires_on_sync_queue_in_coroutine():
+    # The worker pipeline's stage queues are sync queue.Queue; feeding
+    # one from a coroutine would park the whole event loop.  The
+    # asyncio flavor is awaited (exempt), _nowait never blocks, and a
+    # dict .get on a non-queue-named receiver is not a queue.
+    src = '''
+class G:
+    async def pump(self):
+        item = self._work_q.get()
+        await self.handle(item)
+
+    async def ok(self):
+        item = await self._aio_queue.get()
+        fast = self._work_q.get_nowait()
+        meta = self.conf.get("k")
+        return item, fast, meta
+
+    async def handle(self, item):
+        pass
+'''
+    found = findings_for({f"{P}/serve/pump.py": src}, "async-blocking")
+    assert len(found) == 1
+    assert "queue" in found[0].message and "await" in found[0].message
 
 
 def test_async_unawaited_fires_on_bare_coroutine_call():

@@ -627,3 +627,110 @@ def test_packed_tiles_julia_and_guards():
                                     interpret=True)
     with pytest.raises(PallasUnsupported, match="pack"):
         compute_tiles_packed_pallas([spec] * 5, [100] * 5, interpret=True)
+
+
+# --- Interior fast path + device-targeted dispatch (worker backends) ---------
+
+
+def test_backend_interior_fast_path_bit_identical_on_bulb_straddling_tile():
+    """Satellite check for the closed-form interior shortcut end to end
+    through the worker backends: a tile covering x,y in [-1,0]^2
+    straddles the period-2 bulb (center -1+0i, r=1/4) AND the main
+    cardioid's lower-left lobe.  Every pixel the f64 closed form proves
+    interior must be BIT-IDENTICAL between the Pallas fast path and the
+    NumpyBackend golden (both are exactly the saturated max_iter
+    count); off the proven mask only the usual f32-vs-f64 boundary
+    jitter is allowed."""
+    from distributedmandelbrot_tpu.core.workload import Workload
+    from distributedmandelbrot_tpu.worker.backends import (NumpyBackend,
+                                                           PallasBackend)
+
+    w = Workload(4, 300, 1, 1)
+    golden = NumpyBackend(definition=128).compute_batch([w])[0]
+    fast = PallasBackend(definition=128).compute_batch([w])[0]
+
+    spec = TileSpec.for_chunk(4, 1, 1, definition=128)
+    cr, ci = spec.grid_2d()
+    mask = np.asarray(escape_time.mandelbrot_interior(cr, ci)).ravel()
+    assert mask.mean() > 0.05, "fixture view misses the bulb/cardioid"
+    assert np.array_equal(fast[mask], golden[mask]), \
+        "interior fast path diverged from the golden on proven pixels"
+    off = float((fast[~mask] != golden[~mask]).mean())
+    assert off <= 0.02, f"{off:.2%} mismatch off the proven-interior mask"
+
+
+def test_device_targeted_dispatch_pins_output_and_matches_default():
+    """compute_tile_pallas_device(device=...) commits the dispatch to
+    that chip (here a virtual CPU device) without changing a pixel —
+    the property the pipelined executor's round-robin rests on."""
+    import jax
+
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_pallas_device)
+
+    spec = VIEWS["seahorse"]
+    base = np.asarray(compute_tile_pallas_device(spec, 120, interpret=True))
+    target = jax.devices()[-1]
+    out = compute_tile_pallas_device(spec, 120, interpret=True,
+                                     device=target)
+    assert out.devices() == {target}
+    assert np.array_equal(np.asarray(out), base)
+
+
+def test_pallas_backend_devices_follow_mesh_placement_order():
+    from distributedmandelbrot_tpu.parallel.mesh import device_ring
+    from distributedmandelbrot_tpu.worker import PallasBackend
+
+    backend = PallasBackend(definition=128)
+    assert backend.devices() == device_ring()
+    assert len(backend.devices()) >= 2  # conftest's 8 virtual devices
+
+
+def test_pipeline_executor_drives_pallas_backend_across_devices():
+    """End-to-end pipelined executor over the real PallasBackend
+    (interpret kernels, virtual CPU devices): every submitted tile is
+    bit-identical to a direct single-tile dispatch, whatever device the
+    round-robin placed it on."""
+    from distributedmandelbrot_tpu.core.workload import Workload
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_pallas_device)
+    from distributedmandelbrot_tpu.worker import PallasBackend
+    from distributedmandelbrot_tpu.worker.pipeline import (PipelineExecutor,
+                                                           as_dispatcher)
+
+    class MiniClient:
+        def __init__(self, tiles):
+            self._tiles = list(tiles)
+            self.submitted = []
+
+        def request(self):
+            return self._tiles.pop(0) if self._tiles else None
+
+        def request_batch(self, n):
+            got = self._tiles[:n]
+            del self._tiles[:n]
+            return got
+
+        def submit(self, w, p):
+            self.submitted.append((w, p))
+            return True
+
+        def submit_batch(self, results):
+            self.submitted.extend(results)
+            return [True] * len(results)
+
+    tiles = [Workload(2, 48, i % 2, i // 2) for i in range(4)]
+    client = MiniClient(tiles)
+    backend = PallasBackend(definition=128)
+    pipe = PipelineExecutor(client, as_dispatcher(backend),
+                            window=4, depth=2, batch_size=2)
+    rounds = pipe.run()
+    assert rounds >= 1
+    assert len(client.submitted) == 4
+    assert pipe.in_flight == 0
+    for w, pixels in client.submitted:
+        spec = TileSpec.for_chunk(w.level, w.index_real, w.index_imag,
+                                  definition=128)
+        want = np.asarray(compute_tile_pallas_device(
+            spec, w.max_iter, interpret=True)).reshape(-1)
+        assert np.array_equal(np.asarray(pixels), want)

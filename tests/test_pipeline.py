@@ -1,0 +1,396 @@
+"""Pipelined worker executor tests (CPU-only, no jax, no sockets).
+
+Overlap is proven on a VIRTUAL clock: fake stages "sleep" in virtual
+seconds, a driver thread advances time to the earliest pending deadline
+once every sleeper is parked, and the assertions compare VIRTUAL
+elapsed time — so a loaded CI box can stretch real wall-clock without
+touching the numbers.  The remaining tests (crash propagation, window
+accounting, worker delegation) run on the real clock with zero delays.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.worker.pipeline import (PipelineExecutor,
+                                                       SyncDispatcher,
+                                                       as_dispatcher)
+
+PIXELS = 16  # tiny payload; the executor never inspects pixel counts
+
+
+class VirtualClock:
+    """Deterministic time: ``sleep(dt)`` parks the caller until virtual
+    ``now`` reaches its deadline; a driver thread advances ``now`` to
+    the earliest deadline whenever the sleeper set has been stable for
+    a short real grace period (pipeline handoffs between sleeps take
+    microseconds, so stability means everyone who will sleep is
+    sleeping)."""
+
+    GRACE_S = 0.02
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._now = 0.0
+        self._sleepers: dict[int, float] = {}
+        self._next_id = 0
+        self._shutdown = False
+        self._driver = threading.Thread(target=self._drive, daemon=True)
+        self._driver.start()
+
+    def now(self) -> float:
+        with self._cond:
+            return self._now
+
+    def sleep(self, dt: float) -> None:
+        with self._cond:
+            sid = self._next_id
+            self._next_id += 1
+            deadline = self._now + dt
+            self._sleepers[sid] = deadline
+            self._cond.notify_all()
+            while self._now < deadline and not self._shutdown:
+                self._cond.wait(0.2)
+            del self._sleepers[sid]
+            self._cond.notify_all()
+
+    def _drive(self) -> None:
+        while True:
+            with self._cond:
+                if self._shutdown:
+                    return
+                snapshot = set(self._sleepers)
+            time.sleep(self.GRACE_S)
+            with self._cond:
+                if self._shutdown:
+                    return
+                if not self._sleepers or set(self._sleepers) != snapshot:
+                    continue  # not yet stable; re-observe
+                self._now = min(self._sleepers.values())
+                self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+        self._driver.join()
+
+
+@pytest.fixture()
+def vclock():
+    clk = VirtualClock()
+    yield clk
+    clk.close()
+
+
+class FakeClient:
+    """In-memory Distributer: hands out ``n_tiles`` workloads, accepts
+    every submit, and tracks the peak leased-but-unsubmitted count —
+    the lease-hoarding metric the window test pins."""
+
+    def __init__(self, n_tiles: int, clock: VirtualClock | None = None,
+                 lease_s: float = 0.0, upload_s: float = 0.0) -> None:
+        self._tiles = [Workload(64, 50, i % 64, i // 64)
+                       for i in range(n_tiles)]
+        self._i = 0
+        self._lock = threading.Lock()
+        self.clock = clock
+        self.lease_s = lease_s
+        self.upload_s = upload_s
+        self.submitted: list[Workload] = []
+        self.outstanding = 0
+        self.max_outstanding = 0
+        self.fail_request_after: int | None = None
+        self.fail_submit_after: int | None = None
+
+    def _sleep(self, dt: float) -> None:
+        if dt > 0 and self.clock is not None:
+            self.clock.sleep(dt)
+
+    def _take(self, n: int) -> list[Workload]:
+        with self._lock:
+            if self.fail_request_after is not None \
+                    and self._i >= self.fail_request_after:
+                raise RuntimeError("lease exchange blew up")
+            got = self._tiles[self._i:self._i + n]
+            self._i += len(got)
+            self.outstanding += len(got)
+            self.max_outstanding = max(self.max_outstanding,
+                                       self.outstanding)
+        return got
+
+    def request(self):
+        self._sleep(self.lease_s)
+        got = self._take(1)
+        return got[0] if got else None
+
+    def request_batch(self, max_count: int):
+        self._sleep(self.lease_s)
+        return self._take(max_count)
+
+    def submit(self, workload, pixels) -> bool:
+        return self.submit_batch([(workload, pixels)])[0]
+
+    def submit_batch(self, results):
+        self._sleep(self.upload_s)
+        with self._lock:
+            if self.fail_submit_after is not None \
+                    and len(self.submitted) + len(results) \
+                    > self.fail_submit_after:
+                raise RuntimeError("submit exchange blew up")
+            self.submitted.extend(w for w, _ in results)
+            self.outstanding -= len(results)
+        return [True] * len(results)
+
+
+class FakeDispatcher:
+    """TileDispatcher with injectable per-stage virtual delays and
+    optional crash points."""
+
+    label = "FakeDispatcher"
+
+    def __init__(self, clock: VirtualClock | None = None,
+                 dispatch_s: float = 0.0, materialize_s: float = 0.0,
+                 n_devices: int = 1) -> None:
+        self.clock = clock
+        self.dispatch_s = dispatch_s
+        self.materialize_s = materialize_s
+        self.n_devices = n_devices
+        self.fail_dispatch_after: int | None = None
+        self.fail_materialize_after: int | None = None
+        self.dispatched = 0
+        self.materialized = 0
+        self.seen_devices: set[int] = set()
+        self._lock = threading.Lock()
+
+    def _sleep(self, dt: float) -> None:
+        if dt > 0 and self.clock is not None:
+            self.clock.sleep(dt)
+
+    def devices(self) -> list:
+        return list(range(self.n_devices))
+
+    def dispatch(self, workload, device):
+        with self._lock:
+            if self.fail_dispatch_after is not None \
+                    and self.dispatched >= self.fail_dispatch_after:
+                raise RuntimeError("kernel dispatch blew up")
+            self.dispatched += 1
+            self.seen_devices.add(device)
+        self._sleep(self.dispatch_s)
+        return (workload, device)
+
+    def materialize(self, handle):
+        with self._lock:
+            if self.fail_materialize_after is not None \
+                    and self.materialized >= self.fail_materialize_after:
+                raise RuntimeError("materialize blew up")
+            self.materialized += 1
+        self._sleep(self.materialize_s)
+        return np.zeros(PIXELS, dtype=np.uint8)
+
+
+# -- overlap on the virtual clock -------------------------------------------
+
+def test_wall_clock_tracks_max_stage_not_sum(vclock):
+    """8 tiles through stage delays lease=0.05 / dispatch=0.2 /
+    materialize=0.1 / upload=0.1 virtual-s: serial cost would be
+    8 * 0.45 = 3.6 vs; pipelined, the 0.2 vs dispatch stage dominates
+    and everything else hides behind it."""
+    n = 8
+    client = FakeClient(n, clock=vclock, lease_s=0.05, upload_s=0.1)
+    disp = FakeDispatcher(clock=vclock, dispatch_s=0.2, materialize_s=0.1)
+    pipe = PipelineExecutor(client, disp, window=4, depth=2,
+                            clock=vclock.now)
+    t0 = vclock.now()
+    rounds = pipe.run()
+    elapsed = vclock.now() - t0
+
+    assert rounds == n
+    assert len(client.submitted) == n
+    serial = n * (0.05 + 0.2 + 0.1 + 0.1)
+    # Must beat serial decisively (the whole point) but cannot beat the
+    # slowest stage's total service time.
+    assert elapsed >= n * 0.2 - 1e-6
+    assert elapsed <= 0.6 * serial, (
+        f"virtual wall {elapsed:.2f}s vs serial {serial:.2f}s: "
+        f"stages are not overlapping")
+    stats = pipe.stage_stats()
+    # The dominant stage is near-saturated; its neighbours mostly bubble.
+    assert stats["stages"]["dispatch"]["occupancy"] > 0.6
+    assert stats["stages"]["lease"]["occupancy"] < 0.5
+
+
+def test_stage_busy_accounting_matches_injected_delays(vclock):
+    n = 6
+    client = FakeClient(n, clock=vclock, lease_s=0.01, upload_s=0.02)
+    disp = FakeDispatcher(clock=vclock, dispatch_s=0.05, materialize_s=0.03)
+    pipe = PipelineExecutor(client, disp, window=3, clock=vclock.now)
+    pipe.run()
+    stages = pipe.stage_stats()["stages"]
+    assert stages["dispatch"]["busy_s"] == pytest.approx(n * 0.05, abs=1e-6)
+    assert stages["materialize"]["busy_s"] == pytest.approx(n * 0.03,
+                                                            abs=1e-6)
+    assert stages["upload"]["items"] == n
+
+
+# -- crash propagation ------------------------------------------------------
+
+@pytest.mark.parametrize("stage", ["lease", "dispatch", "materialize",
+                                   "upload"])
+def test_crash_in_any_stage_propagates_and_drains_window(stage):
+    n = 12
+    client = FakeClient(n)
+    disp = FakeDispatcher()
+    if stage == "lease":
+        client.fail_request_after = 4
+    elif stage == "dispatch":
+        disp.fail_dispatch_after = 3
+    elif stage == "materialize":
+        disp.fail_materialize_after = 3
+    else:
+        client.fail_submit_after = 2
+    pipe = PipelineExecutor(client, disp, window=5, batch_size=2)
+    with pytest.raises(RuntimeError, match="blew up"):
+        pipe.run()
+    # No orphaned in-flight tiles: every leased tile was either
+    # submitted or explicitly abandoned (lease expiry re-issues those).
+    assert pipe.in_flight == 0
+    from distributedmandelbrot_tpu.obs import names as obs_names
+    abandoned = pipe.counters.get(obs_names.PIPELINE_TILES_ABANDONED)
+    assert len(client.submitted) + abandoned == client._i
+
+
+def test_external_stop_drains_window():
+    client = FakeClient(50)
+    disp = FakeDispatcher()
+    stop = threading.Event()
+    pipe = PipelineExecutor(client, disp, window=4)
+    done: list[int] = []
+    t = threading.Thread(
+        target=lambda: done.append(pipe.run(poll_interval=0.01, stop=stop)),
+        daemon=True)
+    t.start()
+    time.sleep(0.15)
+    stop.set()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert pipe.in_flight == 0
+
+
+# -- lease prefetch stays inside the window ---------------------------------
+
+def test_lease_prefetch_never_exceeds_window():
+    """A slow downstream (real 5 ms per dispatch) piles leased tiles up
+    against the window; the client-side peak of leased-but-unsubmitted
+    must never pass it — lease hoarding would starve other workers."""
+    window = 3
+    client = FakeClient(14)
+
+    class SlowDispatcher(FakeDispatcher):
+        def dispatch(self, workload, device):
+            time.sleep(0.005)
+            return super().dispatch(workload, device)
+
+    pipe = PipelineExecutor(client, SlowDispatcher(), window=window,
+                            batch_size=2)
+    pipe.run()
+    assert len(client.submitted) == 14
+    assert client.max_outstanding <= window, (
+        f"peak {client.max_outstanding} leased-but-unsubmitted tiles "
+        f"exceeds window {window}")
+
+
+def test_round_robin_covers_all_devices():
+    client = FakeClient(12)
+    disp = FakeDispatcher(n_devices=3)
+    pipe = PipelineExecutor(client, disp, window=6, depth=2)
+    pipe.run()
+    assert disp.seen_devices == {0, 1, 2}
+
+
+# -- dispatcher adapters and worker delegation ------------------------------
+
+def test_as_dispatcher_picks_sync_wrapper_for_plain_backend():
+    class Plain:
+        def compute_batch(self, workloads):
+            return [np.zeros(PIXELS, dtype=np.uint8) for _ in workloads]
+
+    d = as_dispatcher(Plain())
+    assert isinstance(d, SyncDispatcher)
+    assert d.devices() == [None]
+    out = d.materialize(d.dispatch(Workload(64, 10, 0, 0), None))
+    assert out.shape == (PIXELS,)
+
+
+def test_worker_window_delegates_to_pipeline():
+    from distributedmandelbrot_tpu.worker import Worker
+
+    class Plain:
+        def compute_batch(self, workloads):
+            return [np.full(PIXELS, 7, dtype=np.uint8) for _ in workloads]
+
+    client = FakeClient(9)
+    worker = Worker(client, Plain(), batch_size=2, window=4)
+    rounds = worker.run_until_drained()
+    assert rounds >= 1
+    assert len(client.submitted) == 9
+    assert worker.pipeline is not None
+    assert worker.pipeline.in_flight == 0
+    stats = worker.pipeline.stage_stats()
+    assert stats["stages"]["upload"]["items"] == 9
+    assert worker.counters.get("tiles_computed") == 9
+    assert worker.counters.get("results_accepted") == 9
+
+
+def test_worker_window_zero_keeps_classic_path():
+    from distributedmandelbrot_tpu.worker import Worker
+
+    class Plain:
+        def compute_batch(self, workloads):
+            return [np.zeros(PIXELS, dtype=np.uint8) for _ in workloads]
+
+    client = FakeClient(4)
+    worker = Worker(client, Plain(), batch_size=2, window=0)
+    worker.run_until_drained()
+    assert len(client.submitted) == 4
+    assert worker.pipeline is None
+
+
+def test_run_forever_pipelined_stops_on_event():
+    from distributedmandelbrot_tpu.worker import Worker
+
+    class Plain:
+        def compute_batch(self, workloads):
+            return [np.zeros(PIXELS, dtype=np.uint8) for _ in workloads]
+
+    client = FakeClient(6)
+    worker = Worker(client, Plain(), window=3)
+    stop = threading.Event()
+    t = threading.Thread(target=worker.run_forever,
+                         kwargs=dict(poll_interval=0.01, stop=stop),
+                         daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while len(client.submitted) < 6 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    stop.set()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert len(client.submitted) == 6
+
+
+def test_window_and_depth_validation():
+    client = FakeClient(1)
+    with pytest.raises(ValueError):
+        PipelineExecutor(client, FakeDispatcher(), window=0)
+    with pytest.raises(ValueError):
+        PipelineExecutor(client, FakeDispatcher(), depth=0)
+    from distributedmandelbrot_tpu.worker import Worker
+    with pytest.raises(ValueError):
+        Worker(client, FakeDispatcher(), window=-1)
