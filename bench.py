@@ -1058,6 +1058,12 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
         stage_stats = (worker.pipeline.stage_stats()
                        if worker.pipeline is not None else None)
         backend_cls = type(backend).__name__
+        # Cross-process critical-path attribution: the coordinator's
+        # trace joined with the worker spans it ingested over the wire
+        # (obs/spans.py) — the "where exactly" view beside the phase
+        # sums below.
+        from distributedmandelbrot_tpu.obs.spans import critical_path
+        farm_trace = critical_path(co.trace.spans(), co.spans)
 
     if window > 0:
         # Per-tile turnaround = dispatch->materialized, straight from the
@@ -1114,6 +1120,14 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
             out[f"pipe_{name}_busy_s"] = st["busy_s"]
             out[f"pipe_{name}_occupancy"] = st["occupancy"]
             out[f"pipe_{name}_bubble"] = st["bubble"]
+    if farm_trace.get("tiles"):
+        out["farm_trace_tiles"] = farm_trace["tiles"]
+        out["farm_trace_attributed"] = farm_trace["attributed_tiles"]
+        for phase in ("queue", "compute", "d2h", "upload", "persist",
+                      "other"):
+            out[f"farm_trace_{phase}_s"] = farm_trace[f"{phase}_s"]
+            out[f"farm_trace_{phase}_share"] = \
+                farm_trace[f"{phase}_share"]
     out.update(hist)
     return out
 

@@ -18,7 +18,7 @@ Struct("...")`` it describes, and the documented composition
 ``QUERY == u32 level + QUERY_TAIL`` must hold byte-for-byte (the
 gateway reads the leading u32 alone to sniff the batch magic).
 
-``wire-parity`` — the four protocol-speaking modules must actually
+``wire-parity`` — the protocol-speaking modules must actually
 reference the canonical symbols for the messages they speak (via
 ``proto.X`` or ``from ...net.protocol import X``); a module that stops
 doing so has, by construction, re-typed the format somewhere.  Modules
@@ -61,10 +61,18 @@ STRUCT_FUNCS = frozenset({"Struct", "pack", "unpack", "unpack_from",
 # module -> canonical net/protocol.py symbols it must reference.
 REQUIRED_SYMBOLS = {
     f"{PACKAGE}/coordinator/dataserver.py": ("QUERY",),
+    f"{PACKAGE}/coordinator/distributer.py": ("SPANS_HEADER", "SPAN_SYNC",
+                                              "SPAN_RECORD"),
     f"{PACKAGE}/serve/gateway.py": ("QUERY", "QUERY_TAIL"),
     f"{PACKAGE}/viewer/client.py": ("QUERY", "BATCH_HEADER"),
-    f"{PACKAGE}/worker/client.py": ("WORKLOAD_WIRE_SIZE",),
+    f"{PACKAGE}/worker/client.py": ("WORKLOAD_WIRE_SIZE", "SPANS_HEADER",
+                                    "SPAN_SYNC", "SPAN_RECORD"),
 }
+
+# Span wire frames whose format must lead with the QUERY key triple
+# (level, index_real, index_imag as 3 x u32): keyed frames share one
+# prefix so a reader can always peel the key the same way.
+KEYED_SPAN_STRUCTS = ("SPAN_SYNC", "SPAN_RECORD")
 
 
 def check(project: Project) -> list[Finding]:
@@ -166,6 +174,16 @@ def _check_sizes(sf: SourceFile) -> list[Finding]:
                 f'QUERY ("{head}") must be a leading u32 followed '
                 f'byte-for-byte by QUERY_TAIL ("{tail}"): the gateway '
                 f'sniffs the first u32 for the batch magic'))
+    if sf.relpath == PROTOCOL:
+        key_prefix = fmts.get("QUERY", "<III")
+        for name in KEYED_SPAN_STRUCTS:
+            fmt = fmts.get(name)
+            if fmt is not None and not fmt.startswith(key_prefix):
+                out.append(Finding(
+                    "wire-size", "error", sf.relpath, 1,
+                    f'{name} ("{fmt}") must lead with the QUERY key '
+                    f'triple ("{key_prefix}"): keyed frames share the '
+                    f'tile-key prefix'))
     return out
 
 

@@ -1260,7 +1260,17 @@ def _print_varz(varz: dict) -> None:
         for wid in sorted(workers):
             w = workers[wid]
             print(f"  {wid:<40} {w.get('tiles', 0)} tiles, "
-                  f"{w.get('busy_s', 0.0):.3f}s busy")
+                  f"{w.get('busy_s', 0.0):.3f}s busy "
+                  f"({w.get('busy_source', 'lease')})")
+    farm = varz.get("farm_trace")
+    if farm and farm.get("tiles"):
+        print(f"critical path ({farm['tiles']} tiles, "
+              f"{farm.get('attributed_tiles', 0)} span-attributed):")
+        for phase in ("queue", "compute", "d2h", "upload", "persist",
+                      "other"):
+            secs = farm.get(f"{phase}_s", 0.0)
+            share = farm.get(f"{phase}_share", 0.0)
+            print(f"  {phase:<10} {secs:>10.3f}s  {share * 100:5.1f}%")
 
 
 def cmd_stats(argv: Sequence[str]) -> int:
@@ -1300,6 +1310,43 @@ def cmd_stats(argv: Sequence[str]) -> int:
             time.sleep(args.watch)
         except KeyboardInterrupt:
             return 0
+
+
+def cmd_trace(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu trace",
+        description="Dump a running coordinator's merged farm timeline "
+                    "(coordinator lifecycle + clock-aligned worker spans) "
+                    "as Chrome trace-event JSON from the metrics "
+                    "exporter's /trace.json.  Load the file at "
+                    "https://ui.perfetto.dev or chrome://tracing.")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int,
+                        default=proto.DEFAULT_EXPORTER_PORT)
+    parser.add_argument("--timeout", type=float, default=5.0,
+                        help="HTTP fetch timeout in seconds")
+    parser.add_argument("--out", default="-", metavar="PATH",
+                        help="output path ('-' for stdout)")
+    args = parser.parse_args(argv)
+
+    import json
+    import urllib.request
+    url = f"http://{args.host}:{args.port}/trace.json"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            trace = json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"dmtpu trace: cannot fetch {url}: {e}")
+    body = json.dumps(trace, indent=1)
+    if args.out == "-":
+        print(body, flush=True)
+    else:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(body + "\n")
+        n = len(trace.get("traceEvents", []))
+        print(f"wrote {n} trace events -> {args.out} "
+              f"(load at https://ui.perfetto.dev)", flush=True)
+    return 0
 
 
 def cmd_check(argv: Sequence[str]) -> int:
@@ -1378,7 +1425,7 @@ _NO_FILE = _NoFile()
 COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
             "serve": cmd_serve, "viewer": cmd_viewer, "render": cmd_render,
             "animate": cmd_animate, "compact": cmd_compact,
-            "stats": cmd_stats, "check": cmd_check}
+            "stats": cmd_stats, "trace": cmd_trace, "check": cmd_check}
 
 
 def _enable_compile_cache() -> None:
@@ -1436,7 +1483,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
               "{coordinator|worker|serve|viewer|render|animate|compact|"
-              "stats|check} [options]\n"
+              "stats|trace|check} [options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

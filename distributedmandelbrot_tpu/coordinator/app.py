@@ -22,6 +22,7 @@ from distributedmandelbrot_tpu.net import protocol as proto
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.exporter import MetricsExporter
 from distributedmandelbrot_tpu.obs.metrics import Registry
+from distributedmandelbrot_tpu.obs.spans import SpanStore
 from distributedmandelbrot_tpu.obs.trace import TraceLog
 from distributedmandelbrot_tpu.serve.cache import DecodedTileCache
 from distributedmandelbrot_tpu.serve.gateway import TileGateway
@@ -51,13 +52,16 @@ class Coordinator:
                  gateway_rate: Optional[float] = None,
                  gateway_burst: float = 256.0,
                  ondemand_deadline: float = proto.DEFAULT_ONDEMAND_DEADLINE,
-                 exporter_port: Optional[int] = None) \
+                 exporter_port: Optional[int] = None,
+                 accept_spans: bool = True) \
             -> None:
-        # One registry + one trace ring feed every layer of this process;
-        # the exporter (opt-in like the gateway: exporter_port=None
-        # disables, 0 binds an ephemeral loopback port) serves both.
+        # One registry + one trace ring + one span store feed every layer
+        # of this process; the exporter (opt-in like the gateway:
+        # exporter_port=None disables, 0 binds an ephemeral loopback
+        # port) serves all three.
         self.registry = Registry()
         self.trace = TraceLog()
+        self.spans = SpanStore()
         self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index,
                                 registry=self.registry)
         # Fail loudly if another live coordinator owns any of our levels
@@ -96,7 +100,9 @@ class Coordinator:
                                            sweep_period=sweep_period,
                                            read_timeout=read_timeout,
                                            counters=self.counters,
-                                           trace=self.trace)
+                                           trace=self.trace,
+                                           spans=self.spans,
+                                           accept_spans=accept_spans)
             self.dataserver = DataServer(self.store, host=host,
                                          port=dataserver_port,
                                          read_timeout=read_timeout,
@@ -124,6 +130,7 @@ class Coordinator:
             if exporter_port is not None:
                 self.exporter = MetricsExporter(
                     self.registry, trace=self.trace,
+                    spans=self.spans,
                     varz_extra=self._varz_extra,
                     host=host, port=exporter_port)
         except BaseException:

@@ -36,6 +36,7 @@ from distributedmandelbrot_tpu.core.workload import (WORKLOAD_WIRE_SIZE,
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
 from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.spans import Span, SpanStore
 from distributedmandelbrot_tpu.obs.trace import TraceLog
 from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils.metrics import Counters
@@ -43,6 +44,19 @@ from distributedmandelbrot_tpu.utils.metrics import Counters
 logger = logging.getLogger("dmtpu.distributer")
 
 MAX_BATCH = 4096
+# Per-report ceiling on sync samples / span records: a worker drains its
+# recorder (8 K ring) after every upload, so an honest report is far
+# smaller; a count beyond this is a corrupt or hostile frame.
+MAX_SPANS = 65536
+
+# Wire stage code (net/protocol.py SPAN_STAGE_*) -> stage name.
+_STAGE_NAMES = {
+    proto.SPAN_STAGE_PREFETCH: obs_names.SPAN_PREFETCH,
+    proto.SPAN_STAGE_DISPATCH: obs_names.SPAN_DISPATCH,
+    proto.SPAN_STAGE_COMPUTE: obs_names.SPAN_COMPUTE,
+    proto.SPAN_STAGE_D2H: obs_names.SPAN_D2H,
+    proto.SPAN_STAGE_UPLOAD: obs_names.SPAN_UPLOAD,
+}
 
 
 def _peer_id(writer: asyncio.StreamWriter) -> Optional[str]:
@@ -62,6 +76,8 @@ class Distributer:
                  read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
                  counters: Optional[Counters] = None,
                  trace: Optional[TraceLog] = None,
+                 spans: Optional[SpanStore] = None,
+                 accept_spans: bool = True,
                  on_chunk_saved=None) -> None:
         self.scheduler = scheduler
         self.store = store
@@ -72,6 +88,11 @@ class Distributer:
         self.counters = counters if counters is not None else Counters()
         self.registry = self.counters.registry
         self.trace = trace if trace is not None else TraceLog()
+        self.spans = spans if spans is not None else SpanStore()
+        # False makes this build behave like a legacy coordinator for the
+        # 0x04 extension (unknown purpose byte -> drop the connection) —
+        # the degradation path the worker tests drive.
+        self.accept_spans = accept_spans
         # Optional ``callback(key)`` fired on this event loop after a chunk
         # is durably persisted — the gateway's on-demand path hangs its
         # arrival notification here.
@@ -149,6 +170,8 @@ class Distributer:
                     await self._handle_batch_request(reader, writer)
                 elif purpose == proto.PURPOSE_BATCH_RESPONSE:
                     await self._handle_batch_response(reader, writer)
+                elif purpose == proto.PURPOSE_SPANS and self.accept_spans:
+                    await self._handle_spans(reader, writer)
                 else:
                     logger.error("unknown purpose byte %#x from %s",
                                  purpose, peer)
@@ -177,6 +200,10 @@ class Distributer:
                 writer.write(w.to_wire())
                 self.counters.inc("workloads_granted")
                 self.trace.record("granted", w.key, worker=_peer_id(writer))
+                # Grant timestamp for NTP-style clock alignment: paired
+                # with the worker's request/receive clock samples when a
+                # span report for this key arrives (obs/spans.py).
+                self.spans.note_grant(w.key, time.monotonic())
                 logger.info("granted %s", w)
 
     async def _handle_batch_request(self, reader: asyncio.StreamReader,
@@ -191,15 +218,54 @@ class Distributer:
             framing.write_byte(writer, proto.WORKLOAD_AVAILABLE)
             framing.write_u32(writer, len(grants))
             peer = _peer_id(writer)
+            t_grant = time.monotonic()
             for w in grants:
                 writer.write(w.to_wire())
                 self.trace.record("granted", w.key, worker=peer)
+                self.spans.note_grant(w.key, t_grant)
             self.counters.inc("workloads_granted", len(grants))
             logger.info("granted batch of %d tiles", len(grants))
 
     async def _handle_response(self, reader: asyncio.StreamReader,
                                writer: asyncio.StreamWriter) -> None:
         await self._ingest_one(reader, writer)
+
+    async def _handle_spans(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        """Ingest one worker span report (PURPOSE_SPANS, 0x04)."""
+        hdr = await self._read(
+            framing.read_exact(reader, proto.SPANS_HEADER_WIRE_SIZE))
+        worker_id, n_sync, n_spans = proto.SPANS_HEADER.unpack(hdr)
+        if n_sync > MAX_SPANS or n_spans > MAX_SPANS:
+            logger.error("oversized span report from worker %016x "
+                         "(%d syncs, %d spans)", worker_id, n_sync, n_spans)
+            raise ConnectionError("span report exceeds MAX_SPANS")
+        sync_data = await self._read(framing.read_exact(
+            reader, n_sync * proto.SPAN_SYNC_WIRE_SIZE))
+        span_data = await self._read(framing.read_exact(
+            reader, n_spans * proto.SPAN_RECORD_WIRE_SIZE))
+        for level, ir, ii, t_req, t_recv in \
+                proto.SPAN_SYNC.iter_unpack(sync_data):
+            c_grant = self.spans.grant_time((level, ir, ii))
+            if c_grant is None:
+                # Grant fell out of the bounded map (or predates this
+                # process); the sample cannot be paired.
+                self.counters.inc(obs_names.COORD_SPANS_UNALIGNED)
+                continue
+            self.spans.add_sync(worker_id, c_grant, t_req, t_recv)
+            self.counters.inc(obs_names.COORD_SPAN_SYNC_SAMPLES)
+        records = []
+        for level, ir, ii, stage, device, seq, t0, t1 in \
+                proto.SPAN_RECORD.iter_unpack(span_data):
+            name = _STAGE_NAMES.get(stage)
+            if name is None:
+                continue  # future stage code from a newer worker; skip
+            records.append(Span(name, (level, ir, ii), t0, t1,
+                                device, seq))
+        self.counters.inc(obs_names.COORD_SPANS_INGESTED,
+                          self.spans.ingest(worker_id, records))
+        self.counters.inc(obs_names.COORD_SPAN_REPORTS)
+        framing.write_byte(writer, proto.SPANS_ACCEPT)
 
     async def _handle_batch_response(self, reader: asyncio.StreamReader,
                                      writer: asyncio.StreamWriter) -> None:

@@ -106,6 +106,10 @@ class EmbeddedCoordinator:
         return self.coordinator.trace
 
     @property
+    def spans(self):
+        return self.coordinator.spans
+
+    @property
     def scheduler(self):
         return self.coordinator.scheduler
 

@@ -20,6 +20,13 @@ Distributer protocol (default port 59010).  Connection purpose byte, then:
   n submissions each shaped exactly like a single response (16-byte echo ->
   accept/reject byte -> pixels if accepted).  Per-item dedup semantics are
   identical to singles.
+- ``PURPOSE_SPANS`` (0x04, extension): worker pushes a batch of trace
+  spans after an upload — ``SPANS_HEADER`` (worker id, sync-sample count,
+  span count), then the sync samples and span records; server replies
+  ``SPANS_ACCEPT``.  A legacy coordinator treats 0x04 as an unknown
+  purpose byte and drops the connection; the worker takes the EOF as
+  "spans unsupported", disables the push permanently, and keeps working
+  — tracing degrades, tiles don't.
 
 DataServer protocol (default port 59011): client sends 3 x uint32 LE
 ``(level, index_real, index_imag)``; server replies ``QUERY_ACCEPT`` +
@@ -41,6 +48,7 @@ PURPOSE_REQUEST = 0x00
 PURPOSE_RESPONSE = 0x01
 PURPOSE_BATCH_REQUEST = 0x02  # extension
 PURPOSE_BATCH_RESPONSE = 0x03  # extension
+PURPOSE_SPANS = 0x04  # extension: worker span report push
 
 # Distributer: workload availability
 WORKLOAD_AVAILABLE = 0x10
@@ -49,6 +57,11 @@ WORKLOAD_NOT_AVAILABLE = 0x11
 # Distributer: response acceptance
 RESPONSE_ACCEPT = 0x20
 RESPONSE_REJECT = 0x21
+
+# Distributer: span report acceptance (0x04 extension).  One accept code
+# only: a coordinator that speaks 0x04 always ingests; one that doesn't
+# closes the connection, which is the worker's degradation signal.
+SPANS_ACCEPT = 0x30
 
 # DataServer: query status
 QUERY_ACCEPT = 0x00
@@ -81,6 +94,32 @@ QUERY_TAIL_WIRE_SIZE = 8
 # Gateway batch header: (GATEWAY_BATCH_MAGIC, count), 2 x u32 LE.
 BATCH_HEADER = struct.Struct("<II")
 BATCH_HEADER_WIRE_SIZE = 8
+
+# Span-report push (PURPOSE_SPANS).  Header: (worker_id u64 — a random
+# per-process id, stable across the worker's many short connections;
+# n_sync u32; n_spans u32).
+SPANS_HEADER = struct.Struct("<QII")
+SPANS_HEADER_WIRE_SIZE = 16
+# Clock-sync sample: the tile key of a granted workload plus the worker's
+# monotonic clock just before the lease request was sent and just after
+# the grant arrived.  The coordinator pairs these with its own grant
+# timestamp for the same key to estimate the clock offset NTP-style; the
+# key triple leads, byte-compatible with QUERY, like every keyed frame.
+SPAN_SYNC = struct.Struct("<IIIdd")
+SPAN_SYNC_WIRE_SIZE = 28
+# Span record: tile key, stage code (u8, SPAN_STAGE_*), device index
+# (u8), lease sequence (u16 — distinguishes re-grants of the same tile),
+# then [t0, t1) on the worker's monotonic clock (f64 seconds).
+SPAN_RECORD = struct.Struct("<IIIBBHdd")
+SPAN_RECORD_WIRE_SIZE = 32
+
+# Wire codes for span stages (names live in obs/names.py; the wire uses
+# one byte).  Order matches the worker pipeline.
+SPAN_STAGE_PREFETCH = 0
+SPAN_STAGE_DISPATCH = 1
+SPAN_STAGE_COMPUTE = 2
+SPAN_STAGE_D2H = 3
+SPAN_STAGE_UPLOAD = 4
 
 DEFAULT_DISTRIBUTER_PORT = 59010
 DEFAULT_DATASERVER_PORT = 59011

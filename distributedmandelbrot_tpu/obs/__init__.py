@@ -10,17 +10,29 @@ package is the telemetry spine of the TPU build:
 - :mod:`.trace` — a bounded ring buffer of per-tile lifecycle events
   (``scheduled -> granted -> result_received -> persisted -> served``)
   joined into latency spans and a per-worker skew summary;
+- :mod:`.spans` — cross-process tracing: the worker-side span recorder,
+  the NTP-style clock-offset estimator, and the coordinator-side span
+  store that merges wire-pushed worker spans onto the local timeline;
+- :mod:`.chrome` — the merged timeline as Chrome trace-event JSON
+  (Perfetto-loadable), served by the exporter as ``/trace.json``;
 - :mod:`.exporter` — an asyncio HTTP endpoint serving ``/metrics``
-  (Prometheus text exposition v0.0.4), ``/varz`` (JSON snapshot) and
-  ``/healthz``, enabled from the coordinator like the gateway is.
+  (Prometheus text exposition v0.0.4), ``/varz`` (JSON snapshot),
+  ``/healthz`` and ``/trace.json``, enabled from the coordinator like
+  the gateway is.
 """
 
+from distributedmandelbrot_tpu.obs.chrome import render_chrome_trace
 from distributedmandelbrot_tpu.obs.exporter import (MetricsExporter,
                                                     render_prometheus)
 from distributedmandelbrot_tpu.obs.metrics import (DEFAULT_BUCKETS, Counter,
                                                    Gauge, Histogram, Registry)
+from distributedmandelbrot_tpu.obs.spans import (ClockOffsetEstimator,
+                                                 OffsetEstimate, Span,
+                                                 SpanRecorder, SpanStore,
+                                                 critical_path)
 from distributedmandelbrot_tpu.obs.trace import TraceEvent, TraceLog
 
-__all__ = ["Counter", "DEFAULT_BUCKETS", "Gauge", "Histogram",
-           "MetricsExporter", "Registry", "TraceEvent", "TraceLog",
-           "render_prometheus"]
+__all__ = ["ClockOffsetEstimator", "Counter", "DEFAULT_BUCKETS", "Gauge",
+           "Histogram", "MetricsExporter", "OffsetEstimate", "Registry",
+           "Span", "SpanRecorder", "SpanStore", "TraceEvent", "TraceLog",
+           "critical_path", "render_chrome_trace", "render_prometheus"]

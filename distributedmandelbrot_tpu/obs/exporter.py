@@ -14,7 +14,9 @@ gateway, ephemeral port by default).
 - ``/varz`` — JSON snapshot: every instrument, histogram percentiles,
   plus whatever the embedding coordinator contributes through the
   ``varz_extra`` callback (scheduler frontier depth, trace summaries);
-- ``/healthz`` — liveness probe, ``ok``.
+- ``/healthz`` — liveness probe, ``ok``;
+- ``/trace.json`` — the merged coordinator + worker timeline in Chrome
+  trace-event JSON (obs/chrome.py), loadable at ui.perfetto.dev.
 """
 
 from __future__ import annotations
@@ -26,8 +28,10 @@ import math
 import re
 from typing import Callable, Optional
 
+from distributedmandelbrot_tpu.obs.chrome import render_chrome_trace
 from distributedmandelbrot_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                                    Registry)
+from distributedmandelbrot_tpu.obs.spans import SpanStore, critical_path
 from distributedmandelbrot_tpu.obs.trace import TraceLog
 
 logger = logging.getLogger("dmtpu.exporter")
@@ -110,10 +114,12 @@ class MetricsExporter:
 
     def __init__(self, registry: Registry, *,
                  trace: Optional[TraceLog] = None,
+                 spans: Optional[SpanStore] = None,
                  varz_extra: Optional[Callable[[], dict]] = None,
                  host: str = "127.0.0.1", port: int = 0) -> None:
         self.registry = registry
         self.trace = trace
+        self.spans = spans
         self.varz_extra = varz_extra
         self.host = host
         self.port = port
@@ -124,7 +130,7 @@ class MetricsExporter:
             self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         logger.info("metrics exporter on http://%s:%d (/metrics /varz "
-                    "/healthz)", self.host, self.port)
+                    "/healthz /trace.json)", self.host, self.port)
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -165,9 +171,18 @@ class MetricsExporter:
             elif path == "/healthz":
                 self._respond(writer, 200, "text/plain; charset=utf-8",
                               b"ok\n", head=method == "HEAD")
+            elif path == "/trace.json":
+                # Merged farm timeline in Chrome trace-event format —
+                # what `dmtpu trace` fetches and ui.perfetto.dev loads.
+                body = (json.dumps(render_chrome_trace(self.trace,
+                                                       self.spans))
+                        + "\n").encode()
+                self._respond(writer, 200, "application/json", body,
+                              head=method == "HEAD")
             else:
                 self._respond(writer, 404, "text/plain; charset=utf-8",
-                              b"not found (try /metrics /varz /healthz)\n")
+                              b"not found (try /metrics /varz /healthz "
+                              b"/trace.json)\n")
             await writer.drain()
         except (ConnectionError, TimeoutError, asyncio.TimeoutError,
                 asyncio.CancelledError):
@@ -196,13 +211,22 @@ class MetricsExporter:
         out = self.registry.snapshot()
         if self.trace is not None:
             spans = self.trace.spans()
+            reported = (self.spans.compute_seconds_by_key()
+                        if self.spans is not None else None)
             out["trace"] = {
                 "recorded": self.trace.recorded,
                 "dropped": self.trace.dropped,
                 "spans": len(spans),
                 "complete_spans": sum(1 for s in spans if s["complete"]),
-                "worker_skew": self.trace.worker_skew(),
+                "worker_skew": self.trace.worker_skew(reported=reported),
             }
+            if self.spans is not None:
+                out["trace"]["span_store"] = {
+                    "ingested": self.spans.ingested,
+                    "workers": len(self.spans.workers()),
+                    "unaligned": self.spans.unaligned,
+                }
+                out["farm_trace"] = critical_path(spans, self.spans)
         if self.varz_extra is not None:
             try:
                 out.update(self.varz_extra())

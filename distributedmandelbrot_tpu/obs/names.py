@@ -78,6 +78,35 @@ HIST_BACKEND_PHASE_SECONDS = "worker_backend_phase_seconds"
 PHASE_DISPATCH = "dispatch"
 PHASE_MATERIALIZE = "materialize"
 
+# -- distributed tracing (cross-process spans) ----------------------------
+
+# Worker-side span push over PURPOSE_SPANS (0x04): records pushed,
+# reports sent, and the one-shot degradation counter bumped when a
+# legacy coordinator closes the connection on the unknown purpose byte.
+WORKER_SPANS_PUSHED = "worker_spans_pushed"
+WORKER_SPAN_REPORTS = "worker_span_reports"
+WORKER_SPANS_UNSUPPORTED = "worker_spans_unsupported"
+WORKER_SPANS_DROPPED = "worker_spans_dropped"
+# Coordinator-side ingest.
+COORD_SPAN_REPORTS = "coord_span_reports"
+COORD_SPANS_INGESTED = "coord_spans_ingested"
+COORD_SPAN_SYNC_SAMPLES = "coord_span_sync_samples"
+COORD_SPANS_UNALIGNED = "coord_spans_unaligned"
+
+# Span stage label values, in worker pipeline order.  ``prefetch`` is
+# the lease exchange that delivered the tile, ``dispatch`` the host-side
+# kernel enqueue, ``compute`` the tile's device residency (dispatch
+# start -> materialized), ``d2h`` the device wait + device->host copy,
+# ``upload`` the submit exchange.  The wire carries these as one-byte
+# codes (net/protocol.py SPAN_STAGE_*).
+SPAN_PREFETCH = "prefetch"
+SPAN_DISPATCH = "dispatch"
+SPAN_COMPUTE = "compute"
+SPAN_D2H = "d2h"
+SPAN_UPLOAD = "upload"
+SPAN_STAGES = (SPAN_PREFETCH, SPAN_DISPATCH, SPAN_COMPUTE, SPAN_D2H,
+               SPAN_UPLOAD)
+
 # -- store ----------------------------------------------------------------
 
 HIST_STORE_READ_SECONDS = "store_read_seconds"

@@ -1,0 +1,361 @@
+"""Cross-process span tracing: worker recorder, clock alignment, merged store.
+
+PR 2's trace ring is coordinator-local: a tile's whole worker life —
+lease prefetch wait, per-device dispatch, kernel residency, D2H, upload
+— collapses into one opaque ``granted -> result_received`` interval on
+the coordinator's clock.  This module is the other half of the timeline:
+
+- :class:`SpanRecorder` — worker-side, thread-safe, bounded.  The worker
+  loop and the pipelined executor record per-stage spans (``prefetch`` /
+  ``dispatch`` / ``compute`` / ``d2h`` / ``upload``, names in
+  obs/names.py) keyed by tile key + lease sequence, all on the worker's
+  ``time.monotonic``.  Drained after each upload and pushed over the
+  ``PURPOSE_SPANS`` wire extension (net/protocol.py).
+- :class:`ClockOffsetEstimator` — NTP-style per-worker offset from the
+  lease round-trip.  The worker samples its clock just before sending a
+  lease request (``t_req``) and just after the grant arrives
+  (``t_recv``); the coordinator stamped the grant at ``c_grant`` on its
+  own clock.  The grant sits somewhere inside the round trip, so
+  ``offset = c_grant - (t_req + t_recv) / 2`` with error bounded by half
+  the round trip — the classic NTP midpoint with one server timestamp.
+  Among many samples the minimum-RTT one wins (least bound).
+- :class:`SpanStore` — coordinator-side merge point.  Raw worker-clock
+  spans are kept per worker and aligned to the coordinator clock at read
+  time, so a later, tighter offset sample retroactively improves every
+  span already ingested.
+- :func:`critical_path` — attributes each complete tile's life across
+  queue / compute / d2h / upload / persist, splitting the coordinator's
+  opaque grant->receive blob with the worker-reported stages when they
+  are present (surfaced in ``dmtpu stats`` and ``bench.py --farm``).
+
+Durations never need alignment (both endpoints share the skew), so the
+skew summary and critical-path attribution stay exact even when the
+offset estimate is loose; only absolute placement on the merged timeline
+carries the round-trip error bound.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Iterable, NamedTuple, Optional, Sequence
+
+from distributedmandelbrot_tpu.obs import names as obs_names
+
+Key = tuple[int, int, int]
+
+# Per-key lease-sequence map cap: re-grants of the same tile are rare
+# (lease expiry), so the map is cleared wholesale past this size rather
+# than carrying LRU machinery for a diagnostic field.
+_SEQ_MAP_CAP = 65536
+
+
+class Span(NamedTuple):
+    stage: str  # obs_names.SPAN_* value
+    key: Key
+    t0: float  # worker monotonic seconds
+    t1: float
+    device: int = 0
+    seq: int = 0  # lease sequence (distinguishes re-grants of one tile)
+
+
+class SyncSample(NamedTuple):
+    key: Key
+    t_req: float  # worker clock just before the lease request went out
+    t_recv: float  # worker clock just after the grant arrived
+
+
+class OffsetEstimate(NamedTuple):
+    offset: float  # coordinator clock - worker clock, seconds
+    error: float  # bound: half the grant round trip of the best sample
+
+
+class ClockOffsetEstimator:
+    """Best-of-N NTP midpoint estimate from lease round trips."""
+
+    def __init__(self) -> None:
+        self._best: Optional[OffsetEstimate] = None
+        self.samples = 0
+
+    def add_sample(self, c_grant: float, t_req: float,
+                   t_recv: float) -> None:
+        if t_recv < t_req:
+            return  # nonsensical sample (clock stepped); ignore
+        self.samples += 1
+        est = OffsetEstimate(c_grant - (t_req + t_recv) / 2.0,
+                             (t_recv - t_req) / 2.0)
+        if self._best is None or est.error < self._best.error:
+            self._best = est
+
+    @property
+    def estimate(self) -> Optional[OffsetEstimate]:
+        return self._best
+
+
+class SpanRecorder:
+    """Worker-side bounded span buffer (thread-safe: the pipeline's four
+    stage threads all write; the upload stage drains)."""
+
+    def __init__(self, capacity: int = 8192, *,
+                 clock=time.monotonic,
+                 worker_id: Optional[int] = None) -> None:
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self.clock = clock
+        # Random 64-bit id: stable across this worker's many short
+        # connections, so the coordinator can group spans per process.
+        self.worker_id = (worker_id if worker_id is not None
+                          else random.getrandbits(64))
+        self.enabled = True
+        self._lock = threading.Lock()
+        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._syncs: deque[SyncSample] = deque(maxlen=256)
+        self._seq = 0
+        self._seq_by_key: dict[Key, int] = {}
+        self._dropped = 0
+
+    def note_grant(self, keys: Sequence[Key], t_req: float,
+                   t_recv: float) -> None:
+        """Record one lease exchange: a clock-sync sample (first granted
+        key stands for the exchange) plus a ``prefetch`` span per tile."""
+        if not self.enabled or not keys:
+            return
+        with self._lock:
+            self._seq += 1
+            seq = self._seq & 0xFFFF
+            if len(self._seq_by_key) > _SEQ_MAP_CAP:
+                self._seq_by_key.clear()
+            for k in keys:
+                self._seq_by_key[k] = seq
+            self._syncs.append(SyncSample(keys[0], t_req, t_recv))
+            for k in keys:
+                self._append_locked(Span(obs_names.SPAN_PREFETCH, k,
+                                         t_req, t_recv, 0, seq))
+
+    def record(self, stage: str, key: Key, t0: float, t1: float,
+               device: int = 0) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._append_locked(Span(stage, key, t0, t1, device,
+                                     self._seq_by_key.get(key, 0)))
+
+    def _append_locked(self, span: Span) -> None:
+        # Caller holds self._lock (the _locked suffix is the contract;
+        # both call sites are inside ``with self._lock`` blocks).
+        if len(self._spans) == self.capacity:
+            # dmtpu: ignore[lock-guard] — held by caller, see above
+            self._dropped += 1
+        # dmtpu: ignore[lock-guard] — held by caller, see above
+        self._spans.append(span)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def drain(self) -> tuple[list[SyncSample], list[Span]]:
+        """Take everything recorded so far (called after each upload by
+        the span-push path; the buffers start empty again)."""
+        with self._lock:
+            syncs, spans = list(self._syncs), list(self._spans)
+            self._syncs.clear()
+            self._spans.clear()
+        return syncs, spans
+
+
+class SpanStore:
+    """Coordinator-side merge point for remote worker spans.
+
+    ``note_grant`` is called by the distributer at grant time (same
+    moment the ``granted`` trace event is recorded) so later sync
+    samples can be paired with the coordinator-clock grant timestamp.
+    Ingested spans stay on the worker's clock; :meth:`spans` aligns them
+    with the current best per-worker offset at read time.
+    """
+
+    def __init__(self, capacity: int = 16384, *,
+                 grant_capacity: int = 65536) -> None:
+        self._lock = threading.Lock()
+        self._spans: deque[tuple[int, Span]] = deque(maxlen=capacity)
+        self._grants: dict[Key, float] = {}
+        self._grant_order: deque[Key] = deque()
+        self._grant_capacity = grant_capacity
+        self._estimators: dict[int, ClockOffsetEstimator] = {}
+        self.ingested = 0
+
+    # -- coordinator-side bookkeeping ---------------------------------
+
+    def note_grant(self, key: Key, ts: float) -> None:
+        with self._lock:
+            if key not in self._grants:
+                self._grant_order.append(key)
+            self._grants[key] = ts
+            while len(self._grant_order) > self._grant_capacity:
+                old = self._grant_order.popleft()
+                self._grants.pop(old, None)
+
+    def grant_time(self, key: Key) -> Optional[float]:
+        with self._lock:
+            return self._grants.get(key)
+
+    # -- ingest --------------------------------------------------------
+
+    def add_sync(self, worker_id: int, c_grant: float, t_req: float,
+                 t_recv: float) -> None:
+        with self._lock:
+            est = self._estimators.get(worker_id)
+            if est is None:
+                est = self._estimators[worker_id] = ClockOffsetEstimator()
+            est.add_sample(c_grant, t_req, t_recv)
+
+    def ingest(self, worker_id: int, spans: Iterable[Span]) -> int:
+        n = 0
+        with self._lock:
+            for span in spans:
+                self._spans.append((worker_id, span))
+                n += 1
+            self.ingested += n
+        return n
+
+    # -- read side -----------------------------------------------------
+
+    def offset(self, worker_id: int) -> Optional[OffsetEstimate]:
+        with self._lock:
+            est = self._estimators.get(worker_id)
+        return est.estimate if est is not None else None
+
+    def workers(self) -> list[int]:
+        with self._lock:
+            seen = {wid for wid, _ in self._spans}
+            seen.update(self._estimators)
+        return sorted(seen)
+
+    @property
+    def unaligned(self) -> int:
+        """Spans held for workers with no usable offset estimate yet."""
+        with self._lock:
+            return sum(1 for wid, _ in self._spans
+                       if self._estimators.get(wid) is None
+                       or self._estimators[wid].estimate is None)
+
+    def spans(self) -> list[dict]:
+        """Ingested spans aligned to the coordinator clock; workers with
+        no offset estimate are omitted (their placement is unknowable)."""
+        with self._lock:
+            items = list(self._spans)
+            offsets = {wid: est.estimate
+                       for wid, est in self._estimators.items()}
+        out = []
+        for wid, span in items:
+            est = offsets.get(wid)
+            if est is None:
+                continue
+            out.append({
+                "worker": wid, "key": span.key, "stage": span.stage,
+                "device": span.device, "seq": span.seq,
+                "t0": span.t0 + est.offset, "t1": span.t1 + est.offset,
+                "align_error_s": est.error,
+            })
+        out.sort(key=lambda s: s["t0"])
+        return out
+
+    def stage_seconds_by_key(self) -> dict[Key, dict[str, float]]:
+        """Per-tile summed stage durations (worker-reported; duration
+        needs no clock alignment).  The skew fix and the critical-path
+        attribution both read this."""
+        with self._lock:
+            items = [span for _, span in self._spans]
+        out: dict[Key, dict[str, float]] = {}
+        for span in items:
+            stages = out.setdefault(span.key, {})
+            stages[span.stage] = (stages.get(span.stage, 0.0)
+                                  + max(0.0, span.t1 - span.t0))
+        return out
+
+    def compute_seconds_by_key(self) -> dict[Key, float]:
+        """Per-tile worker-reported compute seconds — what
+        ``TraceLog.worker_skew`` substitutes for its grant->receive
+        fallback (``busy_source: "reported"``)."""
+        return {key: stages[obs_names.SPAN_COMPUTE]
+                for key, stages in self.stage_seconds_by_key().items()
+                if obs_names.SPAN_COMPUTE in stages}
+
+
+def critical_path(trace_spans: list[dict],
+                  store: Optional[SpanStore]) -> dict:
+    """Attribute complete tiles' lifetimes across the pipeline.
+
+    ``queue`` and ``persist`` come from the coordinator's own events;
+    the opaque grant->receive blob splits into ``compute`` (device
+    residency minus the D2H tail), ``d2h``, ``upload`` and ``other``
+    (network + worker-internal queueing) when the worker reported spans
+    for the tile, and is attributed wholesale to ``compute`` otherwise
+    (the lease fallback, as in the pre-tracing skew summary).
+    """
+    by_key = store.stage_seconds_by_key() if store is not None else {}
+    sums = {"queue": 0.0, "compute": 0.0, "d2h": 0.0, "upload": 0.0,
+            "persist": 0.0, "other": 0.0}
+    tiles = attributed = 0
+    total = 0.0
+    for span in trace_spans:
+        if not span.get("complete"):
+            continue
+        tiles += 1
+        total += span.get("total_s", 0.0)
+        sums["queue"] += span.get("queue_s", 0.0)
+        sums["persist"] += span.get("persist_s", 0.0)
+        blob = span.get("compute_s", 0.0)  # granted -> result_received
+        stages = by_key.get(span["key"])
+        if stages and obs_names.SPAN_COMPUTE in stages:
+            attributed += 1
+            d2h = stages.get(obs_names.SPAN_D2H, 0.0)
+            compute = max(0.0, stages[obs_names.SPAN_COMPUTE] - d2h)
+            upload = stages.get(obs_names.SPAN_UPLOAD, 0.0)
+            sums["compute"] += compute
+            sums["d2h"] += d2h
+            sums["upload"] += upload
+            sums["other"] += max(0.0, blob - compute - d2h - upload)
+        else:
+            sums["compute"] += blob
+    out: dict = {"tiles": tiles, "attributed_tiles": attributed,
+                 "total_s": round(total, 6)}
+    for name, secs in sums.items():
+        out[f"{name}_s"] = round(secs, 6)
+        out[f"{name}_share"] = round(secs / total, 4) if total > 0 else 0.0
+    return out
+
+
+def flush_spans(recorder: Optional[SpanRecorder], client,
+                counters) -> None:
+    """Drain ``recorder`` and push over the client's 0x04 exchange.
+
+    One copy of the push-after-upload policy shared by the classic
+    worker loop and the pipelined executor: a rejected push (legacy
+    coordinator closed the connection) disables the recorder, bumps
+    ``worker_spans_unsupported`` once, and is never an error — tracing
+    degrades, tiles don't.
+    """
+    if recorder is None or not recorder.enabled:
+        return
+    push = getattr(client, "push_spans", None)
+    if push is None:  # duck-typed in-process client: no wire, no push
+        recorder.enabled = False
+        return
+    syncs, spans = recorder.drain()
+    if not syncs and not spans:
+        return
+    if push(recorder.worker_id, syncs, spans):
+        counters.inc(obs_names.WORKER_SPAN_REPORTS)
+        counters.inc(obs_names.WORKER_SPANS_PUSHED, len(spans))
+    else:
+        recorder.enabled = False
+        counters.inc(obs_names.WORKER_SPANS_UNSUPPORTED)
+        counters.inc(obs_names.WORKER_SPANS_DROPPED, len(spans))

@@ -119,29 +119,55 @@ class TraceLog:
             out.append(span)
         return out
 
-    def worker_skew(self) -> dict:
+    def worker_skew(self,
+                    reported: Optional[dict[Key, float]] = None) -> dict:
         """Per-worker load summary over the current window.
 
         For each worker (connection id) seen on a ``result_received``:
-        tiles finished and busy seconds (sum of grant->receive).  The
-        headline ``skew`` is max busy / mean busy across workers — 1.0
-        is a perfectly balanced farm; the MPI-paper pathology shows up
-        as one worker's skew >> 1 while the rest idle.
+        tiles finished and busy seconds.  ``busy_s`` prefers the
+        worker-reported compute-span duration for the tile (``reported``
+        maps tile key -> seconds, typically ``SpanStore.compute_seconds
+        _by_key()``); without one it falls back to the coordinator-only
+        grant->receive interval — which also contains network + upload
+        time, so each worker's ``busy_source`` labels what the number
+        is: ``"reported"`` (all tiles span-backed), ``"lease"`` (pure
+        fallback), or ``"mixed"``.  The headline ``skew`` is max busy /
+        mean busy across workers — 1.0 is a perfectly balanced farm; the
+        MPI-paper pathology shows up as one worker's skew >> 1 while the
+        rest idle.
         """
+        reported = reported or {}
         busy: dict[str, float] = {}
         tiles: dict[str, int] = {}
+        span_tiles: dict[str, int] = {}
         for span in self.spans():
             worker = span.get("worker")
-            if worker is None or "compute_s" not in span:
+            if worker is None:
                 continue
-            busy[worker] = busy.get(worker, 0.0) + span["compute_s"]
+            if span["key"] in reported:
+                dur = reported[span["key"]]
+                from_span = True
+            elif "compute_s" in span:
+                dur = span["compute_s"]
+                from_span = False
+            else:
+                continue
+            busy[worker] = busy.get(worker, 0.0) + dur
             tiles[worker] = tiles.get(worker, 0) + 1
+            span_tiles[worker] = span_tiles.get(worker, 0) + from_span
         if not busy:
             return {"workers": {}, "skew": None}
+
+        def source(w: str) -> str:
+            if span_tiles[w] == tiles[w]:
+                return "reported"
+            return "lease" if span_tiles[w] == 0 else "mixed"
+
         mean = sum(busy.values()) / len(busy)
         return {
             "workers": {w: {"tiles": tiles[w],
-                            "busy_s": round(busy[w], 6)}
+                            "busy_s": round(busy[w], 6),
+                            "busy_source": source(w)}
                         for w in sorted(busy)},
             "skew": round(max(busy.values()) / mean, 3) if mean > 0 else None,
         }

@@ -134,12 +134,20 @@ class PallasBackend:
         self.definition = definition
         self.clamp = clamp
         self.registry = registry if registry is not None else Registry()
+        self.spans = None  # SpanRecorder once the worker binds one
 
     def bind_registry(self, registry: Registry) -> None:
         """Adopt the worker's registry so the phase histograms land where
         the exporter scrapes.  Called at worker construction, before any
         compute thread exists, so no observation can straddle the swap."""
         self.registry = registry
+
+    def bind_spans(self, recorder) -> None:
+        """Adopt the worker's span recorder: the batch path then records
+        per-tile compute/d2h spans itself (it knows the tile keys; the
+        worker loop only sees batch boundaries).  Same construction-time
+        timing contract as :meth:`bind_registry`."""
+        self.spans = recorder
 
     def _observe_phase(self, phase: str, seconds: float) -> None:
         self.registry.observe(obs_names.HIST_BACKEND_PHASE_SECONDS,
@@ -193,8 +201,28 @@ class PallasBackend:
         # Two-phase: dispatch every tile's kernel first (the device queue
         # runs them back to back), then materialize — compute of tile k
         # overlaps the device->host transfer of tile k-1.
-        pending = [self.dispatch_tile(w) for w in workloads]
-        return [self.materialize_tile(p) for p in pending]
+        if self.spans is None:
+            pending = [self.dispatch_tile(w) for w in workloads]
+            return [self.materialize_tile(p) for p in pending]
+        clock = self.spans.clock
+        pending = []
+        for w in workloads:
+            t0 = clock()
+            handle = self.dispatch_tile(w)
+            pending.append((w, handle, t0,
+                            clock()))
+        out = []
+        for w, handle, t_disp, t_disp_end in pending:
+            self.spans.record(obs_names.SPAN_DISPATCH, w.key,
+                              t_disp, t_disp_end)
+            t0 = clock()
+            out.append(self.materialize_tile(handle))
+            t1 = clock()
+            # d2h = the materialize call (device wait + D2H); compute =
+            # dispatch start -> materialized, so d2h nests inside it.
+            self.spans.record(obs_names.SPAN_D2H, w.key, t0, t1)
+            self.spans.record(obs_names.SPAN_COMPUTE, w.key, t_disp, t1)
+        return out
 
 
 def recompute_unresolvable_f32(workloads: Sequence[Workload],

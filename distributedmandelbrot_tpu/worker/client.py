@@ -18,6 +18,16 @@ from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
 from distributedmandelbrot_tpu.net.protocol import WORKLOAD_WIRE_SIZE
+from distributedmandelbrot_tpu.obs import names as obs_names
+
+# Span stage name (obs/names.py) -> one-byte wire code, pipeline order.
+_STAGE_CODES = {
+    obs_names.SPAN_PREFETCH: proto.SPAN_STAGE_PREFETCH,
+    obs_names.SPAN_DISPATCH: proto.SPAN_STAGE_DISPATCH,
+    obs_names.SPAN_COMPUTE: proto.SPAN_STAGE_COMPUTE,
+    obs_names.SPAN_D2H: proto.SPAN_STAGE_D2H,
+    obs_names.SPAN_UPLOAD: proto.SPAN_STAGE_UPLOAD,
+}
 
 
 class DistributerClient:
@@ -26,6 +36,10 @@ class DistributerClient:
         self.host = host
         self.port = port
         self.timeout = timeout
+        # Latched the first time a span push fails: a legacy coordinator
+        # drops the connection on the unknown 0x04 purpose byte, and
+        # retrying every upload would just spam its error log.
+        self.span_push_disabled = False
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection((self.host, self.port),
@@ -63,6 +77,39 @@ class DistributerClient:
             return [Workload.from_wire(
                 framing.recv_exact(sock, WORKLOAD_WIRE_SIZE))
                 for _ in range(n)]
+
+    # -- span push (0x04 extension) ---------------------------------------
+
+    def push_spans(self, worker_id: int, syncs, spans) -> bool:
+        """Best-effort batched span report after an upload.
+
+        ``syncs`` are (key, t_req, t_recv) clock samples; ``spans`` are
+        (stage, key, t0, t1, device, seq) records — both the tuple
+        shapes obs/spans.py drains.  Returns False and permanently
+        disables the push when the coordinator does not speak 0x04
+        (EOF/reset instead of ``SPANS_ACCEPT``); never raises.
+        """
+        if self.span_push_disabled:
+            return False
+        buf = bytearray()
+        buf += proto.SPANS_HEADER.pack(worker_id, len(syncs), len(spans))
+        for key, t_req, t_recv in syncs:
+            buf += proto.SPAN_SYNC.pack(*key, t_req, t_recv)
+        for stage, key, t0, t1, device, seq in spans:
+            buf += proto.SPAN_RECORD.pack(*key, _STAGE_CODES[stage],
+                                          device, seq, t0, t1)
+        try:
+            with self._connect() as sock:
+                framing.send_byte(sock, proto.PURPOSE_SPANS)
+                framing.send_all(sock, bytes(buf))
+                status = framing.recv_byte(sock)
+                if status != proto.SPANS_ACCEPT:
+                    raise framing.ProtocolError(
+                        f"unexpected span ack {status:#x}")
+            return True
+        except (OSError, framing.ProtocolError):
+            self.span_push_disabled = True
+            return False
 
     # -- result submission ------------------------------------------------
 

@@ -51,6 +51,7 @@ import numpy as np
 
 from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.spans import SpanRecorder, flush_spans
 from distributedmandelbrot_tpu.utils.metrics import Counters
 from distributedmandelbrot_tpu.worker.client import DistributerClient
 
@@ -168,7 +169,8 @@ class PipelineExecutor:
                  dispatcher: TileDispatcher, *,
                  window: int = 8, depth: int = 2, batch_size: int = 1,
                  counters: Optional[Counters] = None,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 spans: Optional[SpanRecorder] = None) -> None:
         if window < 1:
             raise ValueError("window must be >= 1")
         if depth < 1:
@@ -183,6 +185,11 @@ class PipelineExecutor:
         self.counters = counters if counters is not None else Counters()
         self.registry = self.counters.registry
         self._hist_labels = {"backend": dispatcher.label}
+        # Cross-process spans (obs/spans.py).  Span timestamps always
+        # come from the recorder's own clock, never ``clock`` — stage
+        # accounting may run on a virtual clock in tests, but spans must
+        # stay comparable with the coordinator's monotonic timeline.
+        self.spans = spans
 
         self._dispatch_q: queue.Queue = queue.Queue()
         self._mat_q: queue.Queue = queue.Queue()
@@ -262,9 +269,15 @@ class PipelineExecutor:
             # window, so ``room`` can only have grown meanwhile and the
             # prefetch can never exceed ``window`` leases outstanding.
             want = min(self.batch_size, room)
+            s0 = self.spans.clock() if self.spans is not None else 0.0
             t0 = self.clock()
             got = self._acquire(want)
             dt = self.clock() - t0
+            if self.spans is not None and got:
+                # The lease round trip doubles as the clock-sync sample
+                # the coordinator aligns this worker's spans with.
+                self.spans.note_grant([w.key for w in got], s0,
+                                      self.spans.clock())
             st.add(dt)
             self.counters.inc(obs_names.WORKER_LEASE_US, int(dt * 1e6))
             self.counters.inc(obs_names.PIPELINE_LEASE_EXCHANGES)
@@ -310,6 +323,7 @@ class PipelineExecutor:
                 # and permits die with the executor.
                 self._abandon(1)
                 continue
+            s0 = self.spans.clock() if self.spans is not None else 0.0
             t0 = self.clock()
             try:
                 handle = self.dispatcher.dispatch(item, devices[d])
@@ -319,10 +333,13 @@ class PipelineExecutor:
                 raise
             dt = self.clock() - t0
             st.add(dt)
+            if self.spans is not None:
+                self.spans.record(obs_names.SPAN_DISPATCH, item.key,
+                                  s0, self.spans.clock(), device=d)
             self.registry.observe(
                 obs_names.HIST_PIPELINE_STAGE_SECONDS, dt,
                 labels={"stage": obs_names.STAGE_DISPATCH})
-            self._mat_q.put((item, d, handle, t0))
+            self._mat_q.put((item, d, handle, t0, s0))
 
     @staticmethod
     def _start_host_copy(handle) -> None:
@@ -342,7 +359,7 @@ class PipelineExecutor:
             nxt = None
             if item is _EOS:
                 return
-            workload, d, handle, t_disp = item
+            workload, d, handle, t_disp, s_disp = item
             # One-step lookahead: start the NEXT tile's D2H before
             # blocking on this one, so transfer overlaps compute.
             self._start_host_copy(handle)
@@ -356,6 +373,7 @@ class PipelineExecutor:
                 sems[d].release()
                 self._abandon(1)
                 continue
+            s0 = self.spans.clock() if self.spans is not None else 0.0
             t0 = self.clock()
             try:
                 pixels = self.dispatcher.materialize(handle)
@@ -366,6 +384,15 @@ class PipelineExecutor:
                 sems[d].release()
             dt = self.clock() - t0
             st.add(dt)
+            if self.spans is not None:
+                s1 = self.spans.clock()
+                # d2h = the materialize call (device wait + D2H copy);
+                # compute = the tile's whole device residency, dispatch
+                # start -> materialized, so d2h nests inside it.
+                self.spans.record(obs_names.SPAN_D2H, workload.key,
+                                  s0, s1, device=d)
+                self.spans.record(obs_names.SPAN_COMPUTE, workload.key,
+                                  s_disp, s1, device=d)
             tile_s = self.clock() - t_disp
             self.counters.inc(obs_names.WORKER_TILES_COMPUTED)
             self.counters.inc(obs_names.WORKER_COMPUTE_US,
@@ -379,6 +406,7 @@ class PipelineExecutor:
 
     def _submit(self, results: Sequence[tuple[Workload, np.ndarray]]) -> None:
         st = self._stats[obs_names.STAGE_UPLOAD]
+        s0 = self.spans.clock() if self.spans is not None else 0.0
         t0 = self.clock()
         if len(results) == 1:
             accepted = [self.client.submit(*results[0])]
@@ -386,6 +414,12 @@ class PipelineExecutor:
             accepted = self.client.submit_batch(results)
         dt = self.clock() - t0
         st.add(dt, len(results))
+        if self.spans is not None:
+            s1 = self.spans.clock()
+            for w, _ in results:
+                self.spans.record(obs_names.SPAN_UPLOAD, w.key, s0, s1)
+            # Push rides the upload stage thread — off the compute path.
+            flush_spans(self.spans, self.client, self.counters)
         self.counters.inc(obs_names.WORKER_UPLOAD_US, int(dt * 1e6))
         self.registry.observe(
             obs_names.HIST_PIPELINE_STAGE_SECONDS, dt,

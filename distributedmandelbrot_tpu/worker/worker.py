@@ -32,6 +32,7 @@ import numpy as np
 
 from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.spans import SpanRecorder, flush_spans
 from distributedmandelbrot_tpu.utils.metrics import Counters
 from distributedmandelbrot_tpu.worker.backends import ComputeBackend
 from distributedmandelbrot_tpu.worker.client import DistributerClient
@@ -63,6 +64,15 @@ class Worker:
         bind = getattr(backend, "bind_registry", None)
         if bind is not None:
             bind(self.registry)
+        # Per-stage spans, pushed to the coordinator after each upload
+        # (obs/spans.py).  A backend that can time its own per-tile
+        # compute/D2H phases adopts the recorder and owns those stages;
+        # otherwise run_once records batch-granularity compute spans.
+        self.spans = SpanRecorder()
+        bind_spans = getattr(backend, "bind_spans", None)
+        self._backend_spans = bind_spans is not None
+        if bind_spans is not None:
+            bind_spans(self.spans)
         # Histograms are labeled by backend class so a mixed farm's
         # artifacts separate Pallas tiles from the numpy control.
         self._hist_labels = {"backend": type(backend).__name__}
@@ -84,11 +94,17 @@ class Worker:
             accepted = [self.client.submit(*results[0])]
         else:
             accepted = self.client.submit_batch(results)
+        t1 = time.monotonic()
+        for w, _ in results:
+            self.spans.record(obs_names.SPAN_UPLOAD, w.key, t0, t1)
+        # Push runs on whichever thread submitted (the overlap-IO thread
+        # when enabled) — span traffic stays off the compute path.
+        flush_spans(self.spans, self.client, self.counters)
         # Timed here so both the inline and the overlap-IO thread path
         # feed the same counter (bench_farm's phase breakdown).
         # Microsecond units: sub-ms loopback events would floor to zero
         # in ms and hide exactly the overheads the breakdown exposes.
-        upload_s = time.monotonic() - t0
+        upload_s = t1 - t0
         self.counters.inc(obs_names.WORKER_UPLOAD_US, int(upload_s * 1e6))
         self.registry.observe(obs_names.HIST_WORKER_UPLOAD_SECONDS,
                               upload_s, labels=self._hist_labels)
@@ -122,14 +138,24 @@ class Worker:
         """One pull/compute/submit round; False when no work was available."""
         t_lease = time.monotonic()
         workloads = self._acquire()
+        t_grant = time.monotonic()
         self.counters.inc(obs_names.WORKER_LEASE_US,
-                          int((time.monotonic() - t_lease) * 1e6))
+                          int((t_grant - t_lease) * 1e6))
         if not workloads:
             self._join_upload()
             return False
+        # The lease round trip doubles as the clock-sync sample the
+        # coordinator aligns this worker's spans with (obs/spans.py).
+        self.spans.note_grant([w.key for w in workloads], t_lease, t_grant)
         t0 = time.monotonic()
         pixels = self.backend.compute_batch(workloads)
-        compute_s = time.monotonic() - t0
+        t_done = time.monotonic()
+        compute_s = t_done - t0
+        if not self._backend_spans:
+            # Batch granularity: without backend phase timing every tile
+            # in the batch shares the dispatch->materialize interval.
+            for w in workloads:
+                self.spans.record(obs_names.SPAN_COMPUTE, w.key, t0, t_done)
         self.counters.inc(obs_names.WORKER_TILES_COMPUTED, len(workloads))
         self.counters.inc(obs_names.WORKER_COMPUTE_US, int(compute_s * 1e6))
         self.registry.observe(obs_names.HIST_WORKER_COMPUTE_SECONDS,
@@ -152,7 +178,7 @@ class Worker:
         pipe = PipelineExecutor(self.client, as_dispatcher(self.backend),
                                 window=self.window, depth=self.depth,
                                 batch_size=self.batch_size,
-                                counters=self.counters)
+                                counters=self.counters, spans=self.spans)
         self.pipeline = pipe
         return pipe.run(poll_interval=poll_interval, stop=stop)
 

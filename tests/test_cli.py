@@ -155,6 +155,38 @@ def test_viewer_prompt_mode(tmp_path, monkeypatch):
         cli.main(["viewer"])
 
 
+def test_trace_command_empty_farm(tmp_path, capsys):
+    """`dmtpu trace` against a coordinator with no workers dumps an
+    empty-but-valid Chrome trace (coordinator metadata only) and exits
+    0 — both to a file and to stdout."""
+    import json
+
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    from distributedmandelbrot_tpu.core.workload import parse_level_settings
+
+    out = tmp_path / "trace.json"
+    with EmbeddedCoordinator(str(tmp_path / "data"),
+                             parse_level_settings("1:12")) as co:
+        rc = cli.main(["trace", "--port", str(co.exporter_port),
+                       "--out", str(out)])
+        assert rc == 0
+        assert "wrote" in capsys.readouterr().out
+        rc = cli.main(["trace", "--port", str(co.exporter_port)])
+        assert rc == 0
+        stdout_doc = json.loads(capsys.readouterr().out)
+    doc = json.loads(out.read_text())
+    assert doc == stdout_doc
+    assert isinstance(doc["traceEvents"], list)
+    # No workers ran: only metadata rows, every one well-formed.
+    assert doc["traceEvents"], "coordinator metadata rows expected"
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] == "M"
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+    # A dead port is a loud SystemExit, not a traceback.
+    with pytest.raises(SystemExit, match="cannot fetch"):
+        cli.main(["trace", "--port", "1", "--timeout", "0.5"])
+
+
 def test_worker_backend_validation():
     with pytest.raises(SystemExit):
         cli.main(["worker", "--backend", "pallas", "--dtype", "f64"])

@@ -1,0 +1,364 @@
+"""Distributed-tracing tests: NTP-style clock alignment, the worker span
+recorder, the coordinator span store merge, critical-path attribution,
+Chrome trace rendering, and the 0x04 wire push end-to-end (including the
+legacy-coordinator degradation path)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from distributedmandelbrot_tpu.core import LevelSetting
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.chrome import render_chrome_trace
+from distributedmandelbrot_tpu.obs.spans import (ClockOffsetEstimator, Span,
+                                                 SpanRecorder, SpanStore,
+                                                 critical_path)
+from distributedmandelbrot_tpu.obs.trace import TraceLog
+from distributedmandelbrot_tpu.worker import (DistributerClient, NumpyBackend,
+                                              Worker)
+
+from harness import CoordinatorHarness
+
+
+# -- clock-offset estimation ------------------------------------------------
+
+def test_offset_estimator_skewed_clocks_asymmetric_rtt():
+    """Two virtual clocks with a known skew and an asymmetric round trip:
+    the NTP midpoint lands within the advertised error bound even though
+    the uplink/downlink split is 10x lopsided."""
+    true_offset = 123.456  # coordinator clock - worker clock
+    uplink, downlink = 0.02, 0.18
+
+    est = ClockOffsetEstimator()
+    t_req = 5.0  # worker clock at request send
+    c_grant = t_req + true_offset + uplink  # coordinator stamps the grant
+    t_recv = t_req + uplink + downlink
+    est.add_sample(c_grant, t_req, t_recv)
+
+    got = est.estimate
+    assert got is not None
+    assert got.error == pytest.approx((uplink + downlink) / 2)
+    assert abs(got.offset - true_offset) <= got.error
+    # The bound is tight here: the midpoint is off by exactly the
+    # asymmetry, (downlink - uplink) / 2.
+    assert abs(got.offset - true_offset) == pytest.approx(
+        (downlink - uplink) / 2)
+
+
+def test_offset_estimator_prefers_min_rtt_sample():
+    true_offset = -42.0
+    est = ClockOffsetEstimator()
+    est.add_sample(10.0 + true_offset + 0.1, 10.0, 10.4)  # rtt 0.4
+    loose = est.estimate
+    assert loose.error == pytest.approx(0.2)
+    # A later, tighter (symmetric) round trip takes over...
+    est.add_sample(20.0 + true_offset + 0.005, 20.0, 20.01)
+    tight = est.estimate
+    assert tight.error == pytest.approx(0.005)
+    assert tight.offset == pytest.approx(true_offset)
+    # ...and a subsequent looser one does not regress the estimate.
+    est.add_sample(30.0 + true_offset + 0.5, 30.0, 31.0)
+    assert est.estimate == tight
+    assert est.samples == 3
+    # A clock-stepped (t_recv < t_req) sample is ignored outright.
+    est.add_sample(40.0, 40.0, 39.0)
+    assert est.samples == 3
+
+
+# -- the worker-side recorder ----------------------------------------------
+
+def test_recorder_grant_record_drain():
+    rec = SpanRecorder(worker_id=7)
+    keys = [(4, 0, 0), (4, 0, 1)]
+    rec.note_grant(keys, 1.0, 1.2)
+    rec.record(obs_names.SPAN_COMPUTE, keys[0], 1.3, 2.0)
+    syncs, spans = rec.drain()
+    # One sync sample per lease exchange (first key stands for it).
+    assert len(syncs) == 1
+    assert syncs[0].key == keys[0]
+    assert (syncs[0].t_req, syncs[0].t_recv) == (1.0, 1.2)
+    # A prefetch span per granted key + the recorded compute span, all
+    # carrying the exchange's lease sequence.
+    stages = sorted(s.stage for s in spans)
+    assert stages == [obs_names.SPAN_COMPUTE, obs_names.SPAN_PREFETCH,
+                      obs_names.SPAN_PREFETCH]
+    assert {s.seq for s in spans} == {1}
+    # drain() cleared everything.
+    assert rec.drain() == ([], [])
+
+
+def test_recorder_bounded_and_disableable():
+    rec = SpanRecorder(capacity=2)
+    for i in range(5):
+        rec.record(obs_names.SPAN_COMPUTE, (1, 0, i), 0.0, 1.0)
+    assert len(rec) == 2
+    assert rec.dropped == 3
+    rec.enabled = False
+    rec.record(obs_names.SPAN_COMPUTE, (1, 0, 9), 0.0, 1.0)
+    rec.note_grant([(1, 0, 9)], 0.0, 1.0)
+    _, spans = rec.drain()
+    assert all(s.key != (1, 0, 9) for s in spans)
+
+
+# -- the coordinator-side store --------------------------------------------
+
+def test_store_aligns_spans_at_read_time():
+    """Coordinator base clock ~1000, worker base clock ~5: after one sync
+    sample the worker's compute span lands inside the coordinator's
+    granted->received interval, within the estimate's error bound — and
+    a later, tighter sample retroactively improves the placement."""
+    wid = 99
+    store = SpanStore()
+    key = (3, 1, 2)
+    store.note_grant(key, 1000.2)
+    assert store.grant_time(key) == 1000.2
+
+    span = Span(obs_names.SPAN_COMPUTE, key, 5.3, 6.0, device=0, seq=1)
+    assert store.ingest(wid, [span]) == 1
+    # No sync sample yet: the span cannot be placed.
+    assert store.unaligned == 1
+    assert store.spans() == []
+
+    # Worker sent the lease request at 5.0 (its clock), got the grant at
+    # 5.2; the coordinator stamped it at 1000.2.  True offset is ~995.2
+    # (grant stamped near t_recv), estimate 995.1 +/- 0.1.
+    store.add_sync(wid, 1000.2, 5.0, 5.2)
+    assert store.unaligned == 0
+    [aligned] = store.spans()
+    est = store.offset(wid)
+    assert est.error == pytest.approx(0.1)
+    assert aligned["t0"] == pytest.approx(5.3 + est.offset)
+    assert aligned["align_error_s"] == pytest.approx(est.error)
+    # Placement error is within the bound of the coordinator interval.
+    assert aligned["t0"] >= 1000.2 - est.error
+    # Durations never needed alignment.
+    assert aligned["t1"] - aligned["t0"] == pytest.approx(0.7)
+
+    # A tighter sample arriving LATER re-places the already-ingested
+    # span (alignment happens at read time): the new offset is exactly
+    # 995.0 +/- 0.01, moving t0 from ~1000.4 to 1000.3.
+    store.add_sync(wid, 1000.7, 5.69, 5.71)
+    [better] = store.spans()
+    assert better["align_error_s"] == pytest.approx(0.01)
+    assert better["t0"] == pytest.approx(5.3 + 995.0)
+
+    # Per-tile stage seconds are offset-free.
+    assert store.compute_seconds_by_key() == {key: pytest.approx(0.7)}
+
+
+def test_store_spans_sorted_and_per_worker_offsets():
+    store = SpanStore()
+    store.add_sync(1, 100.0, 0.0, 0.0)  # worker 1: offset exactly +100
+    store.add_sync(2, 500.0, 0.0, 0.0)  # worker 2: offset exactly +500
+    store.ingest(2, [Span(obs_names.SPAN_UPLOAD, (1, 0, 0), 1.0, 2.0)])
+    store.ingest(1, [Span(obs_names.SPAN_COMPUTE, (1, 0, 0), 3.0, 4.0)])
+    out = store.spans()
+    assert [s["t0"] for s in out] == [103.0, 501.0]  # merged order, not
+    assert [s["worker"] for s in out] == [1, 2]      # ingest order
+
+
+# -- worker_skew busy-source fix -------------------------------------------
+
+def _ticking_clock():
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    return clock
+
+
+def test_worker_skew_busy_source_labels():
+    """busy_s derives from worker-reported compute spans when present
+    (labeled "reported"); the grant->receive fallback — which also
+    contains network + upload time — is labeled "lease", a mix "mixed"."""
+    log = TraceLog(clock=_ticking_clock())
+    keys = [(4, 0, 0), (4, 0, 1)]
+    for key in keys:
+        log.record("granted", key, worker="w:1")
+        log.record("result_received", key, worker="w:1")
+    skew = log.worker_skew()
+    w1 = skew["workers"]["w:1"]
+    assert w1["busy_source"] == "lease"
+    assert w1["busy_s"] == pytest.approx(2.0)  # two 1 s lease intervals
+
+    reported = {keys[0]: 0.25, keys[1]: 0.5}
+    w1 = log.worker_skew(reported=reported)["workers"]["w:1"]
+    assert w1["busy_source"] == "reported"
+    assert w1["busy_s"] == pytest.approx(0.75)
+
+    w1 = log.worker_skew(reported={keys[0]: 0.25})["workers"]["w:1"]
+    assert w1["busy_source"] == "mixed"
+    assert w1["busy_s"] == pytest.approx(1.25)
+
+
+# -- critical-path attribution ---------------------------------------------
+
+def test_critical_path_splits_blob_with_reported_stages():
+    store = SpanStore()
+    key_a, key_b = (5, 0, 0), (5, 0, 1)
+    store.ingest(1, [
+        Span(obs_names.SPAN_COMPUTE, key_a, 0.0, 0.7),  # includes d2h
+        Span(obs_names.SPAN_D2H, key_a, 0.5, 0.7),
+        Span(obs_names.SPAN_UPLOAD, key_a, 0.7, 0.8),
+    ])
+    trace_spans = [
+        {"key": key_a, "complete": True, "total_s": 2.0, "queue_s": 0.5,
+         "compute_s": 1.0, "persist_s": 0.3},
+        {"key": key_b, "complete": True, "total_s": 1.0, "queue_s": 0.1,
+         "compute_s": 0.6, "persist_s": 0.2},
+        {"key": (5, 1, 1), "complete": False},  # ignored
+    ]
+    out = critical_path(trace_spans, store)
+    assert out["tiles"] == 2
+    assert out["attributed_tiles"] == 1
+    # key_a splits: compute 0.5 (0.7 - d2h 0.2), d2h 0.2, upload 0.1,
+    # other 0.2 (the 1.0 s blob's remainder).  key_b has no spans: its
+    # whole 0.6 s blob falls to compute (lease fallback).
+    assert out["compute_s"] == pytest.approx(1.1)
+    assert out["d2h_s"] == pytest.approx(0.2)
+    assert out["upload_s"] == pytest.approx(0.1)
+    assert out["other_s"] == pytest.approx(0.2)
+    assert out["queue_s"] == pytest.approx(0.6)
+    assert out["persist_s"] == pytest.approx(0.5)
+    assert out["total_s"] == pytest.approx(3.0)
+    assert out["queue_share"] == pytest.approx(0.2)
+    # No store at all: everything still attributes (to the fallback).
+    bare = critical_path(trace_spans, None)
+    assert bare["attributed_tiles"] == 0
+    assert bare["compute_s"] == pytest.approx(1.6)
+
+
+# -- Chrome trace rendering -------------------------------------------------
+
+def _assert_valid_trace_events(doc):
+    assert isinstance(doc["traceEvents"], list)
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "M", "i"), ev
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert ev["ts"] >= 0 and ev["dur"] >= 0, ev
+        if ev["ph"] == "M":
+            assert ev["name"] in ("process_name", "thread_name")
+
+
+def test_chrome_render_empty_and_merged():
+    empty = render_chrome_trace(None, None)
+    _assert_valid_trace_events(empty)
+    assert empty["displayTimeUnit"] == "ms"
+    assert all(e["ph"] == "M" for e in empty["traceEvents"])
+
+    log = TraceLog(clock=_ticking_clock())
+    key = (2, 0, 0)
+    for name in ("scheduled", "granted", "result_received", "persisted",
+                 "served"):
+        log.record(name, key, worker="w:1")
+    store = SpanStore()
+    store.add_sync(7, 2.0, 0.0, 0.0)
+    store.ingest(7, [Span(obs_names.SPAN_COMPUTE, key, 0.5, 1.0, device=1)])
+    doc = render_chrome_trace(log, store)
+    _assert_valid_trace_events(doc)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"queue", "in_flight", "persist", "served",
+            obs_names.SPAN_COMPUTE} <= names
+    # The worker's process row exists and the compute slice nests on a
+    # device thread of it.
+    [proc] = [e for e in doc["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "process_name"
+              and e["pid"] >= 100]
+    assert proc["args"]["name"] == f"worker {7:016x}"
+    [compute] = [e for e in doc["traceEvents"]
+                 if e["name"] == obs_names.SPAN_COMPUTE]
+    assert compute["pid"] == proc["pid"] and compute["tid"] == 11
+
+
+# -- end-to-end over the wire ----------------------------------------------
+
+def _drain_in_threads(farm, n_workers, **worker_kwargs):
+    workers = [Worker(DistributerClient("127.0.0.1", farm.distributer_port),
+                      NumpyBackend(), **worker_kwargs)
+               for _ in range(n_workers)]
+    threads = [threading.Thread(target=w.run_until_drained, daemon=True)
+               for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads)
+    return workers
+
+
+def test_farm_drain_produces_loadable_nested_trace_json(tmp_path):
+    """Acceptance: a 2-worker drain yields /trace.json whose per-tile
+    worker compute/upload spans nest inside the coordinator's granted ->
+    result_received interval after clock alignment (within the
+    advertised error bound, plus the ack tail for upload ends)."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, 12)]) as farm:
+        workers = _drain_in_threads(farm, 2, batch_size=2)
+        farm.wait_saves_settled(expected_accepted=4)
+
+        pushed = sum(w.counters.get(obs_names.WORKER_SPANS_PUSHED)
+                     for w in workers)
+        assert pushed > 0
+        assert farm.counters.get(obs_names.COORD_SPANS_INGESTED) == pushed
+        assert all(w.counters.get(obs_names.WORKER_SPANS_UNSUPPORTED) == 0
+                   for w in workers)
+
+        url = f"http://127.0.0.1:{farm.exporter_port}/trace.json"
+        doc = json.loads(urllib.request.urlopen(url, timeout=10).read())
+    _assert_valid_trace_events(doc)
+    events = doc["traceEvents"]
+    in_flight = {e["args"]["key"]: e for e in events
+                 if e["name"] == "in_flight"}
+    assert len(in_flight) == 4
+    checked = 0
+    for name, end_slack_s in ((obs_names.SPAN_COMPUTE, 0.05),
+                              (obs_names.SPAN_UPLOAD, 1.0)):
+        for ev in (e for e in events if e["name"] == name):
+            blob = in_flight[ev["args"]["key"]]
+            tol_us = ev["args"]["align_error_s"] * 1e6 + 50_000
+            assert ev["ts"] >= blob["ts"] - tol_us, (name, ev, blob)
+            # Upload ends after the coordinator's ack reaches the
+            # worker, so its tail gets extra slack beyond clock error.
+            assert (ev["ts"] + ev["dur"]
+                    <= blob["ts"] + blob["dur"] + tol_us
+                    + end_slack_s * 1e6), (name, ev, blob)
+            checked += 1
+    # Every tile has a compute span and an upload span in view.
+    assert checked >= 8
+
+
+def test_legacy_coordinator_degrades_span_push(tmp_path):
+    """Against a coordinator that rejects 0x04 (accept_spans=False: the
+    unknown-purpose drop, exactly a pre-tracing build's behavior), the
+    worker completes the drain with span push disabled — one
+    worker_spans_unsupported bump, results all accepted, zero errors."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, 12)],
+                            accept_spans=False) as farm:
+        [worker] = _drain_in_threads(farm, 1, batch_size=4)
+        farm.wait_saves_settled(expected_accepted=4)
+        assert farm.scheduler.is_complete()
+    assert worker.counters.get(obs_names.WORKER_RESULTS_ACCEPTED) == 4
+    assert worker.counters.get(obs_names.WORKER_SPANS_UNSUPPORTED) == 1
+    assert worker.counters.get(obs_names.WORKER_SPANS_PUSHED) == 0
+    assert worker.counters.get(obs_names.WORKER_SPANS_DROPPED) > 0
+    assert worker.client.span_push_disabled
+    assert not worker.spans.enabled
+
+
+def test_exporter_varz_carries_span_store_and_farm_trace(tmp_path):
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, 12)]) as farm:
+        _drain_in_threads(farm, 1)
+        farm.wait_saves_settled(expected_accepted=1)
+        url = f"http://127.0.0.1:{farm.exporter_port}/varz"
+        out = json.loads(urllib.request.urlopen(url, timeout=10).read())
+    assert out["trace"]["span_store"]["workers"] == 1
+    assert out["trace"]["span_store"]["ingested"] > 0
+    ft = out["farm_trace"]
+    assert ft["tiles"] == 1 and ft["attributed_tiles"] == 1
+    # The skew summary upgraded to worker-reported busy time.
+    [w] = out["trace"]["worker_skew"]["workers"].values()
+    assert w["busy_source"] == "reported"

@@ -223,15 +223,19 @@ def check_live(url: str | None) -> None:
 
 # -- --names: instrumentation-site name audit ------------------------------
 
-# Methods that take a metric name as their first argument, on a Counters
-# facade or Registry receiver.
-_INSTRUMENT_METHODS = frozenset({
-    "inc", "get", "observe", "set_gauge", "timed",
-    "counter", "gauge", "histogram",
-})
-# Receiver spellings that identify a metrics object (so dict.get("key")
-# and friends don't trip the scan).
-_RECEIVER_HINTS = ("counter", "registry", "reg")
+# Method -> receiver spellings that identify the instrumented object.
+# Metric methods take the name on a Counters facade or Registry;
+# "record" is the SpanRecorder entry point (stage labels are names
+# too).  Gating hints per method keeps dict.get("key") and
+# span_dict.get("worker") from tripping the scan.
+_METRIC_RECEIVERS = ("counter", "registry", "reg")
+_INSTRUMENT_METHODS = {
+    "inc": _METRIC_RECEIVERS, "get": _METRIC_RECEIVERS,
+    "observe": _METRIC_RECEIVERS, "set_gauge": _METRIC_RECEIVERS,
+    "timed": _METRIC_RECEIVERS, "counter": _METRIC_RECEIVERS,
+    "gauge": _METRIC_RECEIVERS, "histogram": _METRIC_RECEIVERS,
+    "record": ("span",),
+}
 
 
 def _known_metric_names() -> set[str]:
@@ -271,7 +275,8 @@ def check_names() -> int:
                 recv = (node.func.value.attr
                         if isinstance(node.func.value, ast.Attribute)
                         else node.func.value.id).lower()
-                if not any(h in recv for h in _RECEIVER_HINTS):
+                hints = _INSTRUMENT_METHODS[node.func.attr]
+                if not any(h in recv for h in hints):
                     continue
                 if not (node.args and isinstance(node.args[0], ast.Constant)
                         and isinstance(node.args[0].value, str)):
