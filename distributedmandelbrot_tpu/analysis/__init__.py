@@ -1,11 +1,15 @@
 """Project-native static analysis (``dmtpu check``).
 
 Stdlib-``ast`` checkers for the farm's hand-enforced invariants: lock
-discipline in the threaded layers, async hygiene in the event-loop
-layers, wire-format parity between every speaker of the protocol, and
-purity/precision rules inside JAX-traced functions.  Importing this
-package never imports jax (or the modules under analysis) — the tier-1
-gate runs it in a bare subprocess in well under a second.
+discipline in the threaded layers (interprocedural since v2, over
+``analysis/callgraph.py``), async hygiene in the event-loop layers,
+wire-format parity between every speaker of the protocol, protocol
+conversation conformance (dispatch arms, frame sequences, exact-length
+reads), resource lifecycles (threads, sockets, queues, servers),
+instrumentation-name registration, and purity/precision rules inside
+JAX-traced functions.  Importing this package never imports jax (or the
+modules under analysis) — the tier-1 gate runs it in a bare subprocess
+inside a five-second budget.
 """
 
 from distributedmandelbrot_tpu.analysis.engine import (Finding, Project,
@@ -13,14 +17,18 @@ from distributedmandelbrot_tpu.analysis.engine import (Finding, Project,
                                                        SourceFile, all_rules,
                                                        check_project,
                                                        default_root,
+                                                       expand_rule_ids,
+                                                       fingerprints_at_ref,
                                                        load_baseline,
+                                                       project_at_ref,
                                                        render_json,
                                                        render_text, run_check,
                                                        save_baseline)
 
 __all__ = [
     "Finding", "Project", "Report", "Rule", "SourceFile",
-    "all_rules", "check_project", "default_root",
+    "all_rules", "check_project", "default_root", "expand_rule_ids",
+    "fingerprints_at_ref", "project_at_ref",
     "load_baseline", "save_baseline",
     "render_json", "render_text", "run_check",
 ]

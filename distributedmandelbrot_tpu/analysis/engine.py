@@ -157,13 +157,54 @@ def default_root() -> Path:
     return Path(__file__).resolve().parent.parent.parent
 
 
+def project_at_ref(root: Path | str, ref: str) -> Project:
+    """The package's file set as of a git ref, via ``git archive`` (one
+    subprocess, parsed in memory — the worktree is never touched).  The
+    whole tree is materialized, not just changed files, because the
+    cross-file rules (wire parity, proto frames, the call graph) need
+    the full old project to compute the old fingerprints faithfully."""
+    import io
+    import subprocess
+    import tarfile
+    try:
+        proc = subprocess.run(["git", "archive", ref, "--", PACKAGE],
+                              cwd=str(root), capture_output=True)
+    except OSError as e:
+        raise ValueError(f"cannot run git: {e}")
+    if proc.returncode != 0:
+        err = proc.stderr.decode("utf-8", "replace").strip()
+        raise ValueError(f"git archive {ref} failed: {err}")
+    sources: dict[str, str] = {}
+    with tarfile.open(fileobj=io.BytesIO(proc.stdout)) as tf:
+        for member in tf.getmembers():
+            if member.isfile() and member.name.endswith(".py"):
+                fobj = tf.extractfile(member)
+                if fobj is not None:
+                    sources[member.name] = fobj.read().decode(
+                        "utf-8", "replace")
+    return Project.from_sources(sources)
+
+
+def fingerprints_at_ref(root: Path | str, ref: str,
+                        rule_ids: Optional[Sequence[str]] = None
+                        ) -> set[str]:
+    """Fingerprints of every finding the given rules produce on the tree
+    as of ``ref`` — ``--diff`` treats these as an ephemeral baseline so
+    a check run only reports findings introduced since the ref."""
+    return {f.fingerprint()
+            for f in check_project(project_at_ref(root, ref), rule_ids)}
+
+
 # -- rule registry ---------------------------------------------------------
 
 def _rule_modules():
     # Imported lazily: rule modules import this module for Rule/Finding.
     from distributedmandelbrot_tpu.analysis import (rules_async, rules_jax,
-                                                    rules_locks, rules_wire)
-    return (rules_locks, rules_async, rules_wire, rules_jax)
+                                                    rules_locks, rules_obs,
+                                                    rules_proto, rules_res,
+                                                    rules_wire)
+    return (rules_locks, rules_async, rules_wire, rules_jax, rules_proto,
+            rules_res, rules_obs)
 
 
 def all_rules() -> dict[str, Rule]:
@@ -174,22 +215,41 @@ def all_rules() -> dict[str, Rule]:
     return rules
 
 
+def expand_rule_ids(rule_ids: Sequence[str]) -> list[str]:
+    """Resolve a mix of rule ids and family names (``--rules proto res``)
+    to concrete rule ids; raises ValueError on anything unknown."""
+    known = all_rules()
+    by_family: dict[str, list[str]] = {}
+    for rule in known.values():
+        by_family.setdefault(rule.family, []).append(rule.id)
+    expanded: list[str] = []
+    unknown: list[str] = []
+    for rid in rule_ids:
+        if rid in known:
+            expanded.append(rid)
+        elif rid in by_family:
+            expanded.extend(by_family[rid])
+        else:
+            unknown.append(rid)
+    if unknown:
+        raise ValueError(
+            f"unknown rule ids: {', '.join(sorted(set(unknown)))} "
+            f"(known ids: {', '.join(sorted(known))}; "
+            f"families: {', '.join(sorted(by_family))})")
+    return expanded
+
+
 def check_project(project: Project,
                   rule_ids: Optional[Sequence[str]] = None) -> list[Finding]:
     """Run every rule family; returns ALL findings (suppression and
-    baseline filtering is :func:`run_check`'s job)."""
-    known = all_rules()
-    if rule_ids:
-        unknown = sorted(set(rule_ids) - set(known))
-        if unknown:
-            raise ValueError(f"unknown rule ids: {', '.join(unknown)} "
-                             f"(known: {', '.join(sorted(known))})")
+    baseline filtering is :func:`run_check`'s job).  ``rule_ids`` may mix
+    rule ids and family names."""
     findings = [Finding(PARSE_ERROR.id, PARSE_ERROR.severity, rel, 1, msg)
                 for rel, msg in sorted(project.parse_failures.items())]
+    wanted = set(expand_rule_ids(rule_ids)) if rule_ids else None
     for mod in _rule_modules():
         findings.extend(mod.check(project))
-    if rule_ids:
-        wanted = set(rule_ids)
+    if wanted is not None:
         findings = [f for f in findings if f.rule in wanted]
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings

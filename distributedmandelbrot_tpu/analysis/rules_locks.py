@@ -9,20 +9,27 @@ based: the codebase's convention IS the spec, and the rule catches the
 one call site that forgets it.
 
 ``lock-order`` — a global lock-acquisition-order graph: acquiring lock B
-while holding lock A adds edge ``A -> B`` (lexical ``with`` nesting,
-plus one level of same-class call propagation: ``self.m()`` under A
-contributes edges from A to every lock ``m`` acquires directly).  Any
-cycle is a deadlock risk.  Nodes are ``ClassName.lockattr``, so an
-order inversion *across* classes is caught as long as both acquisitions
-are lexically visible.
+while holding lock A adds edge ``A -> B``.  Edges come from lexical
+``with`` nesting AND from the call graph: a call made under lock A
+contributes edges from A to every lock the callee acquires
+*transitively* (``analysis/callgraph.py``), so a cross-class inversion
+hidden behind two helper hops is still a cycle.  Any cycle is a
+deadlock risk.  Nodes are ``ClassName.lockattr``.
 
 ``lock-held-blocking`` — a call that can block indefinitely (queue
 ``get``/``put``, thread ``join``, semaphore ``acquire``, client
 request/submit network exchanges, ``time.sleep``, event ``wait``) made
-while a lock is held.  The stage-queue pipeline's discipline is that
-every blocking wait happens OUTSIDE the window lock — one queue ``get``
-under it and the whole executor convoys.  Calls on the held lock itself
-(``cond.wait`` / ``notify`` — which release it) are sanctioned.
+while a lock is held.  Since v2 the rule is interprocedural: a call
+site under a lock is also flagged when the *callee* — or anything the
+callee transitively reaches through resolvable calls — performs one of
+the blocking operations, with the call path named in the message.  A
+one-level wrapper no longer defeats the rule.  The stage-queue
+pipeline's discipline is that every blocking wait happens OUTSIDE the
+window lock — one queue ``get`` under it and the whole executor
+convoys.  Calls on the held lock itself (``cond.wait`` / ``notify`` —
+which release it) are sanctioned; unresolvable calls (callbacks,
+``getattr``) are not searched, which keeps the rule quiet rather than
+paranoid.
 
 Scope: coordinator/, storage/, serve/, obs/, worker/ — the modules
 where the asyncio loop and worker/pipeline threads genuinely share
@@ -35,6 +42,7 @@ import ast
 from collections import Counter as _TallyCounter
 from typing import Optional
 
+from distributedmandelbrot_tpu.analysis import callgraph
 from distributedmandelbrot_tpu.analysis.astutil import (FunctionNode,
                                                         call_chain,
                                                         class_defs,
@@ -105,10 +113,12 @@ class _ClassAnalysis:
         self.mutations: list[tuple[str, int, tuple[str, ...], str]] = []
         # lock -> lock lexical acquisition edges, with first line seen
         self.edges: dict[tuple[str, str], int] = {}
-        # locks each method acquires directly (for call propagation)
+        # locks each method acquires directly
         self.method_locks: dict[str, set[str]] = {}
-        # (held locks, same-class callee, line) — call made under a lock
-        self.calls_held: list[tuple[tuple[str, ...], str, int]] = []
+        # (held locks, call node) — every call made under a lock that was
+        # neither flagged directly nor sanctioned; the interprocedural
+        # pass resolves these through the call graph
+        self.calls_held: list[tuple[tuple[str, ...], ast.Call]] = []
         # (line, innermost lock, message) — blocking call under a lock
         self.blocking: list[tuple[int, str, str]] = []
         for meth in methods_of(cls):
@@ -184,12 +194,11 @@ class _ClassAnalysis:
                 msg = None if on_held_lock else _blocking_under_lock(chain)
                 if msg is not None:
                     self.blocking.append((node.lineno, held[-1], msg))
+                elif not on_held_lock:
+                    self.calls_held.append((held, node))
             if chain and chain[0] == "self" and len(chain) >= 3 \
                     and chain[-1] in MUTATORS:
                 self._record_mutation(chain[1], node.lineno, held, method)
-            elif chain and chain[0] == "self" and len(chain) == 2:
-                if held:
-                    self.calls_held.append((held, chain[1], node.lineno))
         elif isinstance(node, ast.Attribute) \
                 and isinstance(node.ctx, ast.Load) and held:
             attr = self_attr(node)
@@ -218,8 +227,102 @@ class _ClassAnalysis:
                 attr, _TallyCounter()).update([held[-1]])
 
 
+class _Summaries:
+    """Per-function facts the interprocedural pass propagates: blocking
+    operations a function performs directly, and locks it acquires
+    directly — computed for EVERY function in the package (a scope-dir
+    method may reach its blocking op through a helper anywhere)."""
+
+    def __init__(self, project: Project) -> None:
+        self.graph = callgraph.graph_for(project)
+        self.own_blocking: dict[str, list[tuple[int, str]]] = {}
+        self.own_locks: dict[str, set[str]] = {}
+        self._reach: dict[str, dict[str, tuple[str, ...]]] = {}
+        class_locks: dict[tuple[str, Optional[str]], set[str]] = {}
+        for qual, fi in self.graph.functions.items():
+            key = (fi.relpath, fi.cls)
+            if key not in class_locks:
+                info = self.graph.class_info(fi.relpath, fi.cls) \
+                    if fi.cls else None
+                class_locks[key] = _bare_with_attrs(info.node) \
+                    if info is not None else set()
+            locks = class_locks[key]
+            blocking: list[tuple[int, str]] = []
+            acquired: set[str] = set()
+            for node in _walk_own(fi.node):
+                if isinstance(node, ast.Call):
+                    chain = call_chain(node)
+                    msg = _blocking_under_lock(chain) if chain else None
+                    if msg is not None:
+                        blocking.append((node.lineno, msg))
+                elif isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        attr = self_attr(item.context_expr)
+                        if attr is not None and attr in locks:
+                            acquired.add(f"{fi.cls}.{attr}")
+            if blocking:
+                self.own_blocking[qual] = blocking
+            if acquired:
+                self.own_locks[qual] = acquired
+
+    def reach(self, qual: str) -> dict[str, tuple[str, ...]]:
+        if qual not in self._reach:
+            self._reach[qual] = self.graph.reachable(qual)
+        return self._reach[qual]
+
+    def blocking_via(self, callee: str
+                     ) -> Optional[tuple[tuple[str, ...], str]]:
+        """(call path ending at the blocking function, message) for the
+        nearest blocking operation reachable from ``callee``."""
+        if callee in self.own_blocking:
+            return (callee,), self.own_blocking[callee][0][1]
+        for qual, path in self.reach(callee).items():  # BFS order
+            if qual in self.own_blocking:
+                return path + (qual,), self.own_blocking[qual][0][1]
+        return None
+
+    def locks_via(self, callee: str) -> set[str]:
+        """Every ``Class.lock`` acquired by ``callee`` or anything it
+        transitively reaches."""
+        out = set(self.own_locks.get(callee, ()))
+        for qual in self.reach(callee):
+            out.update(self.own_locks.get(qual, ()))
+        return out
+
+
+def _walk_own(fn: FunctionNode):
+    """Walk a function body without descending into nested defs or
+    lambdas (their bodies run at some later call)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _bare_with_attrs(cls: ast.ClassDef) -> set[str]:
+    """Same lock-attr evidence as :meth:`_ClassAnalysis._find_lock_attrs`
+    but usable for classes outside the findings scope."""
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                attr = self_attr(item.context_expr)
+                if attr is not None:
+                    locks.add(attr)
+    return locks
+
+
+def _display(qual: str) -> str:
+    return qual.rsplit("::", 1)[-1]
+
+
 def check(project: Project) -> list[Finding]:
     findings: list[Finding] = []
+    summaries = _Summaries(project)
     # Global acquisition-order graph: node "Class.lock" -> successors,
     # with the (path, line) of the first edge for reporting.
     graph: dict[str, set[str]] = {}
@@ -239,14 +342,28 @@ def check(project: Project) -> list[Finding]:
                 a, b = f"{cls.name}.{outer}", f"{cls.name}.{inner}"
                 graph.setdefault(a, set()).add(b)
                 edge_site.setdefault((a, b), (sf.relpath, line))
-            for held, callee, line in info.calls_held:
-                for inner in info.method_locks.get(callee, ()):
+            # Interprocedural: resolve every call made under a held lock
+            # and search what it reaches for blocking ops + acquisitions.
+            for held, call in info.calls_held:
+                callee = summaries.graph.resolve_node(call)
+                if callee is None:
+                    continue
+                hit = summaries.blocking_via(callee)
+                if hit is not None:
+                    path, msg = hit
+                    findings.append(Finding(
+                        "lock-held-blocking", "error", sf.relpath,
+                        call.lineno,
+                        f"{msg} — reached via "
+                        f"{' -> '.join(_display(q) for q in path)}() "
+                        f"(holding {cls.name}.{held[-1]})"))
+                for b in summaries.locks_via(callee):
                     for outer in held:
                         a = f"{cls.name}.{outer}"
-                        b = f"{cls.name}.{inner}"
                         if a != b:
                             graph.setdefault(a, set()).add(b)
-                            edge_site.setdefault((a, b), (sf.relpath, line))
+                            edge_site.setdefault((a, b),
+                                                 (sf.relpath, call.lineno))
 
     findings.extend(_order_findings(graph, edge_site))
     return findings

@@ -1,0 +1,112 @@
+"""``obs-name`` rule: instrumentation literals must be registered names.
+
+The ``results_accepted`` collision (PR 2) happened because two call
+sites spelled the same metric differently and nothing arbitrated.
+``obs/names.py`` is the arbiter; this rule is its enforcement — every
+string literal passed to an instrumentation method
+(``counters.inc("...")``, ``registry.observe("...")``,
+``spans.record("...", ...)``) must be a constant registered there or a
+legacy alias spelling.
+
+This used to live in ``tools/check_metrics.py --names`` (a side tool
+the gate had to remember to run); folding it into ``dmtpu check``
+makes name drift a tier-1 failure.  The tool still delegates here so
+its ``--names`` flag keeps working.
+
+Like every rule in this package, the known-name set is extracted from
+the AST of ``obs/names.py`` — the module is never imported.  Projects
+without a names module (rule fixtures) produce no findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from distributedmandelbrot_tpu.analysis.astutil import attr_chain
+from distributedmandelbrot_tpu.analysis.engine import (Finding, Project,
+                                                       Rule, SourceFile)
+
+RULES = (
+    Rule("obs-name", "obs", "error",
+         "metric/span name literals at instrumentation sites must be "
+         "registered in obs/names.py"),
+)
+
+NAMES_SUFFIX = "obs/names.py"
+
+# Method -> receiver spellings that identify the instrumented object
+# (gating hints keep dict.get("key") from tripping the scan — same
+# tables check_metrics --names used).
+_METRIC_RECEIVERS = ("counter", "registry", "reg")
+INSTRUMENT_METHODS = {
+    "inc": _METRIC_RECEIVERS, "get": _METRIC_RECEIVERS,
+    "observe": _METRIC_RECEIVERS, "set_gauge": _METRIC_RECEIVERS,
+    "timed": _METRIC_RECEIVERS, "counter": _METRIC_RECEIVERS,
+    "gauge": _METRIC_RECEIVERS, "histogram": _METRIC_RECEIVERS,
+    "record": ("span",),
+}
+
+
+def known_names(project: Project) -> Optional[set[str]]:
+    """Registered names from the names module's AST: every uppercase
+    top-level string constant plus the LEGACY_ALIASES dict's legacy
+    spellings.  None when the project has no names module."""
+    for rel in sorted(project.files):
+        if rel.endswith(NAMES_SUFFIX):
+            break
+    else:
+        return None
+    known: set[str] = set()
+    for node in project.files[rel].tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            target, value = node.target.id, node.value
+        else:
+            continue
+        if target.isupper() and isinstance(value, ast.Constant) \
+                and isinstance(value.value, str):
+            known.add(value.value)
+        elif target == "LEGACY_ALIASES" and isinstance(value, ast.Dict):
+            for v in value.values:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    known.add(v.value)
+    return known
+
+
+def iter_sites(project: Project) -> Iterator[tuple[SourceFile, int, str]]:
+    """(file, line, literal) for every instrumentation site whose first
+    argument is a string literal."""
+    for rel in sorted(project.files):
+        sf = project.files[rel]
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in INSTRUMENT_METHODS):
+                continue
+            recv_chain = attr_chain(node.func.value)
+            if not recv_chain:
+                continue
+            recv = recv_chain[-1].lower()
+            if not any(h in recv for h in INSTRUMENT_METHODS[node.func.attr]):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            yield sf, node.args[0].lineno, node.args[0].value
+
+
+def check(project: Project) -> list[Finding]:
+    known = known_names(project)
+    if known is None:
+        return []
+    rule = RULES[0]
+    return [
+        Finding(rule.id, rule.severity, sf.relpath, line,
+                f"metric name {name!r} is not registered in obs/names.py")
+        for sf, line, name in iter_sites(project)
+        if name not in known]

@@ -1353,13 +1353,16 @@ def cmd_check(argv: Sequence[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="dmtpu check",
         description="Run the project-native static analysis suite "
-                    "(lock discipline, async hygiene, wire-format parity, "
-                    "JAX purity) over the package.  Exits 0 when clean, "
-                    "1 when there are unsuppressed findings.")
+                    "(lock discipline incl. interprocedural propagation, "
+                    "async hygiene, wire-format parity, protocol "
+                    "conformance, resource lifecycle, metric-name "
+                    "registration, JAX purity) over the package.  Exits 0 "
+                    "when clean, 1 when there are unsuppressed findings.")
     parser.add_argument("--json", action="store_true",
                         help="emit the versioned JSON report instead of text")
     parser.add_argument("--rules", nargs="+", metavar="RULE",
-                        help="run only these rule ids")
+                        help="run only these rule ids or families "
+                             "(e.g. --rules proto res obs-name)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalogue and exit")
     parser.add_argument("--root", default=None,
@@ -1371,6 +1374,11 @@ def cmd_check(argv: Sequence[str]) -> int:
     parser.add_argument("--update-baseline", action="store_true",
                         help="rewrite the baseline file to grandfather "
                              "every current finding, then exit 0")
+    parser.add_argument("--diff", metavar="GIT_REF", default=None,
+                        help="report only findings introduced since the "
+                             "given git ref (fingerprint-based; findings "
+                             "already present at the ref are treated as "
+                             "an ephemeral baseline) — fast pre-commit runs")
     args = parser.parse_args(argv)
 
     # Imported lazily so `dmtpu coordinator` & co. never pay for it; the
@@ -1402,7 +1410,18 @@ def cmd_check(argv: Sequence[str]) -> int:
             return 0
         baseline = (analysis.load_baseline(baseline_path)
                     if os.path.exists(baseline_path) else set())
-        report = analysis.run_check(project, args.rules, baseline)
+        ref_fps: set = set()
+        if args.diff:
+            ref_fps = analysis.fingerprints_at_ref(root, args.diff,
+                                                   args.rules)
+        report = analysis.run_check(project, args.rules,
+                                    baseline | ref_fps)
+        if ref_fps:
+            # Ephemeral entries that no longer match are expected churn
+            # (the point of --diff is that old findings went away or
+            # moved), not stale committed-baseline entries.
+            report.stale_baseline = [fp for fp in report.stale_baseline
+                                     if fp not in ref_fps]
     except ValueError as e:
         print(f"dmtpu check: {e}", file=sys.stderr)
         return 2

@@ -191,9 +191,13 @@ class PipelineExecutor:
         # stay comparable with the coordinator's monotonic timeline.
         self.spans = spans
 
-        self._dispatch_q: queue.Queue = queue.Queue()
-        self._mat_q: queue.Queue = queue.Queue()
-        self._upload_q: queue.Queue = queue.Queue()
+        # Stage queues are deliberately unbounded: total in-flight work
+        # is already capped at ``window`` by the _cond accounting below,
+        # so no queue can ever hold more than ``window`` items — a
+        # maxsize would add a second, redundant blocking point.
+        self._dispatch_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
+        self._mat_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
+        self._upload_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
         # _cond guards the window account and the error list; every
         # blocking queue/semaphore/client call happens OUTSIDE it.
         self._cond = threading.Condition()

@@ -26,10 +26,11 @@ def findings_for(sources: dict[str, str], rule: str) -> list:
 
 # -- catalogue -------------------------------------------------------------
 
-def test_rule_catalogue_covers_all_four_families():
+def test_rule_catalogue_covers_all_families():
     rules = all_rules()
     families = {r.family for r in rules.values()}
-    assert {"locks", "async", "wire", "jax", "engine"} <= families
+    assert {"locks", "async", "wire", "jax", "engine",
+            "proto", "res", "obs"} <= families
     for rule in rules.values():
         assert rule.severity in ("error", "warning")
         assert rule.doc
@@ -38,6 +39,16 @@ def test_rule_catalogue_covers_all_four_families():
 def test_unknown_rule_id_rejected():
     with pytest.raises(ValueError, match="unknown rule ids"):
         check_project(Project.from_sources({}), ["no-such-rule"])
+
+
+def test_rules_accept_family_names():
+    ids = analysis.expand_rule_ids(["proto", "res", "obs-name"])
+    assert {"proto-dispatch", "proto-frames", "proto-exact-read",
+            "res-thread-join", "obs-name"} <= set(ids)
+    # A family name selects its rules at check time too.
+    assert check_project(Project.from_sources({}), ["proto"]) == []
+    with pytest.raises(ValueError, match="families"):
+        analysis.expand_rule_ids(["no-such-family"])
 
 
 # -- locks -----------------------------------------------------------------
@@ -205,6 +216,95 @@ class W:
                         "lock-held-blocking") == []
     assert findings_for({f"{P}/core/pipe.py": LOCK_BLOCKING_CLASS},
                         "lock-held-blocking") == []
+
+
+# -- locks: interprocedural (v2) -------------------------------------------
+
+WRAPPED_BLOCKING = '''
+import queue
+import threading
+
+class Stage:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def _drain_one(self):
+        return self._q.get()
+
+    def bad(self):
+        with self._lock:
+            item = self._drain_one()
+        return item
+'''
+
+
+def test_lock_held_blocking_sees_through_helper():
+    # A one-level wrapper must not defeat the rule: bad() holds _lock
+    # while calling _drain_one(), whose body blocks on the queue.
+    found = findings_for({f"{P}/worker/stage.py": WRAPPED_BLOCKING},
+                         "lock-held-blocking")
+    assert len(found) == 1
+    f = found[0]
+    assert "reached via" in f.message and "_drain_one" in f.message
+    assert "Stage._lock" in f.message
+
+
+def test_lock_held_blocking_clean_when_helper_blocks_outside_lock():
+    src = WRAPPED_BLOCKING.replace(
+        "        with self._lock:\n            item = self._drain_one()",
+        "        item = self._drain_one()\n        with self._lock:\n"
+        "            self._seen = item")
+    assert findings_for({f"{P}/worker/stage.py": src},
+                        "lock-held-blocking") == []
+
+
+CROSS_CLASS_CYCLE = '''
+import threading
+
+class A:
+    def __init__(self, b: "B"):
+        self.b = b
+        self._la = threading.Lock()
+
+    def f(self):
+        with self._la:
+            self.b.g()
+
+    def grab(self):
+        with self._la:
+            pass
+
+class B:
+    def __init__(self, a: "A"):
+        self.a = a
+        self._lb = threading.Lock()
+
+    def g(self):
+        with self._lb:
+            pass
+
+    def h(self):
+        with self._lb:
+            self.a.grab()
+'''
+
+
+def test_lock_order_cycle_across_classes_via_call_graph():
+    # A.f: holds A._la, calls B.g which takes B._lb; B.h holds B._lb and
+    # calls A.grab which takes A._la.  Neither file nests two ``with``
+    # blocks lexically — only the call graph sees the cycle.
+    found = findings_for({f"{P}/storage/ab.py": CROSS_CLASS_CYCLE},
+                         "lock-order")
+    assert len(found) == 1
+    assert "A._la" in found[0].message and "B._lb" in found[0].message
+
+
+def test_lock_order_clean_when_cross_class_order_is_consistent():
+    src = CROSS_CLASS_CYCLE.replace(
+        "        with self._lb:\n            self.a.grab()",
+        "        self.a.grab()\n        with self._lb:\n            pass")
+    assert findings_for({f"{P}/storage/ab.py": src}, "lock-order") == []
 
 
 # -- async -----------------------------------------------------------------
@@ -485,6 +585,285 @@ def f(x):
     assert findings_for({f"{P}/ops/dt.py": src}, "jax-dtype") == []
 
 
+# -- proto -----------------------------------------------------------------
+
+PROTO_MOD = f"{P}/net/protocol.py"
+PROTO_SRC = '''
+import struct
+
+PURPOSE_REQUEST = 0x00
+
+QUERY = struct.Struct("<III")
+QUERY_WIRE_SIZE = QUERY.size
+QUERY_TAIL = struct.Struct("<II")
+QUERY_TAIL_WIRE_SIZE = QUERY_TAIL.size
+'''
+
+PROTO_CLIENT = f"{P}/worker/client.py"
+CLIENT_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (recv_u32, send_all,
+                                                   send_byte)
+
+class Client:
+    def request(self, sock, a, b, c):
+        send_byte(sock, proto.PURPOSE_REQUEST)
+        send_all(sock, proto.QUERY.pack(a, b, c))
+        return recv_u32(sock)
+'''
+
+PROTO_SERVER = f"{P}/coordinator/distributer.py"
+SERVER_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (recv_byte, recv_exact,
+                                                   send_u32)
+
+class Server:
+    def handle(self, sock):
+        purpose = recv_byte(sock)
+        if purpose == proto.PURPOSE_REQUEST:
+            raw = recv_exact(sock, proto.QUERY.size)
+            a, b, c = proto.QUERY.unpack(raw)
+            send_u32(sock, a)
+'''
+
+PROTO_SOURCES = {PROTO_MOD: PROTO_SRC, PROTO_CLIENT: CLIENT_SRC,
+                 PROTO_SERVER: SERVER_SRC}
+
+
+def test_proto_clean_on_matched_exchange():
+    for rule in ("proto-dispatch", "proto-frames", "proto-exact-read"):
+        assert findings_for(PROTO_SOURCES, rule) == []
+
+
+def test_proto_dispatch_fires_on_purpose_with_no_arm():
+    # The deliberately introduced dispatch gap: the server stops testing
+    # the purpose byte, so PURPOSE_REQUEST has no arm.
+    gap = dict(PROTO_SOURCES)
+    gap[PROTO_SERVER] = SERVER_SRC.replace(
+        "        if purpose == proto.PURPOSE_REQUEST:\n", "        if True:\n")
+    found = findings_for(gap, "proto-dispatch")
+    assert len(found) == 1
+    assert "PURPOSE_REQUEST has no server dispatch arm" in found[0].message
+    assert found[0].path == PROTO_MOD
+
+
+def test_proto_dispatch_fires_on_purpose_with_no_emitter():
+    gap = dict(PROTO_SOURCES)
+    gap[PROTO_CLIENT] = CLIENT_SRC.replace(
+        "        send_byte(sock, proto.PURPOSE_REQUEST)\n", "")
+    found = findings_for(gap, "proto-dispatch")
+    assert len(found) == 1
+    assert "no client emitter" in found[0].message
+
+
+def test_proto_frames_fires_on_struct_disagreement():
+    skewed = dict(PROTO_SOURCES)
+    skewed[PROTO_SERVER] = SERVER_SRC.replace(
+        "recv_exact(sock, proto.QUERY.size)",
+        "recv_exact(sock, proto.QUERY_TAIL.size)").replace(
+        "proto.QUERY.unpack(raw)", "proto.QUERY_TAIL.unpack(raw)")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "client sends [QUERY]" in found[0].message
+    assert "server reads [QUERY_TAIL]" in found[0].message
+
+
+def test_proto_frames_sees_through_helper_and_collapses_loops():
+    # The emitter delegates the frame writes to a helper and the server
+    # reads the struct in a loop — both must still compare clean.
+    spliced = dict(PROTO_SOURCES)
+    spliced[PROTO_CLIENT] = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (recv_u32, send_all,
+                                                   send_byte)
+
+class Client:
+    def _emit_query(self, sock, a, b, c):
+        send_all(sock, proto.QUERY.pack(a, b, c))
+
+    def request(self, sock, a, b, c):
+        send_byte(sock, proto.PURPOSE_REQUEST)
+        self._emit_query(sock, a, b, c)
+        return recv_u32(sock)
+'''
+    spliced[PROTO_SERVER] = SERVER_SRC.replace(
+        "            raw = recv_exact(sock, proto.QUERY.size)\n",
+        "            for _ in range(3):\n"
+        "                raw = recv_exact(sock, proto.QUERY.size)\n")
+    assert findings_for(spliced, "proto-frames") == []
+
+
+def test_proto_exact_read_fires_on_raw_recv():
+    raw = dict(PROTO_SOURCES)
+    raw[PROTO_SERVER] = SERVER_SRC.replace(
+        "recv_exact(sock, proto.QUERY.size)", "sock.recv(12)")
+    found = findings_for(raw, "proto-exact-read")
+    assert len(found) == 1
+    assert "raw .recv()" in found[0].message
+
+
+def test_proto_exact_read_fires_on_wrong_struct_size():
+    wrong = dict(PROTO_SOURCES)
+    wrong[PROTO_SERVER] = SERVER_SRC.replace(
+        "recv_exact(sock, proto.QUERY.size)",
+        "recv_exact(sock, proto.QUERY_TAIL.size)")
+    found = findings_for(wrong, "proto-exact-read")
+    assert len(found) == 1
+    assert "sized as QUERY_TAIL, not QUERY" in found[0].message
+
+
+def test_proto_silent_without_protocol_module():
+    # Fixture projects with no net/protocol.py are out of scope.
+    assert findings_for({PROTO_CLIENT: CLIENT_SRC}, "proto-dispatch") == []
+
+
+# -- res -------------------------------------------------------------------
+
+def test_res_thread_join_fires_on_unjoined_handleless_thread():
+    src = '''
+import threading
+
+class R:
+    def start(self):
+        t = threading.Thread(target=self._run)
+        t.start()
+        threading.Thread(target=self._pump).start()
+'''
+    found = findings_for({f"{P}/worker/r.py": src}, "res-thread-join")
+    assert len(found) == 2
+    assert any("no handle" in f.message for f in found)
+
+
+def test_res_thread_join_clean_on_daemon_join_and_list_join():
+    src = '''
+import threading
+
+class R:
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+        self._workers = [threading.Thread(target=self._pump)
+                         for _ in range(4)]
+
+    def stop(self):
+        for t in self._workers:
+            t.join()
+'''
+    assert findings_for({f"{P}/worker/r.py": src}, "res-thread-join") == []
+
+
+def test_res_socket_close_fires_and_clean_variants():
+    fire = '''
+import socket
+
+class C:
+    def connect(self, addr):
+        sock = socket.create_connection(addr)
+        sock.sendall(b"x")
+'''
+    clean = '''
+import socket
+
+class C:
+    def connect(self, addr):
+        self.sock = socket.create_connection(addr)
+
+    def probe(self, addr):
+        sock = socket.create_connection(addr)
+        try:
+            sock.sendall(b"x")
+        finally:
+            sock.close()
+'''
+    found = findings_for({f"{P}/net/c.py": fire}, "res-socket-close")
+    assert len(found) == 1
+    assert "never closed" in found[0].message
+    assert findings_for({f"{P}/net/c.py": clean}, "res-socket-close") == []
+
+
+def test_res_queue_unbounded_fires_only_without_maxsize():
+    src = '''
+import queue
+
+class Q:
+    def __init__(self):
+        self._work = queue.Queue()
+        self._done = queue.Queue(maxsize=8)
+'''
+    found = findings_for({f"{P}/worker/q.py": src}, "res-queue-unbounded")
+    assert len(found) == 1
+    assert found[0].severity == "warning"
+
+
+def test_res_shutdown_fires_without_stop_hook():
+    src = '''
+from concurrent.futures import ThreadPoolExecutor
+
+class S:
+    def __init__(self):
+        self.pool = ThreadPoolExecutor(max_workers=2)
+'''
+    found = findings_for({f"{P}/coordinator/s.py": src}, "res-shutdown")
+    assert len(found) == 1
+    assert "shutdown" in found[0].message
+    healed = src + '''
+    def close(self):
+        self.pool.shutdown(wait=False)
+'''
+    assert findings_for({f"{P}/coordinator/s.py": healed},
+                        "res-shutdown") == []
+
+
+def test_res_rules_skip_out_of_scope_dirs():
+    src = '''
+import queue
+
+class Q:
+    def __init__(self):
+        self._work = queue.Queue()
+'''
+    assert findings_for({f"{P}/core/q.py": src}, "res-queue-unbounded") == []
+
+
+# -- obs-name --------------------------------------------------------------
+
+NAMES_MOD = f"{P}/obs/names.py"
+NAMES_SRC = '''
+TILES_DONE = "tiles_done"
+
+LEGACY_ALIASES: dict[str, str] = {TILES_DONE: "tiles_complete"}
+'''
+
+
+def test_obs_name_fires_on_unregistered_literal():
+    src = '''
+class W:
+    def f(self):
+        self.counters.inc("tiles_done")
+        self.counters.inc("tiles_complete")
+        self.counters.inc("tils_done")
+        self.conf.get("not_a_metric")
+'''
+    found = findings_for({NAMES_MOD: NAMES_SRC, f"{P}/worker/w.py": src},
+                         "obs-name")
+    assert len(found) == 1
+    assert "'tils_done'" in found[0].message
+    # Without a names module there is no arbiter — stay silent.
+    assert findings_for({f"{P}/worker/w.py": src}, "obs-name") == []
+
+
+def test_obs_name_covers_span_recorder_sites():
+    src = '''
+class W:
+    def f(self):
+        self.spans.record("not_registered", 0, 1.0, 2.0)
+'''
+    found = findings_for({NAMES_MOD: NAMES_SRC, f"{P}/worker/w.py": src},
+                         "obs-name")
+    assert len(found) == 1
+
+
 # -- engine: suppressions, baseline, reporters -----------------------------
 
 def test_inline_suppression_same_line_and_line_above():
@@ -587,3 +966,64 @@ def test_cli_update_baseline_round_trip(tmp_path, capsys):
     doc = json.loads(out[out.index('{'):])
     assert doc["counts"]["baselined"] == 1
     assert doc["stale_baseline"] == []
+
+
+# -- CLI: --diff <git-ref> -------------------------------------------------
+
+def test_cli_diff_reports_only_findings_since_ref(tmp_path, capsys):
+    import shutil
+    import subprocess
+
+    if shutil.which("git") is None:
+        pytest.skip("git not available")
+    from distributedmandelbrot_tpu.cli import main
+
+    pkg = tmp_path / P / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "stateful.py").write_text(LOCK_CLASS)
+
+    def git(*argv):
+        subprocess.run(
+            ["git", "-C", str(tmp_path),
+             "-c", "user.email=ci@example.invalid", "-c", "user.name=ci",
+             *argv], check=True, capture_output=True)
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    baseline = tmp_path / "baseline.json"
+    # Without --diff the pre-existing finding is reported...
+    assert main(["check", "--root", str(tmp_path),
+                 "--baseline", str(baseline)]) == 1
+    # ...with --diff HEAD it is an ephemeral baseline entry, not stale.
+    assert main(["check", "--root", str(tmp_path),
+                 "--baseline", str(baseline), "--diff", "HEAD",
+                 "--json"]) == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out[out.index('{'):])
+    assert doc["counts"]["total"] == 0
+    assert doc["counts"]["baselined"] == 1
+    assert doc["stale_baseline"] == []
+
+    # A finding introduced after the ref is the only one reported.
+    (pkg / "fresh.py").write_text(LOCK_CLASS.replace("Cache", "Fresh"))
+    assert main(["check", "--root", str(tmp_path),
+                 "--baseline", str(baseline), "--diff", "HEAD",
+                 "--json"]) == 1
+    out = capsys.readouterr().out
+    doc = json.loads(out[out.index('{'):])
+    assert doc["counts"]["total"] == 1
+    assert doc["findings"][0]["path"].endswith("fresh.py")
+
+
+def test_cli_diff_bad_ref_exits_2(tmp_path, capsys):
+    import shutil
+
+    if shutil.which("git") is None:
+        pytest.skip("git not available")
+    from distributedmandelbrot_tpu.cli import main
+
+    (tmp_path / P).mkdir()
+    assert main(["check", "--root", str(tmp_path),
+                 "--diff", "no-such-ref"]) == 2

@@ -41,6 +41,28 @@ def test_repo_is_lint_clean_fast_and_jax_free():
     assert elapsed < 5.0, f"gate took {elapsed:.1f}s (budget 5s)"
 
 
+def test_v2_families_are_registered_and_listed():
+    # The catalogue (and thus --list-rules / --rules) must cover the v2
+    # families; family names must expand to their rule ids.
+    from distributedmandelbrot_tpu import analysis
+    families = {r.family for r in analysis.all_rules().values()}
+    assert {"proto", "res", "obs"} <= families
+    assert "obs-name" in analysis.all_rules()
+    expanded = analysis.expand_rule_ids(["proto", "res", "obs-name"])
+    assert {"proto-dispatch", "proto-frames", "proto-exact-read",
+            "res-thread-join", "res-socket-close", "res-queue-unbounded",
+            "res-shutdown", "obs-name"} <= set(expanded)
+
+
+def test_baseline_has_no_entries():
+    # The v2 rollout fixed or inline-suppressed every true positive; the
+    # committed baseline must stay empty so new findings always surface.
+    path = os.path.join(REPO, "tools", "lint_baseline.json")
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert doc["findings"] == []
+
+
 def test_metric_name_literals_are_registered():
     result = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "check_metrics.py"),

@@ -223,71 +223,27 @@ def check_live(url: str | None) -> None:
 
 # -- --names: instrumentation-site name audit ------------------------------
 
-# Method -> receiver spellings that identify the instrumented object.
-# Metric methods take the name on a Counters facade or Registry;
-# "record" is the SpanRecorder entry point (stage labels are names
-# too).  Gating hints per method keeps dict.get("key") and
-# span_dict.get("worker") from tripping the scan.
-_METRIC_RECEIVERS = ("counter", "registry", "reg")
-_INSTRUMENT_METHODS = {
-    "inc": _METRIC_RECEIVERS, "get": _METRIC_RECEIVERS,
-    "observe": _METRIC_RECEIVERS, "set_gauge": _METRIC_RECEIVERS,
-    "timed": _METRIC_RECEIVERS, "counter": _METRIC_RECEIVERS,
-    "gauge": _METRIC_RECEIVERS, "histogram": _METRIC_RECEIVERS,
-    "record": ("span",),
-}
-
-
-def _known_metric_names() -> set[str]:
-    from distributedmandelbrot_tpu.obs import names as obs_names
-    known = {v for k, v in vars(obs_names).items()
-             if k.isupper() and isinstance(v, str)}
-    known.update(obs_names.LEGACY_ALIASES.values())
-    return known
-
-
 def check_names() -> int:
     """Cross-check every metric-name string literal at an instrumentation
     site (``counters.inc("...")``, ``registry.observe("...")``, ...)
     against the canonical registry in obs/names.py.  A literal that is
     not a registered name is exactly how the results_accepted collision
-    happened — two spellings, no arbiter."""
-    import ast
-    known = _known_metric_names()
-    pkg = os.path.join(REPO, "distributedmandelbrot_tpu")
-    unknown: list[tuple[str, int, str]] = []
-    sites = 0
-    for dirpath, _, filenames in os.walk(pkg):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=path)
-            rel = os.path.relpath(path, REPO)
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)
-                        and node.func.attr in _INSTRUMENT_METHODS
-                        and isinstance(node.func.value, ast.Attribute
-                                       | ast.Name)):
-                    continue
-                recv = (node.func.value.attr
-                        if isinstance(node.func.value, ast.Attribute)
-                        else node.func.value.id).lower()
-                hints = _INSTRUMENT_METHODS[node.func.attr]
-                if not any(h in recv for h in hints):
-                    continue
-                if not (node.args and isinstance(node.args[0], ast.Constant)
-                        and isinstance(node.args[0].value, str)):
-                    continue
-                sites += 1
-                name = node.args[0].value
-                if name not in known:
-                    unknown.append((rel, node.args[0].lineno, name))
-    for rel, line, name in unknown:
-        print(f"{rel}:{line}: metric name {name!r} is not registered "
-              f"in obs/names.py", file=sys.stderr)
+    happened — two spellings, no arbiter.
+
+    The scan itself now lives in ``dmtpu check`` as the ``obs-name``
+    rule family; this flag delegates there so the two paths can never
+    disagree about what counts as an instrumentation site."""
+    from distributedmandelbrot_tpu import analysis
+    from distributedmandelbrot_tpu.analysis import rules_obs
+    project = analysis.Project.from_root(REPO)
+    known = rules_obs.known_names(project)
+    if known is None:
+        raise MetricsFormatError(
+            "obs/names.py not found — cannot audit metric names")
+    sites = sum(1 for _ in rules_obs.iter_sites(project))
+    unknown = rules_obs.check(project)
+    for f in unknown:
+        print(f"{f.path}:{f.line}: {f.message}", file=sys.stderr)
     if unknown:
         raise MetricsFormatError(
             f"{len(unknown)} unregistered metric-name literal(s)")
