@@ -6,8 +6,11 @@ discipline in the threaded layers (interprocedural since v2, over
 wire-format parity between every speaker of the protocol, protocol
 conversation conformance (dispatch arms, frame sequences, exact-length
 reads), resource lifecycles (threads, sockets, queues, servers),
-instrumentation-name registration, and purity/precision rules inside
-JAX-traced functions.  Importing this package never imports jax (or the
+instrumentation-name registration, purity/precision rules inside
+JAX-traced functions, and — since v3, over ``analysis/dataflow.py`` —
+taint tracking from network reads to allocation/index/loop/struct sinks
+(``taint-*``) plus exception-path resource-leak and silent-swallow
+checks (``exc-*``).  Importing this package never imports jax (or the
 modules under analysis) — the tier-1 gate runs it in a bare subprocess
 inside a five-second budget.
 """

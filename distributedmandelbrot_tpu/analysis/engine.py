@@ -199,12 +199,13 @@ def fingerprints_at_ref(root: Path | str, ref: str,
 
 def _rule_modules():
     # Imported lazily: rule modules import this module for Rule/Finding.
-    from distributedmandelbrot_tpu.analysis import (rules_async, rules_jax,
-                                                    rules_locks, rules_obs,
-                                                    rules_proto, rules_res,
+    from distributedmandelbrot_tpu.analysis import (rules_async, rules_exc,
+                                                    rules_jax, rules_locks,
+                                                    rules_obs, rules_proto,
+                                                    rules_res, rules_taint,
                                                     rules_wire)
     return (rules_locks, rules_async, rules_wire, rules_jax, rules_proto,
-            rules_res, rules_obs)
+            rules_res, rules_obs, rules_taint, rules_exc)
 
 
 def all_rules() -> dict[str, Rule]:

@@ -1356,13 +1356,20 @@ def cmd_check(argv: Sequence[str]) -> int:
                     "(lock discipline incl. interprocedural propagation, "
                     "async hygiene, wire-format parity, protocol "
                     "conformance, resource lifecycle, metric-name "
-                    "registration, JAX purity) over the package.  Exits 0 "
+                    "registration, JAX purity, wire-input taint tracking, "
+                    "exception-path leaks) over the package.  Exits 0 "
                     "when clean, 1 when there are unsuppressed findings.")
     parser.add_argument("--json", action="store_true",
                         help="emit the versioned JSON report instead of text")
     parser.add_argument("--rules", nargs="+", metavar="RULE",
-                        help="run only these rule ids or families "
-                             "(e.g. --rules proto res obs-name)")
+                        help="run only these rule ids or families; "
+                             "space- or comma-separated "
+                             "(e.g. --rules taint,exc or --rules proto res)")
+    parser.add_argument("--severity", choices=("error", "warn", "warning"),
+                        default=None,
+                        help="report only findings at or above this "
+                             "severity (error = errors only; warn/warning "
+                             "= everything, the default)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalogue and exit")
     parser.add_argument("--root", default=None,
@@ -1380,6 +1387,10 @@ def cmd_check(argv: Sequence[str]) -> int:
                              "already present at the ref are treated as "
                              "an ephemeral baseline) — fast pre-commit runs")
     args = parser.parse_args(argv)
+    if args.rules:
+        # --rules taint,exc and --rules taint exc are both accepted.
+        args.rules = [tok for arg in args.rules
+                      for tok in arg.split(",") if tok]
 
     # Imported lazily so `dmtpu coordinator` & co. never pay for it; the
     # analysis package itself never imports jax (gated by the tier-1 test).
@@ -1425,6 +1436,16 @@ def cmd_check(argv: Sequence[str]) -> int:
     except ValueError as e:
         print(f"dmtpu check: {e}", file=sys.stderr)
         return 2
+    except KeyError as e:
+        # Defensive: a fingerprint/file lookup on state that moved under
+        # us (e.g. files deleted since a --diff ref) must degrade to a
+        # diagnostic, not a traceback.
+        print(f"dmtpu check: internal lookup failed for {e!s}",
+              file=sys.stderr)
+        return 2
+    if args.severity == "error":
+        report.findings = [f for f in report.findings
+                           if f.severity == "error"]
     print(analysis.render_json(report) if args.json
           else analysis.render_text(report))
     return 0 if report.clean else 1

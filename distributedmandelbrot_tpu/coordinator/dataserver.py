@@ -19,6 +19,7 @@ from typing import Optional
 
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils.metrics import Counters
 
@@ -70,6 +71,11 @@ class DataServer:
                 await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
             pass
+        except framing.ProtocolError as e:
+            # Malformed or hostile frame (e.g. a truncated query): drop
+            # the connection, leave a trail, keep the accept loop alive.
+            self.counters.inc(obs_names.COORD_FRAMES_REJECTED)
+            logger.error("dropping %s: %s", peer, e)
         except Exception:
             logger.exception("error serving %s", peer)
         finally:
@@ -81,7 +87,7 @@ class DataServer:
 
     async def _serve_query(self, writer: asyncio.StreamWriter, level: int,
                            index_real: int, index_imag: int) -> None:
-        if level < 1 or index_real >= level or index_imag >= level:
+        if not proto.query_in_range(level, index_real, index_imag):
             framing.write_byte(writer, proto.QUERY_REJECT)
             self.counters.inc("queries_rejected")
             logger.info("rejected invalid query (%d,%d,%d)",

@@ -175,11 +175,17 @@ class Distributer:
                 else:
                     logger.error("unknown purpose byte %#x from %s",
                                  purpose, peer)
+                    self.counters.inc(obs_names.COORD_FRAMES_REJECTED)
                     break
                 await writer.drain()
         except (ConnectionError, TimeoutError, asyncio.TimeoutError,
                 asyncio.CancelledError):
             pass  # per-connection failures never take down the accept loop
+        except framing.ProtocolError as e:
+            # Malformed or hostile frame: drop the connection, leave a
+            # trail, keep the accept loop alive.
+            self.counters.inc(obs_names.COORD_FRAMES_REJECTED)
+            logger.error("dropping %s: %s", peer, e)
         except Exception:
             logger.exception("error serving %s", peer)
         finally:
@@ -236,10 +242,10 @@ class Distributer:
         hdr = await self._read(
             framing.read_exact(reader, proto.SPANS_HEADER_WIRE_SIZE))
         worker_id, n_sync, n_spans = proto.SPANS_HEADER.unpack(hdr)
-        if n_sync > MAX_SPANS or n_spans > MAX_SPANS:
-            logger.error("oversized span report from worker %016x "
-                         "(%d syncs, %d spans)", worker_id, n_sync, n_spans)
-            raise ConnectionError("span report exceeds MAX_SPANS")
+        n_sync = proto.validate_count(
+            n_sync, MAX_SPANS, f"sync count from worker {worker_id:016x}")
+        n_spans = proto.validate_count(
+            n_spans, MAX_SPANS, f"span count from worker {worker_id:016x}")
         sync_data = await self._read(framing.read_exact(
             reader, n_sync * proto.SPAN_SYNC_WIRE_SIZE))
         span_data = await self._read(framing.read_exact(
@@ -269,11 +275,13 @@ class Distributer:
 
     async def _handle_batch_response(self, reader: asyncio.StreamReader,
                                      writer: asyncio.StreamWriter) -> None:
-        # No cap here (unlike grants, which bound coordinator state): each
-        # submission is bounded sequential work, and truncating would
-        # desynchronize the stream mid-batch.  A lying count just ends in
-        # EOF, which the connection handler treats as a clean close.
-        count = await self._read(framing.read_u32(reader))
+        # An honest worker's batch came from acquire_batch, which never
+        # grants more than MAX_BATCH — a larger count is a corrupt or
+        # hostile frame, and pretending to iterate it would pin this
+        # handler on a stream that can only end in EOF.
+        count = proto.validate_count(
+            await self._read(framing.read_u32(reader)), MAX_BATCH,
+            "batch-response count")
         for _ in range(count):
             await self._ingest_one(reader, writer)
 
@@ -293,13 +301,18 @@ class Distributer:
             self.counters.inc(obs_names.COORD_RESULTS_REJECTED)
             logger.info("rejected result for %s (stale or unknown lease)", w)
             return
-        framing.write_byte(writer, proto.RESPONSE_ACCEPT)
-        await writer.drain()
         try:
+            # The accept notification lives inside the claim's guarded
+            # region: a peer that vanishes between accept and payload
+            # must release the claim, not wait out its expiry.
+            framing.write_byte(writer, proto.RESPONSE_ACCEPT)
+            await writer.drain()
             data = await self._read(framing.read_exact(reader, CHUNK_PIXELS))
-        except (ConnectionError, TimeoutError, asyncio.TimeoutError):
-            # read_exact maps short reads to ConnectionError; a stalled
-            # upload raises TimeoutError.  Either way the payload never
+        except (ConnectionError, TimeoutError, asyncio.TimeoutError,
+                framing.ProtocolError):
+            # read_exact raises ConnectionError on a clean close,
+            # ProtocolError on a truncated payload; a stalled upload
+            # raises TimeoutError.  Either way the payload never
             # arrived: make the tile grantable again now rather than
             # waiting out the claim's expiry.
             self.scheduler.release_claim(w, token)

@@ -58,11 +58,21 @@ def send_byte(sock: socket.socket, value: int) -> None:
 # -- asyncio (coordinator servers) ----------------------------------------
 
 async def read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
+    """Read exactly ``n`` bytes.
+
+    EOF before the first byte is a clean close (``ConnectionError`` —
+    clients hang up between frames all the time); EOF after a partial
+    frame is a protocol violation (``ProtocolError``), so servers can
+    count truncated frames separately from ordinary disconnects.
+    """
     try:
         return await reader.readexactly(n)
     except asyncio.IncompleteReadError as e:
+        if e.partial:
+            raise ProtocolError(
+                f"truncated frame: {len(e.partial)} of {n} bytes") from None
         raise ConnectionError(
-            f"connection closed after {len(e.partial)} of {n} bytes") from None
+            f"connection closed awaiting {n} bytes") from None
 
 
 async def read_u32(reader: asyncio.StreamReader) -> int:

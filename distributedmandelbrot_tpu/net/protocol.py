@@ -42,6 +42,7 @@ import struct
 # from one module; the format itself lives with the Workload dataclass.
 from distributedmandelbrot_tpu.core.workload import \
     WORKLOAD_WIRE_SIZE  # noqa: F401  (canonical re-export)
+from distributedmandelbrot_tpu.net.framing import ProtocolError
 
 # Distributer: connection purpose
 PURPOSE_REQUEST = 0x00
@@ -120,6 +121,53 @@ SPAN_STAGE_DISPATCH = 1
 SPAN_STAGE_COMPUTE = 2
 SPAN_STAGE_D2H = 3
 SPAN_STAGE_UPLOAD = 4
+
+# -- input validation ------------------------------------------------------
+#
+# The one sanctioned decode path for wire integers.  Every count, length,
+# or index a peer sends is attacker-controlled until it passes one of
+# these (the taint-* rules in analysis/ enforce exactly that): the
+# validators either return the value proven in-range or raise
+# ``framing.ProtocolError``, which every connection handler maps to
+# "drop this connection and bump a *_rejected counter".
+
+# Upper bound for a DataServer/gateway response payload length.  A codec
+# payload is at most the raw chunk (16 MiB) plus small codec framing;
+# double it for headroom.  Anything above is a corrupt or hostile frame,
+# not a big tile.
+MAX_PAYLOAD_BYTES = 2 * 16_777_216
+
+
+def validate_count(n: int, bound: int, what: str = "count") -> int:
+    """Bound-check a wire count/length field; raise on hostile values.
+
+    Returns ``n`` unchanged when ``0 <= n <= bound`` so call sites can
+    write ``n = proto.validate_count(raw, MAX, "batch count")`` and hand
+    downstream code a sanitized value.
+    """
+    if not 0 <= n <= bound:
+        raise ProtocolError(f"{what} {n} outside [0, {bound}]")
+    return n
+
+
+def validate_payload_length(n: int) -> int:
+    """Bound-check a response payload length before allocating for it."""
+    return validate_count(n, MAX_PAYLOAD_BYTES, "payload length")
+
+
+def query_in_range(level: int, index_real: int, index_imag: int) -> bool:
+    """Is ``(level, index_real, index_imag)`` a well-formed tile key?
+
+    A level-``n`` grid has ``n x n`` tiles, so indices live in
+    ``[0, level)``; level 0 does not exist, and ``GATEWAY_BATCH_MAGIC``
+    is reserved as the batch-framing sentinel, never a real level.
+    Unlike :func:`validate_count` this is a predicate: an out-of-range
+    query gets a ``QUERY_REJECT`` reply, not a dropped connection.
+    """
+    if level < 1 or level == GATEWAY_BATCH_MAGIC:
+        return False
+    return 0 <= index_real < level and 0 <= index_imag < level
+
 
 DEFAULT_DISTRIBUTER_PORT = 59010
 DEFAULT_DATASERVER_PORT = 59011

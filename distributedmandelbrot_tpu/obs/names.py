@@ -24,6 +24,9 @@ COORD_RESULTS_DROPPED = "coord_results_dropped"
 COORD_CHUNKS_SAVED = "chunks_saved"
 COORD_SAVE_ERRORS = "save_errors"
 COORD_PERSIST_US = "persist_us"  # microsecond sum (legacy bench field)
+# Malformed/hostile frame dropped the connection (net.protocol validators
+# raised ProtocolError, or the purpose byte was unknown).
+COORD_FRAMES_REJECTED = "coord_frames_rejected"
 
 # -- coordinator: scheduler lease churn -----------------------------------
 
@@ -126,6 +129,9 @@ GATEWAY_REJECTED = "gateway_rejected"
 GATEWAY_OVERLOADED = "gateway_overloaded"
 GATEWAY_UNAVAILABLE = "gateway_unavailable"
 GATEWAY_BATCHES = "gateway_batches"
+# Malformed/hostile frame dropped the connection (batch count outside
+# the validator's bounds, garbage framing).
+GATEWAY_FRAMES_REJECTED = "gateway_frames_rejected"
 HIST_GATEWAY_REQUEST_SECONDS = "gateway_request_seconds"
 TILE_CACHE_HITS = "tile_cache_hits"
 TILE_CACHE_MISSES = "tile_cache_misses"

@@ -168,6 +168,11 @@ class TileGateway:
                 await writer.drain()
         except (ConnectionError, asyncio.CancelledError):
             pass
+        except framing.ProtocolError as e:
+            # Malformed or hostile frame: drop the connection, leave a
+            # trail, keep the accept loop alive.
+            self.counters.inc(obs_names.GATEWAY_FRAMES_REJECTED)
+            logger.error("dropping %s: %s", peer, e)
         except Exception:
             logger.exception("error serving %s", peer)
         finally:
@@ -181,9 +186,11 @@ class TileGateway:
 
     async def _serve_batch(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
-        count = await self._read(framing.read_u32(reader))
-        if count == 0 or count > MAX_BATCH_QUERIES:
-            raise framing.ProtocolError(f"bad batch count {count}")
+        count = proto.validate_count(
+            await self._read(framing.read_u32(reader)), MAX_BATCH_QUERIES,
+            "batch count")
+        if count == 0:
+            raise framing.ProtocolError("empty batch")
         raw = await self._read(framing.read_exact(
             reader, count * proto.QUERY.size))
         queries = [proto.QUERY.unpack_from(raw, n * proto.QUERY.size)
@@ -231,8 +238,7 @@ class TileGateway:
             self, level: int, index_real: int,
             index_imag: int) -> tuple[int, Optional[bytes], str]:
         self.counters.inc("gateway_queries")
-        if level < 1 or level == proto.GATEWAY_BATCH_MAGIC \
-                or index_real >= level or index_imag >= level:
+        if not proto.query_in_range(level, index_real, index_imag):
             self.counters.inc("gateway_rejected")
             return proto.QUERY_REJECT, None, obs_names.OUTCOME_REJECTED
         # Tier-1 hits are answered before admission: they cost no I/O and

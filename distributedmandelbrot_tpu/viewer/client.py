@@ -93,7 +93,9 @@ class DataClient:
             return None, miss
         if status != proto.QUERY_ACCEPT:
             raise framing.ProtocolError(f"unknown query status {status:#x}")
-        length = framing.recv_u32(sock)
+        # The length word sizes an allocation: bound it before trusting it
+        # (a hostile/corrupt server must not pick our buffer sizes).
+        length = proto.validate_payload_length(framing.recv_u32(sock))
         payload = framing.recv_exact(sock, length)
         return Chunk.deserialize_data(payload), FetchStatus.OK
 

@@ -19,6 +19,7 @@ into one device dispatch; scalar backends just loop.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Optional, Protocol, Sequence
 
@@ -32,6 +33,8 @@ from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.metrics import Registry
 from distributedmandelbrot_tpu.ops import escape_time
 from distributedmandelbrot_tpu.ops import reference as ref_ops
+
+logger = logging.getLogger("dmtpu.worker.backends")
 
 
 class ComputeBackend(Protocol):
@@ -268,13 +271,17 @@ def auto_backend(definition: int = CHUNK_WIDTH,
             if pallas_available():
                 return PallasBackend(definition=definition)
         except Exception:
-            pass
+            # Fallback chain by design, but never a silent one: probe
+            # failures here decide which kernel a whole farm runs.
+            logger.debug("pallas probe failed; falling through",
+                         exc_info=True)
     if want is None or want == np.dtype(np.float64):
         try:
             from distributedmandelbrot_tpu import native as native_mod
             if native_mod.native_supported():
                 return NativeBackend(definition=definition)
         except Exception:
-            pass
+            logger.debug("native probe failed; falling through",
+                         exc_info=True)
     return JaxBackend(definition=definition,
                       dtype=np.float32 if want is None else dtype)

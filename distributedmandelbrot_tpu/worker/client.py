@@ -73,7 +73,10 @@ class DistributerClient:
             if status != proto.WORKLOAD_AVAILABLE:
                 raise framing.ProtocolError(
                     f"unexpected availability code {status:#x}")
-            n = framing.recv_u32(sock)
+            # The coordinator grants at most what we asked for; a larger
+            # count is a corrupt frame or an impostor, not a bonus.
+            n = proto.validate_count(framing.recv_u32(sock), max_count,
+                                     "grant count")
             return [Workload.from_wire(
                 framing.recv_exact(sock, WORKLOAD_WIRE_SIZE))
                 for _ in range(n)]

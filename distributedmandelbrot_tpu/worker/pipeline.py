@@ -352,7 +352,9 @@ class PipelineExecutor:
             try:
                 start()
             except Exception:
-                pass  # best-effort prefetch; materialize still copies
+                # Best-effort prefetch; materialize still copies.
+                logger.debug("copy_to_host_async prefetch failed",
+                             exc_info=True)
 
     def _materialize_loop(self) -> None:
         st = self._stats[obs_names.STAGE_MATERIALIZE]

@@ -1027,3 +1027,415 @@ def test_cli_diff_bad_ref_exits_2(tmp_path, capsys):
     (tmp_path / P).mkdir()
     assert main(["check", "--root", str(tmp_path),
                  "--diff", "no-such-ref"]) == 2
+
+
+# -- taint: wire input reaching dangerous sinks ----------------------------
+
+TAINT_FILE = f"{P}/coordinator/handler.py"
+
+TAINT_LOOP_FIRE = '''
+from distributedmandelbrot_tpu.net import framing
+
+
+def handle(sock):
+    n = framing.recv_u32(sock)
+    out = []
+    for _ in range(n):
+        out.append(framing.recv_byte(sock))
+    return out
+'''
+
+
+def test_taint_loop_fires_on_wire_range_bound():
+    found = findings_for({TAINT_FILE: TAINT_LOOP_FIRE}, "taint-loop")
+    assert len(found) == 1
+    assert found[0].severity == "error"
+    assert "range() bound" in found[0].message
+
+
+def test_taint_loop_clean_after_validate_call():
+    src = TAINT_LOOP_FIRE.replace(
+        "    n = framing.recv_u32(sock)",
+        "    n = validate_count(framing.recv_u32(sock), 4096)")
+    assert findings_for({TAINT_FILE: src}, "taint-loop") == []
+
+
+def test_taint_loop_clean_after_comparison_guard():
+    src = TAINT_LOOP_FIRE.replace(
+        "    out = []",
+        "    if n > 4096:\n        raise ValueError(n)\n    out = []")
+    assert findings_for({TAINT_FILE: src}, "taint-loop") == []
+
+
+def test_taint_loop_clean_after_min_clamp():
+    src = TAINT_LOOP_FIRE.replace(
+        "    out = []",
+        "    n = min(n, 4096)\n    out = []")
+    assert findings_for({TAINT_FILE: src}, "taint-loop") == []
+
+
+TAINT_ALLOC_FIRE = '''
+from distributedmandelbrot_tpu.net import framing
+
+
+def read_payload(sock):
+    length = framing.recv_u32(sock)
+    return framing.recv_exact(sock, length)
+'''
+
+
+def test_taint_alloc_fires_on_wire_sized_read():
+    found = findings_for({TAINT_FILE: TAINT_ALLOC_FIRE}, "taint-alloc")
+    assert len(found) == 1
+    assert "recv_exact" in found[0].message
+
+
+def test_taint_alloc_fires_on_bytearray():
+    src = TAINT_ALLOC_FIRE.replace(
+        "    return framing.recv_exact(sock, length)",
+        "    return bytearray(length)")
+    found = findings_for({TAINT_FILE: src}, "taint-alloc")
+    assert len(found) == 1
+    assert "bytearray" in found[0].message
+
+
+def test_taint_alloc_clean_after_payload_validator():
+    src = TAINT_ALLOC_FIRE.replace(
+        "    length = framing.recv_u32(sock)",
+        "    length = validate_payload_length(framing.recv_u32(sock))")
+    assert findings_for({TAINT_FILE: src}, "taint-alloc") == []
+
+
+TAINT_INDEX_FIRE = '''
+from distributedmandelbrot_tpu.net import framing
+
+
+def lookup(sock, table):
+    i = framing.recv_u32(sock)
+    return table[i]
+'''
+
+
+def test_taint_index_fires_on_wire_subscript():
+    found = findings_for({TAINT_FILE: TAINT_INDEX_FIRE}, "taint-index")
+    assert len(found) == 1
+    assert "container index" in found[0].message
+
+
+def test_taint_index_clean_after_len_guard():
+    src = TAINT_INDEX_FIRE.replace(
+        "    return table[i]",
+        "    if i >= len(table):\n        return None\n    return table[i]")
+    assert findings_for({TAINT_FILE: src}, "taint-index") == []
+
+
+TAINT_STRUCT_FIRE = '''
+import struct
+
+from distributedmandelbrot_tpu.net import framing
+
+
+def read_array(sock):
+    n = framing.recv_u32(sock)
+    data = framing.recv_exact(sock, 4)
+    return struct.unpack(f"<{n}I", data)
+'''
+
+
+def test_taint_struct_fires_on_wire_repeat_count():
+    found = findings_for({TAINT_FILE: TAINT_STRUCT_FIRE}, "taint-struct")
+    assert len(found) == 1
+    assert "format" in found[0].message
+
+
+def test_taint_struct_clean_with_constant_format():
+    src = TAINT_STRUCT_FIRE.replace('f"<{n}I"', '"<4I"')
+    assert findings_for({TAINT_FILE: src}, "taint-struct") == []
+
+
+# Through-helper flows: the call graph carries taint across functions in
+# both directions — a helper's tainted RETURN reaches the caller's sink,
+# and a tainted ARGUMENT reaches the helper's sink.
+
+TAINT_HELPER_RETURN = '''
+import struct
+
+
+class Handler:
+    async def _read_len(self, reader):
+        data = await reader.readexactly(4)
+        (n,) = struct.unpack("<I", data)
+        return n
+
+    async def handle(self, reader):
+        n = await self._read_len(reader)
+        for _ in range(n):
+            await reader.readexactly(16)
+'''
+
+
+def test_taint_flows_through_helper_return_via_callgraph():
+    found = findings_for({TAINT_FILE: TAINT_HELPER_RETURN}, "taint-loop")
+    assert len(found) == 1
+    assert "range() bound" in found[0].message
+
+
+TAINT_HELPER_PARAM = '''
+from distributedmandelbrot_tpu.net import framing
+
+
+class Handler:
+    def _alloc(self, n):
+        return bytearray(n)
+
+    def handle(self, sock):
+        n = framing.recv_u32(sock)
+        return self._alloc(n)
+'''
+
+
+def test_taint_flows_into_helper_param_via_callgraph():
+    found = findings_for({TAINT_FILE: TAINT_HELPER_PARAM}, "taint-alloc")
+    assert len(found) == 1
+    assert "_alloc" in found[0].message
+
+
+def test_taint_helper_param_clean_when_sanitized_before_call():
+    src = TAINT_HELPER_PARAM.replace(
+        "        return self._alloc(n)",
+        "        n = validate_count(n, 4096)\n        return self._alloc(n)")
+    assert findings_for({TAINT_FILE: src}, "taint-alloc") == []
+
+
+def test_taint_out_of_scope_dirs_are_ignored():
+    # storage/ only sees validated data; same source there is clean.
+    assert findings_for({f"{P}/storage/handler.py": TAINT_LOOP_FIRE},
+                        "taint-loop") == []
+
+
+# -- exc: exception-path leaks and silent swallows -------------------------
+
+EXC_FILE = f"{P}/coordinator/ingest.py"
+
+EXC_LEAK_FIRE = '''
+from distributedmandelbrot_tpu.net import framing
+
+
+class Ingest:
+    async def ingest(self, reader, writer, w):
+        token = self.scheduler.claim(w)
+        if token is None:
+            return
+        framing.write_byte(writer, 0x20)
+        await writer.drain()
+        try:
+            data = await framing.read_exact(reader, 16)
+        except ConnectionError:
+            self.scheduler.release_claim(w, token)
+            raise
+        self.scheduler.finish_claim(w, token)
+'''
+
+
+def test_exc_leak_fires_on_io_between_claim_and_try():
+    found = findings_for({EXC_FILE: EXC_LEAK_FIRE}, "exc-leak")
+    assert len(found) == 1
+    assert found[0].severity == "error"
+    assert "token" in found[0].message
+
+
+def test_exc_leak_clean_when_io_moved_inside_guarded_try():
+    src = EXC_LEAK_FIRE.replace(
+        "        framing.write_byte(writer, 0x20)\n"
+        "        await writer.drain()\n"
+        "        try:\n"
+        "            data = await framing.read_exact(reader, 16)",
+        "        try:\n"
+        "            framing.write_byte(writer, 0x20)\n"
+        "            await writer.drain()\n"
+        "            data = await framing.read_exact(reader, 16)")
+    assert findings_for({EXC_FILE: src}, "exc-leak") == []
+
+
+def test_exc_leak_clean_when_finally_releases():
+    src = '''
+class Ingest:
+    async def ingest(self, writer, w):
+        token = self.scheduler.claim(w)
+        try:
+            await writer.drain()
+        finally:
+            self.scheduler.release_claim(w, token)
+'''
+    assert findings_for({EXC_FILE: src}, "exc-leak") == []
+
+
+def test_exc_leak_socket_fires_on_io_before_close():
+    src = '''
+import socket
+
+
+def probe(host):
+    sock = socket.create_connection((host, 80))
+    sock.sendall(b"ping")
+    sock.close()
+'''
+    found = findings_for({EXC_FILE: src}, "exc-leak")
+    assert len(found) == 1
+    assert "socket" in found[0].message
+
+
+def test_exc_leak_socket_clean_when_returned_or_with():
+    # Returning transfers ownership (worker client's _connect shape);
+    # non-I/O setup calls in between are fine.
+    src = '''
+import socket
+
+
+def dial(host):
+    sock = socket.create_connection((host, 80))
+    sock.setsockopt(1, 2, 3)
+    return sock
+'''
+    assert findings_for({EXC_FILE: src}, "exc-leak") == []
+
+
+def test_exc_swallow_fires_on_silent_overbroad_handler():
+    src = '''
+def best_effort(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+'''
+    found = findings_for({EXC_FILE: src}, "exc-swallow")
+    assert len(found) == 1
+    assert found[0].severity == "warning"
+
+
+def test_exc_swallow_clean_when_logged_counted_or_narrow():
+    src = '''
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def logged(fn):
+    try:
+        fn()
+    except Exception:
+        logger.debug("probe failed", exc_info=True)
+
+
+def counted(fn, counters):
+    try:
+        fn()
+    except Exception:
+        counters.inc("probe_failures")
+
+
+def narrow(fn):
+    try:
+        fn()
+    except ValueError:
+        pass
+'''
+    assert findings_for({EXC_FILE: src}, "exc-swallow") == []
+
+
+def test_exc_swallow_clean_when_exception_bound_and_used():
+    # The embed.py shape: the handler stores the exception for a later
+    # re-raise — that is handling, not swallowing.
+    src = '''
+class Runner:
+    def run(self, fn):
+        try:
+            fn()
+        except BaseException as e:
+            self._error = e
+'''
+    assert findings_for({EXC_FILE: src}, "exc-swallow") == []
+
+
+# -- CLI: --severity and comma-separated --rules ---------------------------
+
+def test_cli_severity_filter(tmp_path, capsys):
+    from distributedmandelbrot_tpu.cli import main
+    pkg = tmp_path / P / "coordinator"
+    pkg.mkdir(parents=True)
+    # One error (taint-loop) + one warning (exc-swallow).
+    (pkg / "handler.py").write_text(
+        TAINT_LOOP_FIRE
+        + "\n\ndef quiet(fn):\n    try:\n        fn()\n"
+          "    except Exception:\n        pass\n")
+    baseline = tmp_path / "baseline.json"
+
+    assert main(["check", "--root", str(tmp_path), "--baseline",
+                 str(baseline), "--json"]) == 1
+    out = capsys.readouterr().out
+    doc = json.loads(out[out.index('{'):])
+    assert doc["counts"]["error"] == 1
+    assert doc["counts"]["warning"] == 1
+
+    assert main(["check", "--root", str(tmp_path), "--baseline",
+                 str(baseline), "--severity", "error", "--json"]) == 1
+    out = capsys.readouterr().out
+    doc = json.loads(out[out.index('{'):])
+    assert doc["counts"]["total"] == 1
+    assert doc["findings"][0]["rule"] == "taint-loop"
+
+
+def test_cli_rules_accepts_comma_separated_families(tmp_path, capsys):
+    from distributedmandelbrot_tpu.cli import main
+    pkg = tmp_path / P / "coordinator"
+    pkg.mkdir(parents=True)
+    (pkg / "handler.py").write_text(TAINT_LOOP_FIRE)
+    baseline = tmp_path / "baseline.json"
+
+    assert main(["check", "--root", str(tmp_path), "--baseline",
+                 str(baseline), "--rules", "taint,exc", "--json"]) == 1
+    out = capsys.readouterr().out
+    doc = json.loads(out[out.index('{'):])
+    assert {f["rule"] for f in doc["findings"]} == {"taint-loop"}
+    # Families outside the selection are filtered even if they'd fire.
+    assert main(["check", "--root", str(tmp_path), "--baseline",
+                 str(baseline), "--rules", "exc,res", "--json"]) == 0
+
+
+# -- CLI: --diff with a file deleted since the ref -------------------------
+
+def test_cli_diff_survives_deleted_file(tmp_path, capsys):
+    import shutil
+    import subprocess
+
+    if shutil.which("git") is None:
+        pytest.skip("git not available")
+    from distributedmandelbrot_tpu.cli import main
+
+    pkg = tmp_path / P / "serve"
+    pkg.mkdir(parents=True)
+    (pkg / "stateful.py").write_text(LOCK_CLASS)
+    (pkg / "doomed.py").write_text(LOCK_CLASS.replace("Cache", "Doomed"))
+
+    def git(*argv):
+        subprocess.run(
+            ["git", "-C", str(tmp_path),
+             "-c", "user.email=ci@example.invalid", "-c", "user.name=ci",
+             *argv], check=True, capture_output=True)
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    # Delete a file that had findings at the ref: its ref fingerprints
+    # match nothing now, and --diff must treat that as expected churn
+    # (rc 0, no stale entries, no crash), not a lookup error.
+    (pkg / "doomed.py").unlink()
+    baseline = tmp_path / "baseline.json"
+    assert main(["check", "--root", str(tmp_path),
+                 "--baseline", str(baseline), "--diff", "HEAD",
+                 "--json"]) == 0
+    out = capsys.readouterr().out
+    doc = json.loads(out[out.index('{'):])
+    assert doc["counts"]["total"] == 0
+    assert doc["stale_baseline"] == []

@@ -1,0 +1,214 @@
+"""Malformed-frame fuzzing of the three network surfaces.
+
+A deterministic corpus of hostile frames — truncated mid-frame,
+oversized counts, out-of-range tile keys, wrong payload lengths — is
+thrown at the Distributer, DataServer, and gateway of a live embedded
+coordinator.  Every case must end the same way: the offending
+connection is dropped, a named obs counter records the rejection, and
+the event loop keeps serving well-formed clients afterwards.  This is
+the runtime proof of the boundary the taint-* rules enforce
+statically: no peer-controlled integer reaches an allocation, loop, or
+index without passing ``net.protocol``'s validators.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+
+from distributedmandelbrot_tpu.core import LevelSetting
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import names as obs_names
+
+from harness import CoordinatorHarness
+
+MAX_ITER = 12
+U32 = struct.Struct("<I")
+
+
+def _dial(port: int) -> socket.socket:
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _recv_all(sock: socket.socket) -> bytes:
+    """Read until the server closes; proves the connection was dropped."""
+    chunks = []
+    try:
+        while True:
+            data = sock.recv(65536)
+            if not data:
+                break
+            chunks.append(data)
+    except (ConnectionError, socket.timeout, OSError):
+        pass
+    return b"".join(chunks)
+
+
+def _wait_counter(farm, name: str, minimum: int, timeout: float = 10.0) -> int:
+    """Rejections are counted when the handler unwinds, a beat after the
+    socket closes on our side — poll briefly instead of racing it."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = farm.counters.get(name)
+        if value >= minimum:
+            return value
+        time.sleep(0.005)
+    raise AssertionError(
+        f"counter {name} = {farm.counters.get(name)}, wanted >= {minimum}")
+
+
+def _assert_distributer_alive(farm) -> None:
+    """A well-formed request on a fresh connection still gets served."""
+    with _dial(farm.distributer_port) as sock:
+        sock.sendall(bytes([proto.PURPOSE_REQUEST]))
+        status = sock.recv(1)
+        assert status and status[0] in (proto.WORKLOAD_AVAILABLE,
+                                        proto.WORKLOAD_NOT_AVAILABLE)
+
+
+def _assert_dataserver_alive(farm) -> None:
+    with _dial(farm.dataserver_port) as sock:
+        sock.sendall(proto.QUERY.pack(1, 0, 0))
+        status = sock.recv(1)
+        assert status and status[0] in (proto.QUERY_ACCEPT,
+                                        proto.QUERY_NOT_AVAILABLE)
+
+
+def _assert_gateway_alive(farm) -> None:
+    # An out-of-range single query draws an immediate REJECT reply —
+    # the loop must be alive to write it (a valid missing-tile query
+    # would park in the on-demand wait instead).
+    with _dial(farm.gateway_port) as sock:
+        sock.sendall(proto.QUERY.pack(0, 0, 0))
+        status = sock.recv(1)
+        assert status and status[0] == proto.QUERY_REJECT
+
+
+def test_distributer_rejects_malformed_frames_and_stays_alive(tmp_path):
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        rejected = 0
+
+        # Unknown purpose byte: dropped + counted.
+        with _dial(farm.distributer_port) as sock:
+            sock.sendall(bytes([0x7F]))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Truncated workload echo: 8 of 16 bytes, then close.
+        with _dial(farm.distributer_port) as sock:
+            sock.sendall(bytes([proto.PURPOSE_RESPONSE]) + b"\x00" * 8)
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Oversized batch-response count: a u32 far past MAX_BATCH.
+        with _dial(farm.distributer_port) as sock:
+            sock.sendall(bytes([proto.PURPOSE_BATCH_RESPONSE])
+                         + U32.pack(0xFFFF_FFFE))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Oversized span-report header: sync count past MAX_SPANS.
+        with _dial(farm.distributer_port) as sock:
+            sock.sendall(bytes([proto.PURPOSE_SPANS])
+                         + proto.SPANS_HEADER.pack(1, 1 << 20, 0))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+
+def test_distributer_short_payload_releases_claim_and_counts(tmp_path):
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        # Lease the only tile, echo it, get ACCEPT, then send a wrong
+        # (short) payload and hang up mid-frame.
+        with _dial(farm.distributer_port) as sock:
+            sock.sendall(bytes([proto.PURPOSE_REQUEST]))
+            status = sock.recv(1)
+            assert status[0] == proto.WORKLOAD_AVAILABLE
+            wire = b""
+            while len(wire) < 16:
+                wire += sock.recv(16 - len(wire))
+            sock.sendall(bytes([proto.PURPOSE_RESPONSE]) + wire)
+            accept = sock.recv(1)
+            assert accept[0] == proto.RESPONSE_ACCEPT
+            sock.sendall(b"\x00" * 100)  # 100 of 16,777,216 bytes
+        _wait_counter(farm, obs_names.COORD_RESULTS_DROPPED, 1)
+        _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED, 1)
+        # The claim was released, not leaked: the tile is grantable
+        # again right now, without waiting out the lease.
+        deadline = time.monotonic() + 10
+        regranted = False
+        while time.monotonic() < deadline and not regranted:
+            with _dial(farm.distributer_port) as sock:
+                sock.sendall(bytes([proto.PURPOSE_REQUEST]))
+                status = sock.recv(1)
+                regranted = status[0] == proto.WORKLOAD_AVAILABLE
+        assert regranted, "dropped tile never returned to the frontier"
+
+
+def test_dataserver_rejects_malformed_queries_and_stays_alive(tmp_path):
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        # Out-of-range tile keys: a REJECT reply + counter, per query.
+        for key in ((0, 0, 0), (1, 1, 0), (1, 0, 1),
+                    (proto.GATEWAY_BATCH_MAGIC, 0, 0)):
+            with _dial(farm.dataserver_port) as sock:
+                sock.sendall(proto.QUERY.pack(*key))
+                status = sock.recv(1)
+                assert status[0] == proto.QUERY_REJECT
+        _wait_counter(farm, obs_names.DATASERVER_QUERIES_REJECTED, 4)
+
+        # Truncated query: 6 of 12 bytes, then close.
+        with _dial(farm.dataserver_port) as sock:
+            sock.sendall(proto.QUERY.pack(1, 0, 0)[:6])
+        _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED, 1)
+        _assert_dataserver_alive(farm)
+
+
+def test_gateway_rejects_malformed_frames_and_stays_alive(tmp_path):
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        rejected = 0
+
+        # Out-of-range single query: REJECT reply + counter.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(proto.QUERY.pack(0, 3, 3))
+            status = sock.recv(1)
+            assert status[0] == proto.QUERY_REJECT
+        assert _wait_counter(farm, obs_names.GATEWAY_REJECTED, 1) >= 1
+
+        # Oversized batch count: dropped + counted.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_BATCH_MAGIC)
+                         + U32.pack(1 << 20))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_gateway_alive(farm)
+
+        # Empty batch: also a protocol violation (the magic promised
+        # queries).
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_BATCH_MAGIC) + U32.pack(0))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_gateway_alive(farm)
+
+        # Truncated query tail: the first u32 arrived, the 8-byte tail
+        # stops after 4.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(2) + b"\x00" * 4)
+        rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_gateway_alive(farm)

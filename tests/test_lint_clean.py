@@ -54,6 +54,19 @@ def test_v2_families_are_registered_and_listed():
             "res-shutdown", "obs-name"} <= set(expanded)
 
 
+def test_v3_taint_and_exc_families_are_registered():
+    # The dataflow-backed families ride in the same gate: the repo stays
+    # clean with them on, and family names expand for --rules taint,exc.
+    from distributedmandelbrot_tpu import analysis
+    families = {r.family for r in analysis.all_rules().values()}
+    assert {"taint", "exc"} <= families
+    expanded = analysis.expand_rule_ids(["taint", "exc"])
+    assert {"taint-alloc", "taint-index", "taint-loop", "taint-struct",
+            "exc-leak", "exc-swallow"} <= set(expanded)
+    for rule in analysis.all_rules().values():
+        assert rule.severity in ("error", "warning")
+
+
 def test_baseline_has_no_entries():
     # The v2 rollout fixed or inline-suppressed every true positive; the
     # committed baseline must stay empty so new findings always surface.

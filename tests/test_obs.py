@@ -164,6 +164,22 @@ def test_counters_legacy_alias_reads_sum_canonical():
     assert c.get(obs_names.WORKER_RESULTS_ACCEPTED) == 2
 
 
+def test_frame_rejection_counters_are_registered_names():
+    # The fuzz suite (test_fuzz_frames.py) asserts these increment on
+    # hostile frames; the --names audit (tools/check_metrics.py --names,
+    # the obs-name rule) must know them or the handlers would flag.
+    import os
+
+    from distributedmandelbrot_tpu.analysis import Project
+    from distributedmandelbrot_tpu.analysis import rules_obs
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    known = rules_obs.known_names(Project.from_root(repo))
+    assert obs_names.COORD_FRAMES_REJECTED in known
+    assert obs_names.GATEWAY_FRAMES_REJECTED in known
+    assert obs_names.COORD_FRAMES_REJECTED == "coord_frames_rejected"
+    assert obs_names.GATEWAY_FRAMES_REJECTED == "gateway_frames_rejected"
+
+
 def test_counters_share_registry():
     reg = Registry()
     a, b = Counters(registry=reg), Counters(registry=reg)
