@@ -1252,6 +1252,97 @@ def bench_serve(repeats: int, *, levels: str = "2:256",
             **hist}
 
 
+def bench_recovery(repeats: int, *, levels: str = "64:100",
+                   checkpoint_fraction: float = 0.8,
+                   hold_back: int = 64) -> dict:
+    """Crash-recovery shape (no accelerator): how fast a coordinator gets
+    back to granting after a restart, and what the durability checkpoint
+    buys over a full index replay.
+
+    Builds an index of NEVER entries (16 bytes each — pure index
+    traffic, no chunk blobs), writes a checkpoint at
+    ``checkpoint_fraction`` of the grid, lands the rest as a
+    post-checkpoint suffix, then measures:
+
+    - full index replay (no checkpoint) entries/s,
+    - checkpointed restore (decode + suffix-only replay) entries/s,
+    - restart-to-first-grant: EmbeddedCoordinator construction + start
+      + one client.request() round trip on the recovered data dir
+      (``hold_back`` tiles are left incomplete so a grant exists).
+    """
+    import tempfile
+
+    from distributedmandelbrot_tpu.cli import parse_level_settings
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    from distributedmandelbrot_tpu.coordinator.recovery import (
+        RecoveryManager, load_restore_state)
+    from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
+    from distributedmandelbrot_tpu.core.chunk import Chunk
+    from distributedmandelbrot_tpu.storage.store import ChunkStore
+    from distributedmandelbrot_tpu.worker import DistributerClient
+
+    settings = parse_level_settings(levels)
+    grid = [(s.level, i, j) for s in settings
+            for i in range(s.level) for j in range(s.level)]
+    n_total = len(grid) - hold_back
+    n_ckpt = int(n_total * checkpoint_fraction)
+
+    out: dict = {"config": "recovery", "levels": levels,
+                 "index_entries": n_total, "checkpoint_entries": n_ckpt,
+                 "suffix_entries": n_total - n_ckpt}
+    with tempfile.TemporaryDirectory() as tmp:
+        store = ChunkStore(tmp)
+        store.setup()
+        for level, i, j in grid[:n_ckpt]:
+            store.save(Chunk.never(level, i, j))
+        # Index offset at "checkpoint time" — entries past it are the
+        # suffix a checkpointed restore replays.
+        ckpt_offset = store.index_offset()
+        for level, i, j in grid[n_ckpt:n_total]:
+            store.save(Chunk.never(level, i, j))
+
+        def median_restore_s() -> float:
+            times = []
+            for _ in range(max(repeats, 2)):
+                t0 = time.perf_counter()
+                load_restore_state(store, settings)
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            return times[len(times) // 2]
+
+        # Full replay baseline: no checkpoint exists yet, so restore
+        # scans every entry.
+        full = median_restore_s()
+        out["full_replay_s"] = full
+        out["full_replay_entries_per_s"] = n_total / full if full else 0.0
+
+        # Checkpoint as if taken mid-run: the scheduler knows the first
+        # n_ckpt tiles and the index offset recorded when they landed
+        # (build() pairs offset and snapshot the same way live).
+        completed = {k for k in grid[:n_ckpt]}
+        sched = TileScheduler(settings, completed=completed)
+        mgr = RecoveryManager(store, sched, generation=1)
+        ckpt = mgr.build()
+        ckpt.index_offset = ckpt_offset
+        mgr.write(ckpt)
+        suffix = median_restore_s()
+        restored = load_restore_state(store, settings)
+        out["suffix_replay_s"] = suffix
+        out["suffix_replayed_entries"] = restored.replayed_entries
+        out["suffix_replay_entries_per_s"] = \
+            restored.replayed_entries / suffix if suffix else 0.0
+        out["restore_used_checkpoint"] = restored.checkpoint is not None
+
+        # Restart-to-first-grant on the recovered data dir.
+        t0 = time.perf_counter()
+        with EmbeddedCoordinator(tmp, settings, gateway=False,
+                                 exporter=False) as co:
+            w = DistributerClient("127.0.0.1", co.distributer_port).request()
+            out["restart_to_first_grant_s"] = time.perf_counter() - t0
+            out["first_grant_available"] = w is not None
+    return out
+
+
 def _ensure_live_backend(probe_timeout: float = 120.0) -> bool:
     """Guard against a dead accelerator tunnel: on this rig the TPU is
     reached through a network tunnel whose failure mode is jax backend
@@ -1314,7 +1405,17 @@ def main() -> int:
                              "(parabolic bond point; value = the default "
                              "auto-probed path, with exact-scan and "
                              "forced-BLA reference legs)")
+    parser.add_argument("--recovery", action="store_true",
+                        help="run only the crash-recovery config "
+                             "(restart-to-first-grant latency, full vs "
+                             "checkpoint+suffix index replay throughput; "
+                             "no accelerator needed)")
     args = parser.parse_args()
+    if args.recovery:
+        # Pure coordinator/storage path — skip the accelerator probe
+        # entirely so this leg runs anywhere (CI, laptops, dead tunnels).
+        print(json.dumps(bench_recovery(args.repeats)), flush=True)
+        return 0
     fell_back = _ensure_live_backend()
 
     def emit(result: dict) -> None:

@@ -503,6 +503,10 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
     parser.add_argument("--no-read-timeout", action="store_true",
                         help="disable socket read deadlines "
                              "(reference: -t false)")
+    parser.add_argument("--checkpoint-period", type=float, default=0.0,
+                        help="write a durability checkpoint every N seconds "
+                             "(0 disables; restart then replays the full "
+                             "index instead of a suffix)")
     parser.add_argument("--stats-period", type=float, default=60.0,
                         help="seconds between progress/throughput log "
                              "lines (0 disables)")
@@ -539,6 +543,7 @@ def cmd_coordinator(argv: Sequence[str]) -> int:
             lease_timeout=args.lease_timeout, sweep_period=args.sweep_period,
             read_timeout=None if args.no_read_timeout else args.read_timeout,
             fsync_index=args.fsync_index, stats_period=args.stats_period,
+            checkpoint_period=args.checkpoint_period,
             exporter_port=(None if args.exporter_port < 0
                            else args.exporter_port))
     except (DataDirError, LevelOwnedError) as e:
@@ -582,6 +587,9 @@ def cmd_serve(argv: Sequence[str]) -> int:
                         default=proto.DEFAULT_READ_TIMEOUT)
     parser.add_argument("--no-read-timeout", action="store_true")
     parser.add_argument("--stats-period", type=float, default=60.0)
+    parser.add_argument("--checkpoint-period", type=float, default=0.0,
+                        help="write a durability checkpoint every N seconds "
+                             "(0 disables)")
     parser.add_argument("--cache-tiles", type=int, default=256,
                         help="decoded-tile LRU capacity, in tiles")
     parser.add_argument("--max-queue-depth", type=int, default=1024,
@@ -618,6 +626,7 @@ def cmd_serve(argv: Sequence[str]) -> int:
             lease_timeout=args.lease_timeout, sweep_period=args.sweep_period,
             read_timeout=None if args.no_read_timeout else args.read_timeout,
             fsync_index=args.fsync_index, stats_period=args.stats_period,
+            checkpoint_period=args.checkpoint_period,
             gateway_port=args.gateway_port,
             gateway_cache_tiles=args.cache_tiles,
             gateway_max_queue_depth=args.max_queue_depth,
@@ -704,6 +713,11 @@ def cmd_worker(argv: Sequence[str]) -> int:
     parser.add_argument("--depth", type=int, default=2,
                         help="pipelined executor: kernels in flight per "
                              "device (default: 2 — double-buffered)")
+    parser.add_argument("--reconnect", type=int, default=0, metavar="N",
+                        help="redial the coordinator up to N times per "
+                             "exchange on connection failure (capped "
+                             "exponential backoff + jitter; 0 = fail fast). "
+                             "Lets a farm ride out a coordinator restart.")
     parser.add_argument("--kernel", choices=["auto", "xla", "pallas"],
                         default="auto",
                         help="compute kernel for the mesh backend")
@@ -788,7 +802,9 @@ def cmd_worker(argv: Sequence[str]) -> int:
             window = 2 * args.depth * max(1, len(backend.devices()))
         else:
             window = 0
-    worker = Worker(DistributerClient(args.host, args.port), backend,
+    worker = Worker(DistributerClient(args.host, args.port,
+                                      reconnect_attempts=args.reconnect),
+                    backend,
                     batch_size=batch_size, window=window, depth=args.depth)
     profiling = False
     if args.profile:
@@ -1349,6 +1365,35 @@ def cmd_trace(argv: Sequence[str]) -> int:
     return 0
 
 
+def cmd_admin(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu admin",
+        description="Administrative actions against a running "
+                    "coordinator's metrics exporter.")
+    parser.add_argument("action", choices=["checkpoint"],
+                        help="checkpoint: write a durability checkpoint "
+                             "now (POST /checkpoint) and print its stats")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int,
+                        default=proto.DEFAULT_EXPORTER_PORT)
+    parser.add_argument("--timeout", type=float, default=30.0,
+                        help="HTTP timeout in seconds (checkpoint writes "
+                             "are fsync'd; allow for slow disks)")
+    args = parser.parse_args(argv)
+
+    import json
+    import urllib.request
+    url = f"http://{args.host}:{args.port}/checkpoint"
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+            stats = json.loads(resp.read().decode("utf-8"))
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"dmtpu admin checkpoint: cannot POST {url}: {e}")
+    print(json.dumps(stats, indent=1, sort_keys=True), flush=True)
+    return 0
+
+
 def cmd_check(argv: Sequence[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="dmtpu check",
@@ -1465,7 +1510,8 @@ _NO_FILE = _NoFile()
 COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
             "serve": cmd_serve, "viewer": cmd_viewer, "render": cmd_render,
             "animate": cmd_animate, "compact": cmd_compact,
-            "stats": cmd_stats, "trace": cmd_trace, "check": cmd_check}
+            "stats": cmd_stats, "trace": cmd_trace, "admin": cmd_admin,
+            "check": cmd_check}
 
 
 def _enable_compile_cache() -> None:
@@ -1523,7 +1569,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
               "{coordinator|worker|serve|viewer|render|animate|compact|"
-              "stats|trace|check} [options]\n"
+              "stats|trace|admin|check} [options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

@@ -4,7 +4,10 @@ Mirrors the reference's process topology — a single process running the
 Distributer and DataServer concurrently over shared storage
 (``Program.cs:127-150``) — as one asyncio loop instead of two blocking
 threads.  Resume happens here: completed tiles are seeded from the on-disk
-index before the distributer starts (``Distributer.cs:124,165-175``).
+index before the distributer starts (``Distributer.cs:124,165-175``) —
+via the durability checkpoint when one exists (suffix-only index replay
+plus lease/frontier restore, coordinator/recovery.py), full index replay
+otherwise.
 """
 
 from __future__ import annotations
@@ -16,6 +19,8 @@ from typing import Optional, Sequence
 from distributedmandelbrot_tpu.coordinator.clock import Clock
 from distributedmandelbrot_tpu.coordinator.dataserver import DataServer
 from distributedmandelbrot_tpu.coordinator.distributer import Distributer
+from distributedmandelbrot_tpu.coordinator.recovery import (RecoveryManager,
+                                                            load_restore_state)
 from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
 from distributedmandelbrot_tpu.core.workload import LevelSetting
 from distributedmandelbrot_tpu.net import protocol as proto
@@ -53,7 +58,8 @@ class Coordinator:
                  gateway_burst: float = 256.0,
                  ondemand_deadline: float = proto.DEFAULT_ONDEMAND_DEADLINE,
                  exporter_port: Optional[int] = None,
-                 accept_spans: bool = True) \
+                 accept_spans: bool = True,
+                 checkpoint_period: float = 0.0) \
             -> None:
         # One registry + one trace ring + one span store feed every layer
         # of this process; the exporter (opt-in like the gateway:
@@ -71,19 +77,27 @@ class Coordinator:
         self._level_claims = LevelClaims(
             self.store.data_dir, [s.level for s in level_settings])
         try:
-            completed = self.store.completed_keys(
-                levels=[s.level for s in level_settings])
-            if completed:
+            # Checkpoint-aware resume: the completed set comes from the
+            # last checkpoint plus a replay of only the index entries past
+            # its recorded offset; with no (usable) checkpoint this is the
+            # classic full index replay.
+            restore = load_restore_state(self.store, level_settings,
+                                         registry=self.registry)
+            if restore.completed:
                 logger.info("resume: %d tiles already completed on disk",
-                            len(completed))
+                            len(restore.completed))
             self.counters = Counters(registry=self.registry)
             kwargs = {} if clock is None else {"clock": clock}
             self.scheduler = TileScheduler(level_settings,
-                                           completed=completed,
+                                           completed=restore.completed,
                                            lease_timeout=lease_timeout,
                                            registry=self.registry,
                                            trace=self.trace,
                                            **kwargs)
+            # Adopt the checkpointed frontier cursor, retry queue, and
+            # leases (with remaining TTLs) so in-flight workers from
+            # before a restart can land their results against live leases.
+            restore.apply(self.scheduler, registry=self.registry)
             # Live scheduler gauges, read at scrape time (plain ints under
             # the GIL — no locking needed for a monitoring read).
             self.registry.gauge(obs_names.GAUGE_FRONTIER_DEPTH,
@@ -126,12 +140,20 @@ class Coordinator:
                     max_queue_depth=gateway_max_queue_depth,
                     rate=gateway_rate, burst=gateway_burst,
                     counters=self.counters, trace=self.trace)
+            # Durability checkpoints: periodic when checkpoint_period > 0,
+            # on-demand always (POST /checkpoint, final write on stop).
+            self.recovery = RecoveryManager(
+                self.store, self.scheduler,
+                generation=restore.generation,
+                period=checkpoint_period, registry=self.registry,
+                pending_keys_fn=self.distributer.pending_save_keys)
             self.exporter: Optional[MetricsExporter] = None
             if exporter_port is not None:
                 self.exporter = MetricsExporter(
                     self.registry, trace=self.trace,
                     spans=self.spans,
                     varz_extra=self._varz_extra,
+                    checkpoint_cb=self.recovery.checkpoint,
                     host=host, port=exporter_port)
         except BaseException:
             # Construction failed after the claim: release it, or the
@@ -170,6 +192,7 @@ class Coordinator:
             finally:
                 self._level_claims.release()
             raise
+        await self.recovery.start()
         if self.stats_period > 0:
             self._stats_task = asyncio.create_task(self._stats_loop())
 
@@ -195,6 +218,10 @@ class Coordinator:
                 await self.gateway.stop()
             await self.distributer.stop()
             await self.dataserver.stop()
+            # Last: distributer.stop() gathered the in-flight save tasks,
+            # so the parting checkpoint records every durable tile and the
+            # next start replays a zero-length index suffix.
+            await self.recovery.stop()
         finally:
             # Claims must release even when a service stop raises.
             self._level_claims.release()
@@ -252,5 +279,9 @@ class Coordinator:
                 "outstanding_leases": self.scheduler.outstanding_leases,
                 "completed": self.scheduler.completed_count,
                 "total": self.scheduler.total_tiles,
+            },
+            "recovery": {
+                "generation": self.recovery.generation,
+                "checkpoint_period": self.recovery.period,
             },
         }

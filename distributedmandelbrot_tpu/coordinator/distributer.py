@@ -28,7 +28,8 @@ from typing import Optional
 
 import numpy as np
 
-from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
+from distributedmandelbrot_tpu.coordinator.scheduler import (Key,
+                                                             TileScheduler)
 from distributedmandelbrot_tpu.core.chunk import Chunk
 from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
 from distributedmandelbrot_tpu.core.workload import (WORKLOAD_WIRE_SIZE,
@@ -39,6 +40,7 @@ from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.spans import Span, SpanStore
 from distributedmandelbrot_tpu.obs.trace import TraceLog
 from distributedmandelbrot_tpu.storage.store import ChunkStore
+from distributedmandelbrot_tpu.utils import faults
 from distributedmandelbrot_tpu.utils.metrics import Counters
 
 logger = logging.getLogger("dmtpu.distributer")
@@ -100,6 +102,11 @@ class Distributer:
         self._server: Optional[asyncio.Server] = None
         self._sweep_task: Optional[asyncio.Task] = None
         self._save_tasks: set[asyncio.Task] = set()
+        # Tiles accepted in the scheduler whose asynchronous save has not
+        # landed yet.  The recovery manager excludes these from every
+        # checkpoint: completed-in-memory without a durable index entry
+        # must not be checkpointed as done (coordinator/recovery.py).
+        self._pending_saves: set[Key] = set()
 
     async def _read(self, coro):
         """Apply the configured read deadline (reference: the toggleable
@@ -333,9 +340,18 @@ class Distributer:
         self.trace.record("result_received", w.key, worker=_peer_id(writer))
         chunk = Chunk(w.level, w.index_real, w.index_imag,
                       np.frombuffer(data, dtype=np.uint8))
+        # Crashpoint: the tile is complete in the scheduler but its save
+        # task has not even been scheduled — the widest window where only
+        # the pending-save exclusion keeps a checkpoint honest.
+        faults.hit("coord.between_accept_and_persist")
+        self._pending_saves.add(w.key)
         task = asyncio.create_task(self._save_chunk(w, chunk))
         self._save_tasks.add(task)
         task.add_done_callback(self._save_tasks.discard)
+
+    def pending_save_keys(self) -> set[Key]:
+        """Keys whose persistence is in flight (checkpoint exclusion)."""
+        return set(self._pending_saves)
 
     async def _save_chunk(self, w: Workload, chunk: Chunk) -> None:
         try:
@@ -360,3 +376,7 @@ class Distributer:
                              chunk.key)
             self.counters.inc("save_errors")
             self.scheduler.reopen(w)
+        finally:
+            # Durable (or reopened) either way: checkpoints may include —
+            # or, on reopen, re-grant — this tile from now on.
+            self._pending_saves.discard(w.key)

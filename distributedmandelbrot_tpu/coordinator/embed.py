@@ -25,6 +25,7 @@ class EmbeddedCoordinator:
                  lease_timeout: float = 3600.0, sweep_period: float = 300.0,
                  read_timeout: float | None = _UNSET, clock=None,
                  gateway: bool = True, exporter: bool = True,
+                 checkpoint_period: float = 0.0,
                  **gateway_kwargs) -> None:
         self._ready = threading.Event()
         self._stop: asyncio.Event | None = None
@@ -33,7 +34,8 @@ class EmbeddedCoordinator:
         self._kwargs = dict(data_dir_parent=data_dir_parent,
                             host="127.0.0.1", distributer_port=0,
                             dataserver_port=0, lease_timeout=lease_timeout,
-                            sweep_period=sweep_period, clock=clock)
+                            sweep_period=sweep_period, clock=clock,
+                            checkpoint_period=checkpoint_period)
         # The embedded form serves tests and benches, so the gateway is on
         # by default (ephemeral port).  gateway_kwargs passes the admission
         # knobs straight through (gateway_max_queue_depth, gateway_rate,

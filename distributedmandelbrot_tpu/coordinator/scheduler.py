@@ -27,7 +27,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass
-from typing import TYPE_CHECKING, Iterator, Optional, Sequence
+from typing import TYPE_CHECKING, Optional, Sequence
 
 from distributedmandelbrot_tpu.coordinator.clock import Clock, MonotonicClock
 from distributedmandelbrot_tpu.core.workload import LevelSetting, Workload
@@ -86,7 +86,12 @@ class TileScheduler:
         self._claims: dict[Key, tuple[int, Lease]] = {}
         self._claim_seq = 0  # claim identity; see claim()
         self._retry: deque[Workload] = deque()
-        self._cursor = self._grid_iter()
+        # The frontier cursor is a flat position into the grid enumeration
+        # (settings in order, index_real outer, index_imag inner) rather
+        # than a live generator, so a checkpoint can record it as one
+        # integer and a restore resumes the frontier exactly where the
+        # crashed coordinator left it (snapshot_state/restore_state).
+        self._cursor_pos = 0
         self._cursor_done = False
         # Passive telemetry hooks — the scheduler stays pure logic (no
         # I/O, no real time); both default to None and cost nothing then.
@@ -144,11 +149,16 @@ class TileScheduler:
 
     # -- grant path -------------------------------------------------------
 
-    def _grid_iter(self) -> Iterator[Workload]:
+    def _workload_at(self, pos: int) -> Optional[Workload]:
+        """Grid workload at flat cursor position ``pos`` (grant order:
+        settings in sequence, ``index_real`` outer, ``index_imag`` inner,
+        ``Distributer.cs:338-340``); None past the end of the grid."""
         for s in self.level_settings:
-            for index_real in range(s.level):
-                for index_imag in range(s.level):
-                    yield Workload(s.level, s.max_iter, index_real, index_imag)
+            if pos < s.tile_count:
+                return Workload(s.level, s.max_iter, pos // s.level,
+                                pos % s.level)
+            pos -= s.tile_count
+        return None
 
     def _grantable(self, w: Workload, now: float) -> bool:
         if w.key in self._completed:
@@ -164,11 +174,14 @@ class TileScheduler:
             w = self._retry.popleft()
             if self._grantable(w, now):
                 return w
-        if not self._cursor_done:
-            for w in self._cursor:
-                if self._grantable(w, now):
-                    return w
-            self._cursor_done = True
+        while not self._cursor_done:
+            w = self._workload_at(self._cursor_pos)
+            if w is None:
+                self._cursor_done = True
+                break
+            self._cursor_pos += 1
+            if self._grantable(w, now):
+                return w
         return None
 
     def acquire(self) -> Optional[Workload]:
@@ -309,6 +322,84 @@ class TileScheduler:
             self._remaining += 1
             self._retry.append(w)
             self._count_requeue(w.key)
+
+    # -- checkpoint / restore ---------------------------------------------
+
+    def snapshot_state(self, *, exclude: Optional[set[Key]] = None) -> dict:
+        """Checkpointable view of the scheduler (coordinator/recovery.py).
+
+        Plain Python structures only — serialization (and the index
+        offset the completed set pairs with) is the recovery module's
+        business.  ``exclude`` removes keys whose persistence is still
+        in flight: a tile completed in memory but without a durable
+        index entry must not be checkpointed as done, or a crash before
+        its save lands would leave a hole no replay can fill.  Lease
+        expiries are captured as *remaining* TTLs against this clock, so
+        a restore under a different clock origin (a new process) grants
+        workers the time they actually had left.  Claims are folded into
+        the lease list: their upload connections die with the process,
+        and the worker's retry needs a live lease to land against.
+        """
+        now = self.clock.now()
+        completed = set(self._completed)
+        retry = list(self._retry)
+        if exclude:
+            completed -= exclude
+            # An excluded completed tile must also be re-grantable after a
+            # restore: if the crash beats its save, no index entry ever
+            # appears, its lease is gone (consumed at accept), and the
+            # cursor is past it — without a retry entry it would never be
+            # granted again and the run could not finish.  restore_state
+            # filters retry against the final completed set, so if the
+            # save DID land (suffix replay finds it) the entry is dropped.
+            max_iters = {s.level: s.max_iter for s in self.level_settings}
+            for key in sorted(exclude):
+                if key in self._completed and self._in_grid(key):
+                    level, i, j = key
+                    retry.append(Workload(level, max_iters[level], i, j))
+        leases: list[tuple[Workload, float]] = []
+        for lease in self._leases.values():
+            leases.append((lease.workload, lease.expires_at - now))
+        for _, lease in self._claims.values():
+            leases.append((lease.workload, lease.expires_at - now))
+        return {
+            "cursor_pos": self._cursor_pos,
+            "cursor_done": self._cursor_done,
+            "completed": completed,
+            "retry": retry,
+            "leases": leases,
+        }
+
+    def restore_state(self, *, cursor_pos: int, cursor_done: bool,
+                      retry: Sequence[Workload],
+                      leases: Sequence[tuple[Workload, float]]) -> int:
+        """Adopt a checkpointed frontier; returns the leases rebuilt.
+
+        The completed set is NOT restored here — the coordinator seeds
+        it through the constructor after merging the checkpoint's set
+        with the index-suffix replay, and this method filters against
+        it: a tile that completed after the checkpoint must drop out of
+        the restored retry queue and lease table.  A lease whose
+        remaining TTL ran out while the coordinator was down goes
+        straight to the retry queue (grantable now) instead of waiting
+        for a sweep to notice.
+        """
+        now = self.clock.now()
+        self._cursor_pos = cursor_pos
+        self._cursor_done = cursor_done
+        self._retry = deque(w for w in retry
+                            if w.key not in self._completed)
+        rebuilt = 0
+        for w, remaining in leases:
+            if w.key in self._completed or w.key in self._leases:
+                continue
+            if remaining > 0:
+                self._leases[w.key] = Lease(w, now + remaining)
+                rebuilt += 1
+            else:
+                self._retry.append(w)
+                self._count_requeue(w.key, expired=True)
+        return rebuilt
 
     # -- maintenance ------------------------------------------------------
 

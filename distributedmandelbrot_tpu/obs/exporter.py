@@ -16,7 +16,10 @@ gateway, ephemeral port by default).
   ``varz_extra`` callback (scheduler frontier depth, trace summaries);
 - ``/healthz`` — liveness probe, ``ok``;
 - ``/trace.json`` — the merged coordinator + worker timeline in Chrome
-  trace-event JSON (obs/chrome.py), loadable at ui.perfetto.dev.
+  trace-event JSON (obs/chrome.py), loadable at ui.perfetto.dev;
+- ``POST /checkpoint`` — on-demand durability checkpoint (admin-only
+  write route, present iff the embedding coordinator supplies
+  ``checkpoint_cb``; `dmtpu admin checkpoint` posts here).
 """
 
 from __future__ import annotations
@@ -116,11 +119,16 @@ class MetricsExporter:
                  trace: Optional[TraceLog] = None,
                  spans: Optional[SpanStore] = None,
                  varz_extra: Optional[Callable[[], dict]] = None,
+                 checkpoint_cb: Optional[Callable[[], "asyncio.Future"]]
+                 = None,
                  host: str = "127.0.0.1", port: int = 0) -> None:
         self.registry = registry
         self.trace = trace
         self.spans = spans
         self.varz_extra = varz_extra
+        # Async callable -> stats dict; enables the POST /checkpoint
+        # admin route (the coordinator wires its RecoveryManager here).
+        self.checkpoint_cb = checkpoint_cb
         self.host = host
         self.port = port
         self._server: Optional[asyncio.Server] = None
@@ -155,7 +163,20 @@ class MetricsExporter:
                                               _READ_TIMEOUT)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            if method not in ("GET", "HEAD"):
+            if method == "POST" and path == "/checkpoint" \
+                    and self.checkpoint_cb is not None:
+                # The route takes no arguments; any request body goes
+                # unread (HTTP/1.0 — the response closes the connection).
+                try:
+                    stats = await self.checkpoint_cb()
+                    body = (json.dumps(stats, sort_keys=True) + "\n").encode()
+                    self._respond(writer, 200, "application/json", body)
+                except Exception as e:
+                    logger.exception("on-demand checkpoint failed")
+                    self._respond(writer, 500,
+                                  "text/plain; charset=utf-8",
+                                  f"checkpoint failed: {e}\n".encode())
+            elif method not in ("GET", "HEAD"):
                 self._respond(writer, 405, "text/plain; charset=utf-8",
                               b"method not allowed\n")
             elif path == "/metrics":
@@ -198,8 +219,8 @@ class MetricsExporter:
 
     def _respond(self, writer: asyncio.StreamWriter, status: int,
                  ctype: str, body: bytes, *, head: bool = False) -> None:
-        reason = {200: "OK", 404: "Not Found",
-                  405: "Method Not Allowed"}.get(status, "?")
+        reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed",
+                  500: "Internal Server Error"}.get(status, "?")
         writer.write((f"HTTP/1.0 {status} {reason}\r\n"
                       f"Content-Type: {ctype}\r\n"
                       f"Content-Length: {len(body)}\r\n"

@@ -114,6 +114,30 @@ SPAN_STAGES = (SPAN_PREFETCH, SPAN_DISPATCH, SPAN_COMPUTE, SPAN_D2H,
 
 HIST_STORE_READ_SECONDS = "store_read_seconds"
 HIST_STORE_WRITE_SECONDS = "store_write_seconds"
+# Startup tail repair: a crash mid-append left a truncated final entry
+# and setup cut the index back to the last valid boundary.
+STORE_TORN_TAILS_REPAIRED = "store_torn_tails_repaired"
+
+# -- coordinator: durability (checkpoint/restore) -------------------------
+
+# Periodic + on-demand scheduler checkpoints written (and failures).
+COORD_CHECKPOINTS_WRITTEN = "coord_checkpoints_written"
+COORD_CHECKPOINT_ERRORS = "coord_checkpoint_errors"
+HIST_CHECKPOINT_SECONDS = "coord_checkpoint_seconds"
+# Restore path: startups that restored from a checkpoint, index entries
+# replayed during restore (suffix-only when a checkpoint was used — the
+# kill-and-restart e2e asserts replayed < total), and leases rebuilt so
+# in-flight workers can land results across the restart.
+COORD_RESTORES = "coord_restores"
+COORD_REPLAY_ENTRIES = "coord_replay_entries"
+COORD_RESTORED_LEASES = "coord_restored_leases"
+
+# -- worker: reconnect ----------------------------------------------------
+
+# Backoff-then-redial cycles after a dropped coordinator connection
+# (capped exponential + jitter; a coordinator restart no longer kills
+# the farm run).
+WORKER_RECONNECTS = "worker_reconnects"
 
 # -- coordinator: legacy dataserver ---------------------------------------
 

@@ -1,4 +1,4 @@
-"""Chunk store: data directory + append-only index + codec'd chunk files.
+"""Chunk store: append-only index + codec'd chunk blobs over a backend.
 
 Capabilities mirrored from the reference (``DataStorage.cs``), instance-based
 rather than process-global so tests and multi-store coordinators compose:
@@ -21,11 +21,32 @@ check-then-add spin-wait races, ``DataStorage.cs:158-162,337-341``);
 optional fsync for the index; a serialized-payload LRU so the read path
 doesn't decode + re-encode a chunk per request (the reference re-serializes
 every fetch, ``DataServer.cs:204-221``).
+
+Where the bytes live is a :class:`~distributedmandelbrot_tpu.storage
+.backends.StoreBackend`: the default :class:`LocalFileBackend` keeps the
+reference's exact on-disk layout, while :class:`ObjectStoreBackend` maps
+the same index + blobs onto object-store primitives.  This module owns
+every policy above the backend — entry format, filenames, caching,
+torn-tail repair — so the two layouts behave identically.
+
+Durability details this layer owns:
+
+- startup **torn-tail repair**: a crash mid-append leaves a truncated
+  final entry; appending after it (``"ab"``) would bury the tear as
+  *interior* corruption, so setup scans to the last valid entry boundary
+  and truncates the tail before any post-restart append;
+- **logical index offsets**: :meth:`ChunkStore.index_offset` /
+  :meth:`ChunkStore.entries_from` let the coordinator checkpoint a
+  high-water mark and replay only the suffix on restore;
+- armed **crash points** (``utils/faults.py``) at the save path's two
+  nasty interleavings, so the recovery tests can die exactly between the
+  blob write and the index append.
 """
 
 from __future__ import annotations
 
-import os
+import io
+import logging
 import threading
 import time
 from collections import OrderedDict
@@ -34,32 +55,42 @@ from typing import TYPE_CHECKING, Iterable, Optional
 from distributedmandelbrot_tpu.core.chunk import Chunk
 from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
 from distributedmandelbrot_tpu.obs import names as obs_names
-from distributedmandelbrot_tpu.storage.index import (EntryType, IndexEntry,
+from distributedmandelbrot_tpu.storage.backends import (DATA_DIR_NAME,
+                                                        INDEX_FILENAME,
+                                                        DataDirError,
+                                                        LocalFileBackend,
+                                                        StoreBackend)
+from distributedmandelbrot_tpu.storage.index import (CorruptIndexError,
+                                                     EntryType, IndexEntry,
+                                                     TornEntry, read_entry,
                                                      scan_entries)
+from distributedmandelbrot_tpu.utils import faults
 
 if TYPE_CHECKING:
     from distributedmandelbrot_tpu.obs.metrics import Registry
 
-INDEX_FILENAME = "_index.dat"
-DATA_DIR_NAME = "Data"
+__all__ = ["ChunkStore", "DataDirError", "compact", "DATA_DIR_NAME",
+           "INDEX_FILENAME"]
 
-
-class DataDirError(OSError):
-    """The data directory cannot be created or written (clean CLI error;
-    reference: the pre-start writability probe, ``Program.cs:159-176``)."""
+logger = logging.getLogger("dmtpu.store")
 
 
 class ChunkStore:
-    """Durable chunk storage rooted at ``parent_dir/Data/``."""
+    """Durable chunk storage over a backend (default: ``parent_dir/Data/``)."""
 
     def __init__(self, parent_dir: str = "", *, fsync_index: bool = False,
                  payload_cache_size: int = 64,
-                 registry: Optional["Registry"] = None) -> None:
+                 registry: Optional["Registry"] = None,
+                 backend: Optional[StoreBackend] = None) -> None:
         # Optional latency telemetry (store_read/write_seconds); None
         # keeps the store dependency-free for scripts and tests.
         self._registry = registry
-        self.data_dir = os.path.join(parent_dir, DATA_DIR_NAME)
-        self.index_path = os.path.join(self.data_dir, INDEX_FILENAME)
+        self.backend = backend if backend is not None \
+            else LocalFileBackend(parent_dir)
+        # Path attributes exist only for the local layout (ownership
+        # flocks, offline compaction); object-store layouts have neither.
+        self.data_dir = getattr(self.backend, "data_dir", None)
+        self.index_path = getattr(self.backend, "index_path", None)
         self._fsync_index = fsync_index
         self._index_lock = threading.Lock()
         self._file_locks: dict[str, threading.Lock] = {}
@@ -73,38 +104,48 @@ class ChunkStore:
     # -- directory / bookkeeping ------------------------------------------
 
     def setup(self) -> None:
-        """Create the data directory and an empty index if absent.
+        """Create the backing location, then repair any torn index tail.
 
-        Probes writability the way the reference does before starting
-        (``Program.cs:159-176`` writes and deletes a test file) and
-        raises :class:`DataDirError` with a clean message instead of
-        letting a raw OSError traceback surface from the CLI.
+        Backend setup probes writability the way the reference does
+        before starting (``Program.cs:159-176``) and raises
+        :class:`DataDirError` with a clean message instead of letting a
+        raw OSError traceback surface from the CLI.  The tail repair
+        must run before the first post-restart append: the index opens
+        in append mode, so writing after a crash-torn final entry would
+        turn it from a recoverable truncated tail into interior
+        corruption on the next scan.
         """
-        try:
-            os.makedirs(self.data_dir, exist_ok=True)
-        except (OSError, ValueError) as e:
-            # NotADirectoryError/FileExistsError: the path (or a parent)
-            # is occupied by a file; PermissionError: unwritable parent.
-            raise DataDirError(
-                f"cannot create data directory {self.data_dir!r}: "
-                f"{e}") from e
-        probe = os.path.join(self.data_dir,
-                             f"_writable_probe_{os.getpid()}.tmp")
-        try:
-            with open(probe, "wb") as f:
-                f.write(b"probe")
-            os.unlink(probe)
-        except OSError as e:
-            raise DataDirError(
-                f"data directory {self.data_dir!r} is not writable: "
-                f"{e}") from e
+        self.backend.setup()
         with self._index_lock:
-            if not os.path.exists(self.index_path):
-                with open(self.index_path, "wb"):
-                    pass
+            self._repair_index_tail()
 
-    def _chunk_path(self, filename: str) -> str:
-        return os.path.join(self.data_dir, filename)
+    def _repair_index_tail(self) -> None:
+        """Truncate the index to its last valid entry boundary (lock held)."""
+        data = self.backend.read_index()
+        size = len(data)
+        f = io.BytesIO(data)
+        valid = 0
+        while True:
+            try:
+                read_entry(f)
+            except EOFError:
+                break  # clean end: valid == size
+            except TornEntry:
+                break  # crash-torn tail: truncate past `valid`
+            except CorruptIndexError:
+                # Interior corruption is not repairable; keep the bytes
+                # for forensics and let entries() raise loudly, exactly
+                # as an unrepaired store would.
+                return
+            valid = f.tell()
+        if valid < size:
+            self.backend.truncate_index(valid)
+            logger.warning(
+                "repaired torn index tail: truncated %d trailing bytes "
+                "(crash mid-append); %d valid bytes kept", size - valid,
+                valid)
+            if self._registry is not None:
+                self._registry.inc(obs_names.STORE_TORN_TAILS_REPAIRED)
 
     def _file_lock(self, filename: str) -> threading.Lock:
         with self._file_locks_guard:
@@ -112,22 +153,22 @@ class ChunkStore:
 
     def _generate_filename(self, chunk: Chunk) -> str:
         base = f"{chunk.level};{chunk.index_real};{chunk.index_imag}"
-        if not os.path.exists(self._chunk_path(base)):
+        if not self.backend.blob_exists(base):
             return base
         suffix = 0
-        while os.path.exists(self._chunk_path(base + str(suffix))):
+        while self.backend.blob_exists(base + str(suffix)):
             suffix += 1
         return base + str(suffix)
 
     # -- write path -------------------------------------------------------
 
     def save(self, chunk: Chunk) -> IndexEntry:
-        """Persist a chunk: write its file (if Regular), then its index entry.
+        """Persist a chunk: write its blob (if Regular), then its index entry.
 
-        The file is written *before* the index entry so a crash between the
-        two leaves an orphaned data file (harmless) rather than an index
-        entry pointing at nothing — the reverse of the reference's order,
-        which can break resume.
+        The blob is written *before* the index entry so a crash between
+        the two leaves an orphaned data blob (harmless) rather than an
+        index entry pointing at nothing — the reverse of the reference's
+        order, which can break resume.
         """
         t0 = time.monotonic()
         if chunk.is_never:
@@ -138,19 +179,16 @@ class ChunkStore:
             filename = self._generate_filename(chunk)
             payload = chunk.serialize()
             with self._file_lock(filename):
-                tmp = self._chunk_path(filename) + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(payload)
-                os.replace(tmp, self._chunk_path(filename))
+                faults.hit("store.before_chunk_write")
+                self.backend.put_blob(filename, payload)
+            faults.hit("store.after_chunk_write")
             entry = IndexEntry(*chunk.key, EntryType.REGULAR, filename)
             self._cache_payload(chunk.key, payload)
 
         with self._index_lock:
-            with open(self.index_path, "ab") as f:
-                f.write(entry.to_bytes())
-                f.flush()
-                if self._fsync_index:
-                    os.fsync(f.fileno())
+            self.backend.append_index(entry.to_bytes(),
+                                      fsync=self._fsync_index)
+            faults.hit("store.after_index_append")
         if self._registry is not None:
             self._registry.observe(obs_names.HIST_STORE_WRITE_SECONDS,
                                    time.monotonic() - t0)
@@ -160,8 +198,21 @@ class ChunkStore:
 
     def entries(self) -> list[IndexEntry]:
         with self._index_lock:
-            with open(self.index_path, "rb") as f:
-                return list(scan_entries(f))
+            data = self.backend.read_index()
+        return list(scan_entries(io.BytesIO(data)))
+
+    def index_offset(self) -> int:
+        """Logical end offset of the index — the replay high-water mark a
+        checkpoint records so restore can scan only the suffix."""
+        with self._index_lock:
+            return self.backend.index_size()
+
+    def entries_from(self, offset: int) -> list[IndexEntry]:
+        """Entries wholly past logical ``offset`` (the checkpointed
+        prefix is already accounted; only the suffix needs replaying)."""
+        with self._index_lock:
+            data = self.backend.read_index(offset)
+        return list(scan_entries(io.BytesIO(data)))
 
     def completed_keys(self, levels: Optional[Iterable[int]] = None
                        ) -> set[tuple[int, int, int]]:
@@ -231,8 +282,11 @@ class ChunkStore:
         if entry.type == EntryType.IMMEDIATE:
             return Chunk.immediate(*entry.key)
         with self._file_lock(entry.filename):
-            with open(self._chunk_path(entry.filename), "rb") as f:
-                payload = f.read()
+            payload = self.backend.get_blob(entry.filename)
+        if payload is None:
+            raise FileNotFoundError(
+                f"chunk blob {entry.filename!r} referenced by the index "
+                f"is missing from {self.backend.describe()}")
         data = Chunk.deserialize_data(payload)
         if data.size != CHUNK_PIXELS:
             raise ValueError(
@@ -248,7 +302,8 @@ def compact(parent_dir: str = "", *, remove_orphans: bool = True,
     The reference's index is append-only by design (``DataStorage.cs``
     has no compaction; duplicate entries accumulate on re-saves and old
     chunk-file versions linger via collision suffixing) — fine for a
-    run, unbounded for a long-lived farm.  Offline maintenance:
+    run, unbounded for a long-lived farm.  Offline maintenance over the
+    local-file layout (object-store layouts rotate their own segments):
 
     - claims EVERY level present in the index via the flock ownership
       locks, so running against a live coordinator fails loudly instead
@@ -264,6 +319,7 @@ def compact(parent_dir: str = "", *, remove_orphans: bool = True,
     Returns a stats dict: entries before/after, orphans removed, bytes
     reclaimed from the index.
     """
+    import os
     import re as _re
 
     from distributedmandelbrot_tpu.storage.ownership import LevelClaims

@@ -1,0 +1,106 @@
+"""Deterministic crash-point fault injection for durability tests.
+
+The durability subsystem's guarantees are all statements about *where* a
+crash lands relative to the write order (chunk blob vs. index append vs.
+checkpoint swap).  Real crashes are not schedulable, so the write paths
+carry named crash points — :func:`hit` calls that are free no-ops until a
+test arms them — and a test picks the exact interleaving it wants:
+
+- in-process: :func:`arm` makes the Nth hit raise :class:`CrashPointError`,
+  so a unit test can assert what the on-disk state looks like when a save
+  dies between its two writes;
+- cross-process: arming with ``exit=True`` (or via the ``DMTPU_CRASHPOINTS``
+  environment variable, read at import) makes the Nth hit ``os._exit`` the
+  whole process — a real kill, releasing flocks the way a crash does — which
+  is how the kill-and-restart e2e murders a live coordinator mid-level.
+
+Known points (grep for ``faults.hit`` to enumerate):
+
+- ``store.before_chunk_write``  — save() after filename pick, before blob
+- ``store.after_chunk_write``   — blob durable, index entry not yet appended
+- ``store.after_index_append``  — index entry durable, save() not returned
+- ``recovery.mid_checkpoint``   — checkpoint encoded, atomic swap not done
+- ``coord.between_accept_and_persist`` — result accepted, save not scheduled
+
+Environment syntax: ``DMTPU_CRASHPOINTS=point[:after][,point[:after]...]``
+where ``after`` (default 1) is the 1-based hit count that fires.  Env-armed
+points always hard-exit with :data:`CRASH_EXIT_CODE`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+ENV_VAR = "DMTPU_CRASHPOINTS"
+CRASH_EXIT_CODE = 86  # distinctive; tests assert the kill was ours
+
+
+class CrashPointError(RuntimeError):
+    """An armed in-process crash point fired."""
+
+
+_lock = threading.Lock()
+# point -> [remaining_hits, hard_exit]
+_armed: dict[str, list] = {}
+
+
+def arm(point: str, *, after: int = 1, exit: bool = False) -> None:
+    """Arm ``point`` to fire on its ``after``-th hit (1 = next hit)."""
+    if after < 1:
+        raise ValueError(f"after must be >= 1, got {after}")
+    with _lock:
+        _armed[point] = [after, exit]
+
+
+def disarm(point: Optional[str] = None) -> None:
+    """Disarm one point, or every point when ``point`` is None."""
+    with _lock:
+        if point is None:
+            _armed.clear()
+        else:
+            _armed.pop(point, None)
+
+
+def armed() -> dict[str, int]:
+    """Remaining-hit counts by point (test introspection)."""
+    with _lock:
+        return {name: spec[0] for name, spec in _armed.items()}
+
+
+def hit(point: str) -> None:
+    """Production-side hook: crash here iff a test armed this point.
+
+    The unlocked emptiness check keeps the disarmed case free — arming
+    happens strictly before the workload that should crash, never
+    concurrently with it.
+    """
+    if not _armed:
+        return
+    with _lock:
+        spec = _armed.get(point)
+        if spec is None:
+            return
+        spec[0] -= 1
+        if spec[0] > 0:
+            return
+        del _armed[point]
+        hard_exit = spec[1]
+    if hard_exit:
+        os._exit(CRASH_EXIT_CODE)
+    raise CrashPointError(f"armed crash point {point!r} fired")
+
+
+def arm_from_env(environ=os.environ) -> None:
+    """Arm hard-exit points from :data:`ENV_VAR` (subprocess harness)."""
+    spec = environ.get(ENV_VAR, "")
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, count = part.partition(":")
+        arm(name, after=int(count) if count else 1, exit=True)
+
+
+arm_from_env()

@@ -3,13 +3,20 @@
 Speaks the same wire protocol as the reference worker
 (``DistributedMandelbrotWorkerCUDA.py:102-176``): one connection per
 exchange, purpose byte first.  Adds the batched request/response exchanges
-(one connection for a whole batch) used to feed a device mesh.
+(one connection for a whole batch) used to feed a device mesh, and an
+opt-in reconnect policy (capped exponential backoff + jitter) so a
+coordinator restart — now survivable server-side thanks to
+checkpoint/restore — no longer kills the farm run from the client side.
 """
 
 from __future__ import annotations
 
+import random
 import socket
-from typing import Optional, Sequence
+import time
+from typing import Callable, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
 
 import numpy as np
 
@@ -32,7 +39,11 @@ _STAGE_CODES = {
 
 class DistributerClient:
     def __init__(self, host: str, port: int, *,
-                 timeout: Optional[float] = 30.0) -> None:
+                 timeout: Optional[float] = 30.0,
+                 reconnect_attempts: int = 0,
+                 reconnect_base: float = 0.05,
+                 reconnect_cap: float = 5.0,
+                 counters=None, rng: Optional[random.Random] = None) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
@@ -40,6 +51,18 @@ class DistributerClient:
         # drops the connection on the unknown 0x04 purpose byte, and
         # retrying every upload would just spam its error log.
         self.span_push_disabled = False
+        # Reconnect policy: up to ``reconnect_attempts`` redials per
+        # exchange on connection-level failure (OSError), sleeping
+        # min(cap, base * 2^n) scaled by jitter in [0.5, 1.0) between
+        # tries.  Only transport errors retry — a ProtocolError means the
+        # peer is speaking garbage and redialing it would loop forever.
+        # The default (0) preserves the historical fail-fast behavior.
+        self.reconnect_attempts = reconnect_attempts
+        self.reconnect_base = reconnect_base
+        self.reconnect_cap = reconnect_cap
+        self.counters = counters
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = time.sleep  # injectable for deterministic tests
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection((self.host, self.port),
@@ -47,10 +70,36 @@ class DistributerClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
+    def _with_reconnect(self, op: Callable[[], T]) -> T:
+        """Run one exchange, redialing on transport failure.
+
+        Retried exchanges are safe to repeat: request/request_batch at
+        worst leases extra tiles (their leases expire), and a submit
+        whose accept byte was lost is re-claimed server-side, where
+        completion dedup rejects any duplicate.
+        """
+        attempt = 0
+        while True:
+            try:
+                return op()
+            except OSError:
+                if attempt >= self.reconnect_attempts:
+                    raise
+                delay = min(self.reconnect_cap,
+                            self.reconnect_base * (2 ** attempt))
+                delay *= 0.5 + 0.5 * self._rng.random()
+                attempt += 1
+                if self.counters is not None:
+                    self.counters.inc(obs_names.WORKER_RECONNECTS)
+                self._sleep(delay)
+
     # -- job acquisition --------------------------------------------------
 
     def request(self) -> Optional[Workload]:
         """Pull one workload; None when the coordinator has nothing to hand out."""
+        return self._with_reconnect(self._request_once)
+
+    def _request_once(self) -> Optional[Workload]:
         with self._connect() as sock:
             framing.send_byte(sock, proto.PURPOSE_REQUEST)
             status = framing.recv_byte(sock)
@@ -64,6 +113,9 @@ class DistributerClient:
 
     def request_batch(self, max_count: int) -> list[Workload]:
         """Pull up to ``max_count`` workloads in one exchange."""
+        return self._with_reconnect(lambda: self._request_batch_once(max_count))
+
+    def _request_batch_once(self, max_count: int) -> list[Workload]:
         with self._connect() as sock:
             framing.send_byte(sock, proto.PURPOSE_BATCH_REQUEST)
             framing.send_u32(sock, max_count)
@@ -127,6 +179,9 @@ class DistributerClient:
     def submit(self, workload: Workload, pixels: np.ndarray) -> bool:
         """Push one result; returns True if the coordinator accepted it."""
         data = self._pixel_bytes(pixels)
+        return self._with_reconnect(lambda: self._submit_once(workload, data))
+
+    def _submit_once(self, workload: Workload, data: bytes) -> bool:
         with self._connect() as sock:
             framing.send_byte(sock, proto.PURPOSE_RESPONSE)
             framing.send_all(sock, workload.to_wire())
@@ -145,6 +200,10 @@ class DistributerClient:
         if not results:
             return []
         encoded = [(w, self._pixel_bytes(p)) for w, p in results]
+        return self._with_reconnect(lambda: self._submit_batch_once(encoded))
+
+    def _submit_batch_once(self, encoded: Sequence[tuple[Workload, bytes]]
+                           ) -> list[bool]:
         accepted: list[bool] = []
         with self._connect() as sock:
             framing.send_byte(sock, proto.PURPOSE_BATCH_RESPONSE)

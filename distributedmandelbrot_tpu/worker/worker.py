@@ -59,6 +59,11 @@ class Worker:
         self.depth = depth
         self.counters = counters if counters is not None else Counters()
         self.registry = self.counters.registry
+        # A client constructed without its own counters adopts the
+        # worker's, so reconnect metrics land in the same scrape as
+        # compute/upload (one Counters per worker process).
+        if getattr(client, "counters", None) is None:
+            client.counters = self.counters
         # Backends that keep their own phase instruments adopt the
         # worker's registry, so one scrape sees the whole picture.
         bind = getattr(backend, "bind_registry", None)

@@ -1,0 +1,45 @@
+"""Subprocess coordinator for the kill-and-restart recovery e2e.
+
+Usage: python tests/coordinator_driver.py DATA_DIR PORT_FILE LEVELS
+
+Starts a Coordinator (ephemeral loopback ports, exporter on) over
+DATA_DIR, writes the bound ports to PORT_FILE as JSON, then serves until
+killed.  Crashpoints come in through the DMTPU_CRASHPOINTS environment
+variable (utils/faults.py) — the test arms a hard-exit point, drives the
+farm until the process dies mid-level with exit code 86, and restarts
+this same driver on the same data dir to exercise restore.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+
+async def _main() -> None:
+    # Package-under-test import; the test launches us with the repo root
+    # on PYTHONPATH (it is the pytest rootdir).
+    from distributedmandelbrot_tpu.cli import parse_level_settings
+    from distributedmandelbrot_tpu.coordinator.app import Coordinator
+
+    data_dir, port_file, levels = sys.argv[1], sys.argv[2], sys.argv[3]
+    coordinator = Coordinator(
+        parse_level_settings(levels), data_dir_parent=data_dir,
+        host="127.0.0.1", distributer_port=0, dataserver_port=0,
+        exporter_port=0, stats_period=0.0)
+    await coordinator.start()
+    payload = json.dumps({"distributer": coordinator.distributer_port,
+                          "exporter": coordinator.exporter_port,
+                          "pid": os.getpid()})
+    tmp = port_file + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(payload)
+    os.replace(tmp, port_file)  # atomic: the test polls for this file
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await coordinator.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(_main())

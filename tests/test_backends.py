@@ -1,0 +1,196 @@
+"""Store backend tests: object-store kv semantics, the segmented-index
+layout (tail objects, rotation, atomic manifest), and ChunkStore parity
+across LocalFileBackend and ObjectStoreBackend."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core import CHUNK_PIXELS, Chunk
+from distributedmandelbrot_tpu.storage import (ChunkStore, DataDirError,
+                                               DirObjectStore,
+                                               LocalFileBackend,
+                                               MemoryObjectStore,
+                                               ObjectStoreBackend)
+
+
+def patterned_chunk(level=8, i=1, j=2, period=97):
+    data = (np.arange(CHUNK_PIXELS) % period).astype(np.uint8)
+    return Chunk(level, i, j, data)
+
+
+# -- raw kv stores ---------------------------------------------------------
+
+
+@pytest.fixture(params=["memory", "dir"])
+def kv(request, tmp_path):
+    if request.param == "memory":
+        return MemoryObjectStore()
+    return DirObjectStore(str(tmp_path / "kv"))
+
+
+def test_kv_put_get_size_delete(kv):
+    assert kv.get("a/b") is None
+    assert kv.size("a/b") is None
+    kv.put("a/b", b"hello")
+    assert kv.get("a/b") == b"hello"
+    assert kv.size("a/b") == 5
+    kv.put("a/b", b"clobbered")  # puts replace atomically
+    assert kv.get("a/b") == b"clobbered"
+    kv.delete("a/b")
+    assert kv.get("a/b") is None
+    kv.delete("a/b")  # idempotent
+
+
+def test_kv_list_prefix(kv):
+    kv.put("index/tail-000000000001", b"x")
+    kv.put("index/tail-000000000002", b"y")
+    kv.put("blobs/8;1;2", b"z")
+    assert sorted(kv.list("index/")) == ["index/tail-000000000001",
+                                         "index/tail-000000000002"]
+    assert kv.list("blobs/") == ["blobs/8;1;2"]
+    assert kv.list("nope/") == []
+
+
+def test_dir_object_store_rejects_escapes(tmp_path):
+    kv = DirObjectStore(str(tmp_path / "kv"))
+    with pytest.raises(ValueError):
+        kv.put("../escape", b"x")
+    with pytest.raises(ValueError):
+        kv.put("/absolute", b"x")
+
+
+# -- object-store index layout --------------------------------------------
+
+
+def test_object_backend_append_offsets_and_read():
+    be = ObjectStoreBackend(MemoryObjectStore())
+    be.setup()
+    assert be.index_size() == 0
+    assert be.append_index(b"aaaa") == 4
+    assert be.append_index(b"bbbbbb") == 10
+    assert be.read_index() == b"aaaabbbbbb"
+    assert be.read_index(4) == b"bbbbbb"
+    assert be.read_index(7) == b"bbb"  # mid-object offsets work
+    assert be.index_size() == 10
+
+
+def test_object_backend_rotation_seals_segments():
+    kv = MemoryObjectStore()
+    be = ObjectStoreBackend(kv, rotate_threshold=3)
+    be.setup()
+    for i in range(7):
+        be.append_index(bytes([i]) * 2)
+    assert be.read_index() == b"".join(bytes([i]) * 2 for i in range(7))
+    # Rotation merged tails into sealed segment objects and committed a
+    # manifest; leftover tails (< threshold) stay as tail objects.
+    assert any(k.startswith("index/seg-") for k in kv.list("index/"))
+    assert kv.get("index/manifest") is not None
+    # A fresh handle over the same kv reconstructs the identical stream.
+    be2 = ObjectStoreBackend(kv)
+    be2.setup()
+    assert be2.read_index() == be.read_index()
+    assert be2.index_size() == be.index_size()
+    # And appends continue at the right offset.
+    end = be2.append_index(b"zz")
+    assert end == be.index_size() + 2
+    assert be2.read_index(be.index_size()) == b"zz"
+
+
+def test_object_backend_truncate_tail():
+    be = ObjectStoreBackend(MemoryObjectStore(), rotate_threshold=100)
+    be.setup()
+    be.append_index(b"aaaa")
+    be.append_index(b"bb")
+    be.truncate_index(4)  # drop the torn tail object
+    assert be.read_index() == b"aaaa"
+    assert be.index_size() == 4
+    be.append_index(b"cc")
+    assert be.read_index() == b"aaaacc"
+
+
+def test_object_backend_truncate_below_sealed_raises():
+    be = ObjectStoreBackend(MemoryObjectStore(), rotate_threshold=2)
+    be.setup()
+    for _ in range(4):
+        be.append_index(b"xxxx")  # forces at least one sealed segment
+    with pytest.raises(ValueError):
+        be.truncate_index(1)
+
+
+def test_object_backend_blobs():
+    be = ObjectStoreBackend(MemoryObjectStore())
+    be.setup()
+    assert be.get_blob("8;1;2") is None
+    assert not be.blob_exists("8;1;2")
+    be.put_blob("8;1;2", b"payload")
+    assert be.get_blob("8;1;2") == b"payload"
+    assert be.blob_exists("8;1;2")
+    assert be.peek_blob("8;1;2", 3) == b"pay"
+    assert be.list_blobs() == ["8;1;2"]
+
+
+# -- ChunkStore over each backend -----------------------------------------
+
+
+@pytest.fixture(params=["local", "object-memory", "object-dir"])
+def backend_factory(request, tmp_path):
+    """Callable returning a NEW backend handle over the SAME storage, so
+    tests can simulate process restarts."""
+    if request.param == "local":
+        return lambda: LocalFileBackend(str(tmp_path))
+    if request.param == "object-memory":
+        kv = MemoryObjectStore()
+        return lambda: ObjectStoreBackend(kv)
+    kv_root = str(tmp_path / "objects")
+    return lambda: ObjectStoreBackend(DirObjectStore(kv_root))
+
+
+def test_chunkstore_roundtrip_any_backend(backend_factory):
+    store = ChunkStore(backend=backend_factory())
+    store.setup()
+    c = patterned_chunk()
+    store.save(c)
+    store.save(Chunk.never(8, 0, 0))
+    assert store.completed_keys() == {(8, 1, 2), (8, 0, 0)}
+    got = store.load(8, 1, 2)
+    assert got is not None and np.array_equal(got.data, c.data)
+    # "Restart": a fresh store over the same storage sees everything.
+    store2 = ChunkStore(backend=backend_factory())
+    store2.setup()
+    assert store2.completed_keys() == {(8, 1, 2), (8, 0, 0)}
+    got2 = store2.load(8, 1, 2)
+    assert got2 is not None and np.array_equal(got2.data, c.data)
+
+
+def test_chunkstore_suffix_replay_any_backend(backend_factory):
+    store = ChunkStore(backend=backend_factory())
+    store.setup()
+    store.save(Chunk.never(8, 0, 0))
+    offset = store.index_offset()
+    store.save(Chunk.never(8, 1, 1))
+    store.save(Chunk.never(8, 2, 2))
+    suffix = store.entries_from(offset)
+    assert [e.key for e in suffix] == [(8, 1, 1), (8, 2, 2)]
+
+
+def test_local_backend_layout_unchanged(tmp_path):
+    """The default backend writes the historical on-disk layout: a
+    ``Data/`` dir, ``_index.dat`` inside it, ``level;re;im`` blobs."""
+    store = ChunkStore(str(tmp_path))
+    store.setup()
+    store.save(patterned_chunk())
+    data_dir = tmp_path / "Data"
+    assert (data_dir / "_index.dat").is_file()
+    assert (data_dir / "8;1;2").is_file()
+    # Raw index bytes == what the backend reports (byte compatibility).
+    raw = (data_dir / "_index.dat").read_bytes()
+    assert store.backend.read_index() == raw
+
+
+def test_local_backend_unwritable_parent():
+    with pytest.raises(DataDirError):
+        ChunkStore(backend=LocalFileBackend(
+            os.path.join(os.sep, "proc", "definitely", "not",
+                         "writable"))).setup()

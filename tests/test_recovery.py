@@ -1,0 +1,583 @@
+"""Durability tests: checkpoint/restore, torn-tail repair, crashpoint
+interleavings, worker reconnect backoff, and the kill-and-restart e2e.
+
+The deterministic crashpoints (utils/faults.py) let these tests stop a
+store or checkpoint write at the exact interleavings a crash-consistency
+argument worries about; the e2e at the bottom does it for real — a
+subprocess coordinator hard-exits mid-level at an armed crashpoint and a
+restart on the same data dir must drain the farm to the exact tile set.
+"""
+
+import json
+import os
+import random
+import socket
+import struct
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.coordinator.clock import ManualClock
+from distributedmandelbrot_tpu.coordinator.recovery import (
+    Checkpoint, CorruptCheckpointError, RecoveryManager, StaleGenerationError,
+    checkpoint_blob_name, decode_checkpoint, encode_checkpoint,
+    load_restore_state, peek_generation)
+from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
+from distributedmandelbrot_tpu.core import CHUNK_PIXELS, Chunk
+from distributedmandelbrot_tpu.core.workload import LevelSetting, Workload
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.metrics import Registry
+from distributedmandelbrot_tpu.storage.store import ChunkStore
+from distributedmandelbrot_tpu.utils import faults
+from distributedmandelbrot_tpu.utils.metrics import Counters
+from distributedmandelbrot_tpu.worker.client import DistributerClient
+
+SETTINGS = [LevelSetting(8, 100)]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_crashpoints():
+    yield
+    faults.disarm()
+
+
+def make_store(tmp_path) -> ChunkStore:
+    store = ChunkStore(str(tmp_path))
+    store.setup()
+    return store
+
+
+# -- codec ----------------------------------------------------------------
+
+
+def test_checkpoint_codec_roundtrip():
+    ck = Checkpoint(generation=7, index_offset=1234,
+                    settings=((8, 100), (16, 250)), cursor_pos=42,
+                    cursor_done=False,
+                    completed={(8, 0, 0), (8, 3, 3), (16, 9, 1)},
+                    leases=[(Workload(8, 100, 1, 2), 17.5),
+                            (Workload(16, 250, 0, 0), -3.0)],
+                    retry=[Workload(8, 100, 2, 2)])
+    assert decode_checkpoint(encode_checkpoint(ck)) == ck
+
+
+def test_checkpoint_codec_rejects_corruption():
+    data = encode_checkpoint(Checkpoint(
+        generation=1, index_offset=0, settings=((8, 100),), cursor_pos=0,
+        cursor_done=False, completed=set(), leases=[], retry=[]))
+    with pytest.raises(CorruptCheckpointError):
+        decode_checkpoint(data[:-1])  # truncated
+    flipped = bytearray(data)
+    flipped[10] ^= 0xFF
+    with pytest.raises(CorruptCheckpointError):
+        decode_checkpoint(bytes(flipped))  # CRC catches a bit flip
+    with pytest.raises(CorruptCheckpointError):
+        decode_checkpoint(b"NOPE" + data[4:])  # bad magic
+
+
+def test_blob_name_is_per_level_set():
+    assert checkpoint_blob_name(SETTINGS) == "_checkpoint-8.dat"
+    two = [LevelSetting(16, 1), LevelSetting(8, 1)]
+    assert checkpoint_blob_name(two) == "_checkpoint-8_16.dat"
+
+
+# -- checkpoint round trip with a virtual clock ---------------------------
+
+
+def test_lease_ttls_survive_restore(tmp_path):
+    """Remaining lease TTLs are carried as durations: a restore in a new
+    process (fresh clock origin) gives workers the time they had left;
+    a lease that expired while the coordinator was down is grantable
+    immediately."""
+    store = make_store(tmp_path)
+    clock = ManualClock()
+    sched = TileScheduler(SETTINGS, clock=clock, lease_timeout=100.0)
+    w_live = sched.acquire()
+    clock.advance(60.0)
+    w_dying = sched.acquire()  # expires_at = 160
+    clock.advance(10.0)        # now 70: live has 30 left, dying has 90
+    mgr = RecoveryManager(store, sched, generation=1)
+    mgr.checkpoint_sync()
+
+    # Restart after 50 virtual seconds of downtime: w_live's 30 s ran
+    # out, w_dying still has 40 s.
+    res = load_restore_state(store, SETTINGS)
+    clock2 = ManualClock()
+    sched2 = TileScheduler(SETTINGS, completed=res.completed, clock=clock2,
+                           lease_timeout=100.0)
+    # Downtime is modeled by the TTLs themselves; shrink them by hand to
+    # simulate 50 s passing while down.
+    ck = res.checkpoint
+    aged = [(w, remaining - 50.0) for w, remaining in ck.leases]
+    rebuilt = sched2.restore_state(cursor_pos=ck.cursor_pos,
+                                   cursor_done=ck.cursor_done,
+                                   retry=ck.retry, leases=aged)
+    assert rebuilt == 1  # only w_dying still holds a lease
+    assert sched2.can_accept(w_dying)
+    assert not sched2.can_accept(w_live)
+    # The expired tile went to the retry queue: it is granted again
+    # (possibly among frontier tiles, so scan a few grants).
+    granted = {sched2.acquire().key for _ in range(3)}
+    assert w_live.key in granted
+
+
+def test_restore_replays_only_suffix(tmp_path):
+    store = make_store(tmp_path)
+    sched = TileScheduler(SETTINGS)
+    for _ in range(4):
+        w = sched.acquire()
+        sched.complete(w)
+        store.save(Chunk.never(w.level, w.index_real, w.index_imag))
+    RecoveryManager(store, sched, generation=1).checkpoint_sync()
+    for _ in range(3):  # land past the checkpoint
+        w = sched.acquire()
+        sched.complete(w)
+        store.save(Chunk.never(w.level, w.index_real, w.index_imag))
+
+    registry = Registry()
+    res = load_restore_state(store, SETTINGS, registry=registry)
+    assert res.checkpoint is not None
+    assert res.replayed_entries == 3
+    assert len(res.completed) == 7
+    assert res.generation == 2
+    assert registry.counter_value(obs_names.COORD_RESTORES) == 1
+    assert registry.counter_value(obs_names.COORD_REPLAY_ENTRIES) == 3
+
+
+def test_restore_discards_checkpoint_on_settings_change(tmp_path):
+    store = make_store(tmp_path)
+    sched = TileScheduler(SETTINGS)
+    w = sched.acquire()
+    sched.complete(w)
+    store.save(Chunk.never(w.level, w.index_real, w.index_imag))
+    RecoveryManager(store, sched, generation=3).checkpoint_sync()
+
+    changed = [LevelSetting(8, 999)]  # same level, different max_iter
+    res = load_restore_state(store, changed)
+    assert res.checkpoint is None  # full replay fallback
+    assert res.completed == {w.key}
+    assert res.generation == 4  # generation still carries over
+
+
+def test_pending_save_excluded_but_regrantable(tmp_path):
+    """The pending-save window: a tile completed in the scheduler whose
+    save never lands is excluded from the checkpointed completed set AND
+    parked in its retry queue — after restore it is granted again, not
+    stuck in limbo."""
+    store = make_store(tmp_path)
+    sched = TileScheduler(SETTINGS)
+    w = sched.acquire()
+    sched.complete(w)  # accepted, but its save will "never land"
+    mgr = RecoveryManager(store, sched, generation=1,
+                          pending_keys_fn=lambda: {w.key})
+    mgr.checkpoint_sync()
+
+    res = load_restore_state(store, SETTINGS)
+    assert w.key not in res.completed
+    sched2 = TileScheduler(SETTINGS, completed=res.completed)
+    res.apply(sched2)
+    granted = {sched2.acquire().key for _ in range(2)}
+    assert w.key in granted
+
+    # Counter-case: the save DID land (entry in the suffix) — the parked
+    # retry entry must be dropped, not re-granted.
+    store.save(Chunk.never(w.level, w.index_real, w.index_imag))
+    res2 = load_restore_state(store, SETTINGS)
+    assert w.key in res2.completed
+    sched3 = TileScheduler(SETTINGS, completed=res2.completed)
+    res2.apply(sched3)
+    for _ in range(sched3.total_tiles):
+        g = sched3.acquire()
+        assert g is None or g.key != w.key
+
+
+# -- fencing ---------------------------------------------------------------
+
+
+def test_generation_fencing(tmp_path):
+    store = make_store(tmp_path)
+    sched = TileScheduler(SETTINGS)
+    old = RecoveryManager(store, sched, generation=1)
+    old.checkpoint_sync()
+    assert peek_generation(store, SETTINGS) == 1
+    new = RecoveryManager(store, sched, generation=5)
+    new.checkpoint_sync()
+    assert peek_generation(store, SETTINGS) == 5
+    with pytest.raises(StaleGenerationError):
+        old.checkpoint_sync()  # the fenced-out predecessor
+    assert peek_generation(store, SETTINGS) == 5  # untouched
+
+
+def test_mid_checkpoint_crash_preserves_previous(tmp_path):
+    """A crash between encode and PUT leaves the previous checkpoint
+    fully intact (the blob PUT is atomic)."""
+    store = make_store(tmp_path)
+    sched = TileScheduler(SETTINGS)
+    w = sched.acquire()
+    sched.complete(w)
+    mgr = RecoveryManager(store, sched, generation=1)
+    mgr.checkpoint_sync()
+
+    w2 = sched.acquire()
+    sched.complete(w2)
+    faults.arm("recovery.mid_checkpoint")
+    with pytest.raises(faults.CrashPointError):
+        mgr.checkpoint_sync()
+    res = load_restore_state(store, SETTINGS)
+    assert res.checkpoint is not None
+    assert res.checkpoint.completed == {w.key}  # first checkpoint, intact
+
+
+# -- store crashpoint interleavings ---------------------------------------
+
+
+def patterned_chunk(level=8, i=1, j=2):
+    return Chunk(level, i, j,
+                 (np.arange(CHUNK_PIXELS) % 97).astype(np.uint8))
+
+
+def test_crash_before_chunk_write(tmp_path):
+    store = make_store(tmp_path)
+    faults.arm("store.before_chunk_write")
+    with pytest.raises(faults.CrashPointError):
+        store.save(patterned_chunk())
+    # Nothing landed: no index entry, tile will be recomputed.
+    assert store.completed_keys() == set()
+    store.save(patterned_chunk())  # clean retry succeeds
+    assert store.completed_keys() == {(8, 1, 2)}
+
+
+def test_crash_between_chunk_and_index(tmp_path):
+    """The nasty one: blob durable, index entry missing.  The tile must
+    NOT count as completed (replay is index-driven), so it is recomputed
+    — an orphan blob, never a lost tile."""
+    store = make_store(tmp_path)
+    faults.arm("store.after_chunk_write")
+    with pytest.raises(faults.CrashPointError):
+        store.save(patterned_chunk())
+    assert store.completed_keys() == set()
+    store2 = ChunkStore(str(tmp_path))
+    store2.setup()
+    assert store2.completed_keys() == set()
+    store2.save(patterned_chunk())  # retry lands under a fresh blob name
+    assert store2.completed_keys() == {(8, 1, 2)}
+    got = store2.load(8, 1, 2)
+    assert got is not None and np.array_equal(got.data,
+                                              patterned_chunk().data)
+
+
+def test_crash_after_index_append(tmp_path):
+    store = make_store(tmp_path)
+    faults.arm("store.after_index_append")
+    with pytest.raises(faults.CrashPointError):
+        store.save(patterned_chunk())
+    # The append is the commit point: the tile IS durably completed.
+    assert store.completed_keys() == {(8, 1, 2)}
+    store2 = ChunkStore(str(tmp_path))
+    store2.setup()
+    assert store2.completed_keys() == {(8, 1, 2)}
+
+
+# -- torn-tail repair ------------------------------------------------------
+
+
+def test_torn_tail_repaired_before_post_restart_append(tmp_path):
+    """Regression: a crash mid-append leaves a torn final entry; the old
+    "ab"-mode reopen would land the next append AFTER the torn bytes,
+    turning a tolerated torn tail into an interior CorruptIndexError.
+    setup() must truncate to the last valid entry boundary first."""
+    store = make_store(tmp_path)
+    store.save(Chunk.never(8, 0, 0))
+    store.save(Chunk.never(8, 1, 1))
+    index_path = os.path.join(str(tmp_path), "Data", "_index.dat")
+    size = os.path.getsize(index_path)
+    with open(index_path, "ab") as f:  # simulate the torn append
+        f.write(struct.pack("<IIIi", 8, 2, 2, 1)[:7])
+
+    registry = Registry()
+    store2 = ChunkStore(str(tmp_path), registry=registry)
+    store2.setup()
+    assert registry.counter_value(
+        obs_names.STORE_TORN_TAILS_REPAIRED) == 1
+    assert os.path.getsize(index_path) == size  # cut back to the boundary
+    store2.save(Chunk.never(8, 3, 3))  # post-restart append
+    # The whole index parses cleanly — no interior corruption.
+    assert store2.completed_keys() == {(8, 0, 0), (8, 1, 1), (8, 3, 3)}
+    store3 = ChunkStore(str(tmp_path))
+    store3.setup()
+    assert store3.completed_keys() == {(8, 0, 0), (8, 1, 1), (8, 3, 3)}
+
+
+def test_interior_corruption_still_raises(tmp_path):
+    """Repair is strictly a tail operation: interior garbage is damage,
+    not a crash artifact, and keeps raising as before."""
+    store = make_store(tmp_path)
+    store.save(Chunk.never(8, 0, 0))
+    index_path = os.path.join(str(tmp_path), "Data", "_index.dat")
+    with open(index_path, "r+b") as f:
+        f.seek(12)
+        f.write(struct.pack("<i", 99))  # invalid entry type mid-file
+    store2 = ChunkStore(str(tmp_path))
+    store2.setup()  # setup leaves the bytes alone...
+    from distributedmandelbrot_tpu.storage.index import CorruptIndexError
+    with pytest.raises(CorruptIndexError):
+        store2.entries()  # ...and reads still fail loudly
+
+
+# -- property test: random interleavings ----------------------------------
+
+
+@pytest.mark.parametrize("seed", [1, 7, 2026])
+def test_random_interleavings_preserve_completed_set(tmp_path, seed):
+    """Random save/claim/complete/checkpoint/crash/restore sequences:
+    after every crash+restore, the restored completed set equals an
+    index replay exactly (no lost tiles, no phantom completions)."""
+    rng = random.Random(seed)
+    settings = [LevelSetting(4, 50)]
+    levels = [4]
+    store = ChunkStore(str(tmp_path / f"s{seed}"))
+    store.setup()
+    clock = ManualClock()
+    sched = TileScheduler(settings, clock=clock, lease_timeout=30.0)
+    pending: set = set()  # accepted tiles whose save has not landed
+    mgr = RecoveryManager(store, sched, generation=1,
+                          pending_keys_fn=lambda: set(pending))
+
+    for _ in range(300):
+        op = rng.choice(["accept", "accept", "persist", "persist",
+                         "lease", "advance", "checkpoint", "crash"])
+        if op == "accept":
+            w = sched.acquire()
+            if w is not None and sched.complete(w):
+                pending.add(w.key)
+        elif op == "persist" and pending:
+            key = pending.pop()
+            store.save(Chunk.never(*key))
+        elif op == "lease":
+            sched.acquire()  # grant and abandon (expires later)
+        elif op == "advance":
+            clock.advance(rng.uniform(0.0, 20.0))
+        elif op == "checkpoint":
+            mgr.checkpoint_sync()
+        elif op == "crash":
+            # The process dies: in-flight saves and the scheduler vanish.
+            pending.clear()
+            res = load_restore_state(store, settings)
+            assert res.completed == store.completed_keys(levels=levels), \
+                f"restore diverged from index replay (seed={seed})"
+            clock = ManualClock()
+            sched = TileScheduler(settings, completed=res.completed,
+                                  clock=clock, lease_timeout=30.0)
+            res.apply(sched)
+            mgr = RecoveryManager(store, sched,
+                                  generation=res.generation,
+                                  pending_keys_fn=lambda: set(pending))
+
+    # Final crash: same invariant at the end of every sequence.
+    res = load_restore_state(store, settings)
+    assert res.completed == store.completed_keys(levels=levels)
+
+
+# -- worker reconnect backoff ---------------------------------------------
+
+
+def test_reconnect_backoff_schedule():
+    counters = Counters()
+    client = DistributerClient("127.0.0.1", 1, reconnect_attempts=4,
+                               reconnect_base=0.1, reconnect_cap=0.5,
+                               counters=counters,
+                               rng=random.Random(42))
+    sleeps: list = []
+    client._sleep = sleeps.append
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise OSError("connection refused")
+        return "ok"
+
+    assert client._with_reconnect(flaky) == "ok"
+    assert calls["n"] == 4
+    assert counters.get(obs_names.WORKER_RECONNECTS) == 3
+    # Capped exponential envelope with jitter in [0.5, 1.0): attempt n
+    # sleeps within (0.5, 1.0] * min(cap, base * 2^n).
+    for n, s in enumerate(sleeps):
+        hi = min(0.5, 0.1 * (2 ** n))
+        assert hi * 0.5 <= s < hi
+
+
+def test_reconnect_exhaustion_raises():
+    client = DistributerClient("127.0.0.1", 1, reconnect_attempts=2)
+    client._sleep = lambda _s: None
+
+    def always_down():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        client._with_reconnect(always_down)
+
+
+def test_reconnect_never_retries_protocol_errors():
+    from distributedmandelbrot_tpu.net import framing
+    client = DistributerClient("127.0.0.1", 1, reconnect_attempts=5)
+    client._sleep = lambda _s: pytest.fail("must not sleep")
+
+    def hostile():
+        raise framing.ProtocolError("garbage")
+
+    with pytest.raises(framing.ProtocolError):
+        client._with_reconnect(hostile)
+
+
+def test_reconnect_default_off():
+    # Historical fail-fast behavior is the default: port 1 refuses.
+    client = DistributerClient("127.0.0.1", 1, timeout=0.5)
+    client._sleep = lambda _s: pytest.fail("must not sleep")
+    with pytest.raises(OSError):
+        client.request()
+
+
+# -- kill-and-restart e2e --------------------------------------------------
+
+
+DRIVER = os.path.join(os.path.dirname(__file__), "coordinator_driver.py")
+E2E_LEVELS = "3:50"  # 9 tiles; 16 MiB payloads keep this honest but quick
+
+
+def _spawn_coordinator(data_dir, port_file, crashpoints=None,
+                       timeout=30.0):
+    env = dict(os.environ)
+    env.pop("DMTPU_CRASHPOINTS", None)
+    if crashpoints:
+        env["DMTPU_CRASHPOINTS"] = crashpoints
+    # python puts the driver's dir (tests/) on sys.path, not the repo
+    # root the package lives in.
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen([sys.executable, DRIVER, str(data_dir),
+                             str(port_file), E2E_LEVELS], env=env)
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(port_file):
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"coordinator died during startup: rc={proc.returncode}")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise AssertionError("coordinator did not write its ports")
+        time.sleep(0.05)
+    with open(port_file, encoding="utf-8") as f:
+        ports = json.load(f)
+    return proc, ports
+
+
+def _varz(port: int) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/varz", timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _wait_saved(port: int, n: int, timeout=30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _varz(port)["counters"].get("chunks_saved", 0) >= n:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"saves never reached {n}")
+
+
+def test_kill_and_restart_drains_exact_tile_set(tmp_path):
+    """The whole story end to end: a coordinator crashes at an armed
+    crashpoint mid-level (hard exit 86 after the 4th index append), a
+    restart on the same data dir restores from the checkpoint replaying
+    only the index suffix, an in-flight worker lands its pre-crash lease
+    against the restarted process, and the farm drains to the exact tile
+    set — no lost tiles, no stuck leases."""
+    pixels = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+    port_file = tmp_path / "ports1.json"
+    proc, ports = _spawn_coordinator(
+        tmp_path, port_file, crashpoints="store.after_index_append:4")
+    client = DistributerClient("127.0.0.1", ports["distributer"],
+                               timeout=10.0)
+    try:
+        # An in-flight worker: holds a lease across the crash.
+        w_held = client.request()
+        assert w_held is not None
+
+        # Two tiles land, then a checkpoint (so the restart has both a
+        # checkpointed prefix and a replayable suffix).
+        for _ in range(2):
+            w = client.request()
+            assert client.submit(w, pixels)
+        _wait_saved(ports["exporter"], 2)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports['exporter']}/checkpoint",
+            data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            stats = json.loads(resp.read().decode())
+        assert stats["completed"] == 2 and stats["leases"] == 1
+
+        # Keep submitting: the 4th index append hard-exits the process.
+        submitted_after = 0
+        try:
+            for _ in range(6):
+                w = client.request()
+                if w is None:
+                    break
+                if client.submit(w, pixels):
+                    submitted_after += 1
+                time.sleep(0.1)  # let the async save (and the crash) run
+        except OSError:
+            pass  # the process died under us — expected
+        assert proc.wait(timeout=30) == faults.CRASH_EXIT_CODE
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # Restart on the same data dir, no crashpoints.
+    port_file2 = tmp_path / "ports2.json"
+    proc2, ports2 = _spawn_coordinator(tmp_path, port_file2)
+    try:
+        varz = _varz(ports2["exporter"])
+        # Restored from the checkpoint: suffix-only replay (> 0 because
+        # tiles landed after the checkpoint, < total because the prefix
+        # came from the checkpoint), and the held lease was rebuilt.
+        counters = varz["counters"]
+        assert counters["coord_restores"] == 1
+        total_durable = 2 + 2  # pre-checkpoint + index appends 3 and 4
+        assert 0 < counters["coord_replay_entries"] < total_durable
+        assert counters["coord_restored_leases"] >= 1
+        assert varz["recovery"]["generation"] == 2
+
+        # The in-flight worker lands its pre-crash lease post-restart.
+        client2 = DistributerClient("127.0.0.1", ports2["distributer"],
+                                    timeout=10.0)
+        assert client2.submit(w_held, pixels)
+
+        # Drain the farm to completion.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            w = client2.request()
+            if w is None:
+                if _varz(ports2["exporter"])["scheduler"]["completed"] == 9:
+                    break
+                time.sleep(0.2)
+                continue
+            client2.submit(w, pixels)
+        sched = _varz(ports2["exporter"])["scheduler"]
+        assert sched["completed"] == sched["total"] == 9
+        assert sched["outstanding_leases"] == 0  # no stuck leases
+    finally:
+        proc2.kill()
+        proc2.wait()
+
+    # The exact tile set, from the index itself.
+    store = ChunkStore(str(tmp_path))
+    store.setup()
+    assert store.completed_keys() == {(3, i, j)
+                                      for i in range(3) for j in range(3)}
