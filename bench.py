@@ -983,7 +983,7 @@ def _phase_sums(registry, family: str, label: str) -> dict:
 def bench_farm(repeats: int, *, levels: str = "3:1000",
                definition: int = 4096, batch_size: int = 3,
                backend_name: str = "auto", window: int = 8,
-               depth: int = 2) -> dict:
+               depth: int = 2, upload_lanes: int = 0) -> dict:
     """Production shape: coordinator + worker over loopback TCP, 4096^2
     chunks, batched dispatch, full pipeline (lease -> compute -> upload ->
     persist).  Real materialization everywhere — on this rig the device->
@@ -1022,7 +1022,8 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
                                     definition=definition)
         client = DistributerClient("127.0.0.1", co.distributer_port)
         worker = Worker(client, backend, batch_size=batch_size,
-                        overlap_io=True, window=window, depth=depth)
+                        overlap_io=True, window=window, depth=depth,
+                        upload_lanes=upload_lanes)
         # warmup: compile the kernel outside the timed window
         from distributedmandelbrot_tpu.core.workload import Workload
         backend.compute_batch([Workload(settings[0].level,
@@ -1120,6 +1121,17 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
             out[f"pipe_{name}_busy_s"] = st["busy_s"]
             out[f"pipe_{name}_occupancy"] = st["occupancy"]
             out[f"pipe_{name}_bubble"] = st["bubble"]
+        for i, lane in enumerate(stage_stats.get("lanes", [])):
+            out[f"pipe_lane{i}_occupancy"] = lane["occupancy"]
+    # Wire accounting for the session tier: bytes that actually crossed
+    # the socket per codec, and blocking round trips per tile (the
+    # 1-RTT-steady-state target of the lease piggyback).
+    out["farm_wire_raw_bytes"] = wc.get(obs_names.WIRE_RAW_BYTES, 0)
+    out["farm_wire_compressed_bytes"] = \
+        wc.get(obs_names.WIRE_COMPRESSED_BYTES, 0)
+    out["farm_rtts_per_tile"] = round(
+        wc.get(obs_names.WORKER_WIRE_RTTS, 0) / n_tiles, 2)
+    out["farm_sessions"] = wc.get(obs_names.WORKER_SESSIONS_OPENED, 0)
     if farm_trace.get("tiles"):
         out["farm_trace_tiles"] = farm_trace["tiles"]
         out["farm_trace_attributed"] = farm_trace["attributed_tiles"]
@@ -1129,6 +1141,109 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
             out[f"farm_trace_{phase}_share"] = \
                 farm_trace[f"{phase}_share"]
     out.update(hist)
+    return out
+
+
+def bench_farm_multi(repeats: int, *, workers: int = 4,
+                     levels: str = "3:1000", definition: int = 4096,
+                     batch_size: int = 3, backend_name: str = "auto",
+                     window: int = 8, depth: int = 2,
+                     upload_lanes: int = 0) -> dict:
+    """The real farm shape: N worker *subprocesses* racing one
+    coordinator over loopback TCP, each with its own device context,
+    pipelined executor, and session lanes.  Aggregate Mpix/s is wall
+    clock from first spawn to the last chunk fsynced; per-worker wire
+    and lane metrics come back through ``dmtpu worker --stats-json``
+    (subprocess counters are invisible to this process otherwise), and
+    critical-path attribution joins the coordinator's trace with every
+    worker's pushed spans exactly as the single-worker config does."""
+    import os
+    import subprocess
+    import tempfile
+
+    from distributedmandelbrot_tpu.cli import parse_level_settings
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    from distributedmandelbrot_tpu.obs import names as obs_names
+    from distributedmandelbrot_tpu.obs.spans import critical_path
+
+    settings = parse_level_settings(levels)
+    n_tiles = sum(s.level * s.level for s in settings)
+    with tempfile.TemporaryDirectory() as tmp, \
+            EmbeddedCoordinator(tmp, settings) as co:
+        stats_paths = [os.path.join(tmp, f"worker{i}-stats.json")
+                       for i in range(workers)]
+        log_paths = [os.path.join(tmp, f"worker{i}.log")
+                     for i in range(workers)]
+        cmd = [sys.executable, "-m", "distributedmandelbrot_tpu", "worker",
+               "--host", "127.0.0.1", "--port", str(co.distributer_port),
+               "--backend", backend_name, "--batch-size", str(batch_size),
+               "--window", str(window), "--depth", str(depth),
+               "--upload-lanes", str(upload_lanes)]
+        t0 = time.perf_counter()
+        procs = []
+        for stats_path, log_path in zip(stats_paths, log_paths):
+            log = open(log_path, "w")
+            procs.append((subprocess.Popen(
+                cmd + ["--stats-json", stats_path],
+                stdout=log, stderr=subprocess.STDOUT), log))
+        try:
+            for proc, log in procs:
+                rc = proc.wait(timeout=1800)
+                log.close()
+                if rc != 0:
+                    tail = open(log.name).read()[-2000:]
+                    raise RuntimeError(
+                        f"worker subprocess exited {rc}:\n{tail}")
+        finally:
+            for proc, log in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                if not log.closed:
+                    log.close()
+        co.wait_saves_settled(expected_accepted=n_tiles, timeout=600)
+        total = time.perf_counter() - t0
+        cc = co.counters.snapshot()
+        farm_trace = critical_path(co.trace.spans(), co.spans)
+        per_worker = []
+        for stats_path in stats_paths:
+            with open(stats_path, encoding="utf-8") as fh:
+                per_worker.append(json.load(fh))
+
+    def wsum(key: str) -> int:
+        return sum(w["counters"].get(key, 0) for w in per_worker)
+
+    pixels = n_tiles * definition * definition
+    out = {"metric": f"farm e2e {levels} {n_tiles}x{definition}^2 "
+                     f"{workers} workers (subprocess, pipelined "
+                     f"w{window}d{depth}, incl. upload + persist)",
+           "value": round(_mpix(pixels, total), 2), "unit": "Mpix/s",
+           "total_s": round(total, 2),
+           "farm_workers": workers,
+           "tiles_per_worker": [
+               w["counters"].get("tiles_computed", 0) for w in per_worker],
+           "farm_wire_raw_bytes": wsum(obs_names.WIRE_RAW_BYTES),
+           "farm_wire_compressed_bytes":
+               wsum(obs_names.WIRE_COMPRESSED_BYTES),
+           "farm_rtts_per_tile": round(
+               wsum(obs_names.WORKER_WIRE_RTTS) / n_tiles, 2),
+           "farm_sessions": wsum(obs_names.WORKER_SESSIONS_OPENED),
+           "farm_session_fallbacks":
+               wsum(obs_names.WORKER_SESSION_FALLBACKS),
+           "coord_connections":
+               cc.get(obs_names.COORD_CONNECTIONS_ACCEPTED, 0),
+           "persist_s": round(cc.get("persist_us", 0) / 1e6, 2)}
+    for i, w in enumerate(per_worker):
+        for j, lane in enumerate(
+                w.get("stage_stats", {}).get("lanes", [])):
+            out[f"pipe_w{i}_lane{j}_occupancy"] = lane["occupancy"]
+    if farm_trace.get("tiles"):
+        out["farm_trace_tiles"] = farm_trace["tiles"]
+        out["farm_trace_attributed"] = farm_trace["attributed_tiles"]
+        for phase in ("queue", "compute", "d2h", "upload", "persist",
+                      "other"):
+            out[f"farm_trace_{phase}_s"] = farm_trace[f"{phase}_s"]
+            out[f"farm_trace_{phase}_share"] = \
+                farm_trace[f"{phase}_share"]
     return out
 
 
@@ -1391,6 +1506,15 @@ def main() -> int:
     parser.add_argument("--farm-depth", type=int, default=2,
                         help="kernels in flight per device for the farm "
                              "config's pipelined executor")
+    parser.add_argument("--farm-workers", type=int, default=0,
+                        help="run the farm config with N worker "
+                             "subprocesses against one coordinator "
+                             "(aggregate Mpix/s + per-worker wire/lane "
+                             "metrics); 0 = single in-process worker")
+    parser.add_argument("--farm-lanes", type=int, default=0,
+                        help="parallel upload lanes per worker for the "
+                             "farm config (0 = one per local device, "
+                             "capped at 4)")
     parser.add_argument("--serve", action="store_true",
                         help="run only the serving-gateway config "
                              "(cold-miss, warm-hit, coalesced-storm)")
@@ -1425,8 +1549,16 @@ def main() -> int:
         print(json.dumps(result), flush=True)
 
     if args.farm:
-        emit(bench_farm(args.repeats, backend_name=args.farm_backend,
-                        window=args.farm_window, depth=args.farm_depth))
+        if args.farm_workers > 0:
+            emit(bench_farm_multi(args.repeats, workers=args.farm_workers,
+                                  backend_name=args.farm_backend,
+                                  window=args.farm_window,
+                                  depth=args.farm_depth,
+                                  upload_lanes=args.farm_lanes))
+        else:
+            emit(bench_farm(args.repeats, backend_name=args.farm_backend,
+                            window=args.farm_window, depth=args.farm_depth,
+                            upload_lanes=args.farm_lanes))
         return 0
 
     if args.serve:

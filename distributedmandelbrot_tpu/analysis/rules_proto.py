@@ -30,6 +30,16 @@ agrees:
   size — a raw ``sock.recv(n)`` feed is the classic short-read bug,
   and a read sized by a *different* struct is cross-copy drift.
 
+Stream upgrades: a purpose byte listed in :data:`STREAM_FRAME_SYMBOLS`
+turns the connection into a long-lived multiplexed frame stream after
+its hello (``PURPOSE_SESSION``).  Source order stops modeling wire
+order there — the server loops over interleaved frame types while the
+client interleaves pipelined uploads with acks — so sequence parity
+checks the hello prefix only: both sides' op lists are truncated at
+the first op carrying the stream's frame-header struct
+(``SESSION_FRAME``).  Everything inside the stream remains covered by
+``proto-exact-read`` and the ``wire-*`` size checks.
+
 Known resolution limit (documented in README): the gateway's
 magic-sniffing dual framing (``serve/gateway.py`` reads a bare u32 and
 *then* decides legacy-vs-batch) has no purpose byte, so it takes part
@@ -71,6 +81,12 @@ QUERY_EXCHANGES = (
      f"{PACKAGE}/viewer/client.py::DataClient._fetch_once",
      f"{PACKAGE}/coordinator/dataserver.py::DataServer._handle_connection"),
 )
+
+# Purpose bytes that upgrade the connection to a multiplexed frame
+# stream after their hello, mapped to the stream's frame-header struct.
+# Sequence parity for these compares the hello prefix only: both sides
+# truncate at the first op carrying the frame-header symbol.
+STREAM_FRAME_SYMBOLS = {"PURPOSE_SESSION": "SESSION_FRAME"}
 
 # Frame-sequence wildcard: a payload whose length is data-dependent.
 WILD = "?"
@@ -311,6 +327,16 @@ class _Extractor:
 
 # -- sequence comparison ---------------------------------------------------
 
+def _stream_prefix(ops: list[Op], symbol: str) -> list[Op]:
+    """Ops up to (excluding) the first one carrying a stream's
+    frame-header struct — the point where source order stops modeling
+    wire order (see :data:`STREAM_FRAME_SYMBOLS`)."""
+    for i, op in enumerate(ops):
+        if op.symbol == symbol:
+            return ops[:i]
+    return ops
+
+
 def _first_occurrence(ops: list[Op], direction: str) -> list[str]:
     seen: list[str] = []
     for op in ops:
@@ -492,10 +518,15 @@ def check(project: Project) -> list[Finding]:
 
     # Frame-sequence parity: each emitter against each dispatch arm.
     for purpose, emitter_quals in sorted(extractor.emitters.items()):
+        stream_symbol = STREAM_FRAME_SYMBOLS.get(purpose)
         for arm in arms_by_purpose.get(purpose, []):
             server_ops, _ = extractor.body_ops(arm.body)
+            if stream_symbol is not None:
+                server_ops = _stream_prefix(server_ops, stream_symbol)
             for emitter in sorted(emitter_quals):
                 client_ops, _ = extractor.function_ops(emitter)
+                if stream_symbol is not None:
+                    client_ops = _stream_prefix(client_ops, stream_symbol)
                 findings.extend(_frame_findings(
                     purpose, emitter, client_ops, arm.relpath, arm.line,
                     server_ops, table, frames_rule))

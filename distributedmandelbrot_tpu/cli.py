@@ -713,6 +713,20 @@ def cmd_worker(argv: Sequence[str]) -> int:
     parser.add_argument("--depth", type=int, default=2,
                         help="pipelined executor: kernels in flight per "
                              "device (default: 2 — double-buffered)")
+    parser.add_argument("--upload-lanes", type=int, default=0,
+                        help="parallel upload threads, each holding one "
+                             "persistent session to the coordinator "
+                             "(default 0 = one per local device, capped "
+                             "at 4)")
+    parser.add_argument("--no-session", action="store_true",
+                        help="force the legacy connection-per-exchange "
+                             "wire protocol even against a session-"
+                             "capable coordinator")
+    parser.add_argument("--stats-json", metavar="PATH", default="",
+                        help="on a drained exit, dump the worker's counter "
+                             "snapshot and pipeline stage stats to PATH as "
+                             "JSON (how bench.py --farm-workers collects "
+                             "per-subprocess wire/lane metrics)")
     parser.add_argument("--reconnect", type=int, default=0, metavar="N",
                         help="redial the coordinator up to N times per "
                              "exchange on connection failure (capped "
@@ -805,7 +819,9 @@ def cmd_worker(argv: Sequence[str]) -> int:
     worker = Worker(DistributerClient(args.host, args.port,
                                       reconnect_attempts=args.reconnect),
                     backend,
-                    batch_size=batch_size, window=window, depth=args.depth)
+                    batch_size=batch_size, window=window, depth=args.depth,
+                    upload_lanes=args.upload_lanes,
+                    use_session=not args.no_session)
     profiling = False
     if args.profile:
         import jax
@@ -828,6 +844,13 @@ def cmd_worker(argv: Sequence[str]) -> int:
                 print(f"pipeline stage occupancy: {occ} "
                       f"(window={worker.window}, depth={worker.depth})",
                       flush=True)
+            if args.stats_json:
+                import json
+                payload = {"counters": stats, "rounds": rounds}
+                if worker.pipeline is not None:
+                    payload["stage_stats"] = worker.pipeline.stage_stats()
+                with open(args.stats_json, "w", encoding="utf-8") as fh:
+                    json.dump(payload, fh)
     except KeyboardInterrupt:
         pass
     except OSError as e:

@@ -82,3 +82,32 @@ class RleCodec:
             return native.rle_encoded_size(data)
         counts, _ = find_runs(data)
         return counts.size * _REC_DTYPE.itemsize
+
+
+# Strided sample width for the histogram pre-filter below.  Coarse on
+# purpose: the sample only has to distinguish "one escape count
+# dominates" from "boundary soup", not count runs.
+_SAMPLE_STRIDE = 64
+
+
+def estimate_ratio(data: np.ndarray, min_ratio: float = 2.0) -> float:
+    """Cheap estimate of ``data.size / rle_encoded_size`` for the wire tier.
+
+    Two stages, both vectorized.  First an escape-count histogram over a
+    1/64 strided sample: a compression ratio of ``min_ratio`` needs a
+    mean run length of ``5 * min_ratio`` pixels, which forces some single
+    value (in practice the interior's max-iter count) to hold a large
+    share of the tile — if no value reaches half the sample, the tile is
+    boundary soup and RLE cannot win, so bail out reporting 1.0 without
+    touching the full 16 MiB.  Only plausible tiles pay for the exact
+    run count (one boundary-detection pass).
+    """
+    flat = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    if flat.size == 0:
+        return 1.0
+    sample = flat[::_SAMPLE_STRIDE]
+    top_share = np.bincount(sample, minlength=256).max() / sample.size
+    if top_share < 0.5:
+        return 1.0
+    boundaries = int(np.count_nonzero(flat[1:] != flat[:-1]))
+    return flat.size / float((boundaries + 1) * _REC_DTYPE.itemsize)

@@ -59,6 +59,7 @@ class Coordinator:
                  ondemand_deadline: float = proto.DEFAULT_ONDEMAND_DEADLINE,
                  exporter_port: Optional[int] = None,
                  accept_spans: bool = True,
+                 accept_session: bool = True,
                  checkpoint_period: float = 0.0) \
             -> None:
         # One registry + one trace ring + one span store feed every layer
@@ -116,7 +117,8 @@ class Coordinator:
                                            counters=self.counters,
                                            trace=self.trace,
                                            spans=self.spans,
-                                           accept_spans=accept_spans)
+                                           accept_spans=accept_spans,
+                                           accept_session=accept_session)
             self.dataserver = DataServer(self.store, host=host,
                                          port=dataserver_port,
                                          read_timeout=read_timeout,

@@ -28,6 +28,7 @@ from typing import Optional
 
 import numpy as np
 
+from distributedmandelbrot_tpu.codecs.rle import RleCodec
 from distributedmandelbrot_tpu.coordinator.scheduler import (Key,
                                                              TileScheduler)
 from distributedmandelbrot_tpu.core.chunk import Chunk
@@ -80,6 +81,7 @@ class Distributer:
                  trace: Optional[TraceLog] = None,
                  spans: Optional[SpanStore] = None,
                  accept_spans: bool = True,
+                 accept_session: bool = True,
                  on_chunk_saved=None) -> None:
         self.scheduler = scheduler
         self.store = store
@@ -95,6 +97,11 @@ class Distributer:
         # 0x04 extension (unknown purpose byte -> drop the connection) —
         # the degradation path the worker tests drive.
         self.accept_spans = accept_spans
+        # Same switch for the 0x05 session extension: False drops the
+        # hello, which is what pushes a session-capable worker onto its
+        # connection-per-exchange fallback.
+        self.accept_session = accept_session
+        self._rle = RleCodec()
         # Optional ``callback(key)`` fired on this event loop after a chunk
         # is durably persisted — the gateway's on-demand path hangs its
         # arrival notification here.
@@ -157,6 +164,9 @@ class Distributer:
     async def _handle_connection(self, reader: asyncio.StreamReader,
                                  writer: asyncio.StreamWriter) -> None:
         peer = writer.get_extra_info("peername")
+        # Counted at accept so the session e2e can assert the steady
+        # state really is one connection per worker lane.
+        self.counters.inc(obs_names.COORD_CONNECTIONS_ACCEPTED)
         try:
             while True:
                 try:
@@ -179,6 +189,9 @@ class Distributer:
                     await self._handle_batch_response(reader, writer)
                 elif purpose == proto.PURPOSE_SPANS and self.accept_spans:
                     await self._handle_spans(reader, writer)
+                elif purpose == proto.PURPOSE_SESSION and self.accept_session:
+                    await self._handle_session(reader, writer)
+                    break  # a session consumes the connection; EOF follows
                 else:
                     logger.error("unknown purpose byte %#x from %s",
                                  purpose, peer)
@@ -246,6 +259,15 @@ class Distributer:
     async def _handle_spans(self, reader: asyncio.StreamReader,
                             writer: asyncio.StreamWriter) -> None:
         """Ingest one worker span report (PURPOSE_SPANS, 0x04)."""
+        worker_id, sync_data, span_data = await self._read_span_report(reader)
+        self._ingest_span_report(worker_id, sync_data, span_data)
+        framing.write_byte(writer, proto.SPANS_ACCEPT)
+
+    async def _read_span_report(self, reader: asyncio.StreamReader,
+                                declared: Optional[int] = None):
+        """Read one span report body (shared by the 0x04 exchange and the
+        session's FRAME_SPANS, which also cross-checks the frame header's
+        declared length against the report's own counts)."""
         hdr = await self._read(
             framing.read_exact(reader, proto.SPANS_HEADER_WIRE_SIZE))
         worker_id, n_sync, n_spans = proto.SPANS_HEADER.unpack(hdr)
@@ -253,10 +275,20 @@ class Distributer:
             n_sync, MAX_SPANS, f"sync count from worker {worker_id:016x}")
         n_spans = proto.validate_count(
             n_spans, MAX_SPANS, f"span count from worker {worker_id:016x}")
+        if declared is not None and declared != (
+                proto.SPANS_HEADER_WIRE_SIZE
+                + n_sync * proto.SPAN_SYNC_WIRE_SIZE
+                + n_spans * proto.SPAN_RECORD_WIRE_SIZE):
+            raise framing.ProtocolError(
+                f"span frame length {declared} disagrees with its counts")
         sync_data = await self._read(framing.read_exact(
             reader, n_sync * proto.SPAN_SYNC_WIRE_SIZE))
         span_data = await self._read(framing.read_exact(
             reader, n_spans * proto.SPAN_RECORD_WIRE_SIZE))
+        return worker_id, sync_data, span_data
+
+    def _ingest_span_report(self, worker_id: int, sync_data: bytes,
+                            span_data: bytes) -> None:
         for level, ir, ii, t_req, t_recv in \
                 proto.SPAN_SYNC.iter_unpack(sync_data):
             c_grant = self.spans.grant_time((level, ir, ii))
@@ -278,7 +310,187 @@ class Distributer:
         self.counters.inc(obs_names.COORD_SPANS_INGESTED,
                           self.spans.ingest(worker_id, records))
         self.counters.inc(obs_names.COORD_SPAN_REPORTS)
-        framing.write_byte(writer, proto.SPANS_ACCEPT)
+
+    # -- persistent session (PURPOSE_SESSION, 0x05) ------------------------
+
+    async def _handle_session(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> None:
+        """Run one persistent multiplexed session until the peer hangs up.
+
+        Hello first (echoing the negotiated capability subset), then a
+        frame loop: lease requests, uploads (whose acks may piggyback
+        fresh grants), span reports.  Client frames must arrive with
+        strictly incrementing seqs; any violation or malformed frame
+        raises ProtocolError, which drops the whole session.
+        """
+        hello = await self._read(
+            framing.read_exact(reader, proto.SESSION_HELLO_WIRE_SIZE))
+        (offered,) = proto.SESSION_HELLO.unpack(hello)
+        negotiated = offered & proto.SESSION_FLAG_RLE
+        framing.write_byte(writer, proto.SESSION_ACCEPT)
+        writer.write(proto.SESSION_HELLO.pack(negotiated))
+        await writer.drain()
+        self.counters.inc(obs_names.COORD_SESSIONS_OPENED)
+        peer = _peer_id(writer)
+        expected_seq = 0
+        while True:
+            try:
+                hdr = await self._read(framing.read_exact(
+                    reader, proto.SESSION_FRAME_WIRE_SIZE))
+            except (ConnectionError, TimeoutError, asyncio.TimeoutError):
+                return  # clean end of session (EOF or idle between frames)
+            frame_type, seq, length = proto.SESSION_FRAME.unpack(hdr)
+            proto.validate_session_seq(seq, expected_seq)
+            expected_seq = (expected_seq + 1) & proto.MAX_SESSION_SEQ
+            length = proto.validate_payload_length(length)
+            self.counters.inc(obs_names.COORD_SESSION_FRAMES)
+            if frame_type == proto.FRAME_LEASE_REQ:
+                await self._session_lease(reader, writer, seq, length)
+            elif frame_type == proto.FRAME_UPLOAD:
+                await self._session_upload(reader, writer, seq, length,
+                                           negotiated, peer)
+            elif frame_type == proto.FRAME_SPANS:
+                await self._session_spans(reader, length)
+            else:
+                raise framing.ProtocolError(
+                    f"unknown session frame type {frame_type:#x}")
+            await writer.drain()
+
+    async def _session_lease(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter,
+                             seq: int, length: int) -> None:
+        if length != 4:
+            raise framing.ProtocolError(
+                f"lease request frame length {length}, expected 4")
+        count = proto.validate_count(
+            await self._read(framing.read_u32(reader)), MAX_BATCH,
+            "session lease count")
+        with self.registry.timed(obs_names.HIST_GRANT_SECONDS):
+            grants = self.scheduler.acquire_batch(count) if count else []
+        if not grants:
+            self.counters.inc("requests_denied")
+        writer.write(proto.SESSION_FRAME.pack(
+            proto.FRAME_LEASE_GRANT, seq,
+            4 + len(grants) * WORKLOAD_WIRE_SIZE))
+        self._write_grant_list(writer, grants, _peer_id(writer))
+
+    def _write_grant_list(self, writer: asyncio.StreamWriter, grants,
+                          peer: Optional[str]) -> None:
+        framing.write_u32(writer, len(grants))
+        t_grant = time.monotonic()
+        for w in grants:
+            writer.write(w.to_wire())
+            self.trace.record("granted", w.key, worker=peer)
+            self.spans.note_grant(w.key, t_grant)
+        if grants:
+            self.counters.inc("workloads_granted", len(grants))
+
+    def _write_upload_ack(self, writer: asyncio.StreamWriter, seq: int,
+                          flag: int, want: int, peer: Optional[str]) -> None:
+        """Accept/reject ack for one upload, piggybacking up to ``want``
+        fresh grants — the steady-state replacement for a separate lease
+        round trip."""
+        if want:
+            with self.registry.timed(obs_names.HIST_GRANT_SECONDS):
+                grants = self.scheduler.acquire_batch(want)
+        else:
+            grants = []
+        writer.write(proto.SESSION_FRAME.pack(
+            proto.FRAME_UPLOAD_ACK, seq,
+            1 + 4 + len(grants) * WORKLOAD_WIRE_SIZE))
+        framing.write_byte(writer, flag)
+        self._write_grant_list(writer, grants, peer)
+
+    async def _session_upload(self, reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter, seq: int,
+                              length: int, negotiated: int,
+                              peer: Optional[str]) -> None:
+        t_accept = time.monotonic()
+        min_len = WORKLOAD_WIRE_SIZE + proto.UPLOAD_HEADER_WIRE_SIZE
+        if length < min_len:
+            raise framing.ProtocolError(
+                f"upload frame length {length} below header size {min_len}")
+        w = Workload.from_wire(await self._read(
+            framing.read_exact(reader, WORKLOAD_WIRE_SIZE)))
+        codec, want = proto.UPLOAD_HEADER.unpack(await self._read(
+            framing.read_exact(reader, proto.UPLOAD_HEADER_WIRE_SIZE)))
+        want = proto.validate_count(want, MAX_BATCH, "piggyback lease count")
+        body_len = length - min_len
+        if codec == proto.WIRE_CODEC_RAW:
+            # An RLE body's length is data-dependent (bounded by the
+            # already-validated frame length); a raw body is exact.
+            if body_len != CHUNK_PIXELS:
+                raise framing.ProtocolError(
+                    f"raw upload body {body_len}, expected {CHUNK_PIXELS}")
+        elif codec == proto.WIRE_CODEC_RLE:
+            if not negotiated & proto.SESSION_FLAG_RLE:
+                raise framing.ProtocolError(
+                    "RLE upload on a session that did not negotiate it")
+        else:
+            raise framing.ProtocolError(f"unknown wire codec {codec:#x}")
+        token = self.scheduler.claim(w)
+        if token is None:
+            # Stale or unknown lease: the body still has to be drained to
+            # keep the frame stream in sync before the reject ack.
+            await self._read(framing.read_exact(reader, body_len))
+            self.counters.inc(obs_names.COORD_RESULTS_REJECTED)
+            logger.info("rejected result for %s (stale or unknown lease)", w)
+            self._write_upload_ack(writer, seq, proto.RESPONSE_REJECT,
+                                   want, peer)
+            return
+        try:
+            body = await self._read(framing.read_exact(reader, body_len))
+        except (ConnectionError, TimeoutError, asyncio.TimeoutError,
+                framing.ProtocolError):
+            self.scheduler.release_claim(w, token)
+            self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
+            logger.info("dropped result for %s (session upload stalled "
+                        "or connection lost)", w)
+            raise
+        if codec == proto.WIRE_CODEC_RLE:
+            t0 = time.monotonic()
+            try:
+                # Decode off the loop: np.repeat of 16 Mi pixels is
+                # milliseconds of pure CPU the other sessions shouldn't
+                # stall behind.  The decoder itself rejects bombs — the
+                # run counts must sum to exactly CHUNK_PIXELS before
+                # anything is allocated at that size.
+                pixels = await asyncio.to_thread(
+                    self._rle.decode, body, CHUNK_PIXELS)
+            except ValueError as e:
+                self.scheduler.release_claim(w, token)
+                self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
+                raise framing.ProtocolError(
+                    f"bad RLE body for {w}: {e}") from None
+            self.registry.observe(obs_names.HIST_COORD_DECODE_SECONDS,
+                                  time.monotonic() - t0)
+            self.counters.inc(obs_names.WIRE_COMPRESSED_BYTES, body_len)
+        else:
+            pixels = np.frombuffer(body, dtype=np.uint8)
+            self.counters.inc(obs_names.WIRE_RAW_BYTES, body_len)
+        if not self.scheduler.finish_claim(w, token):
+            self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
+            logger.info("dropped result for %s (lease expired mid-upload)", w)
+            self._write_upload_ack(writer, seq, proto.RESPONSE_REJECT,
+                                   want, peer)
+            return
+        self.counters.inc(obs_names.COORD_RESULTS_ACCEPTED)
+        self.registry.observe(obs_names.HIST_ACCEPT_SECONDS,
+                              time.monotonic() - t_accept)
+        self.trace.record("result_received", w.key, worker=peer)
+        chunk = Chunk(w.level, w.index_real, w.index_imag, pixels)
+        faults.hit("coord.between_accept_and_persist")
+        self._pending_saves.add(w.key)
+        task = asyncio.create_task(self._save_chunk(w, chunk))
+        self._save_tasks.add(task)
+        task.add_done_callback(self._save_tasks.discard)
+        self._write_upload_ack(writer, seq, proto.RESPONSE_ACCEPT, want, peer)
+
+    async def _session_spans(self, reader: asyncio.StreamReader,
+                             length: int) -> None:
+        worker_id, sync_data, span_data = await self._read_span_report(
+            reader, declared=length)
+        self._ingest_span_report(worker_id, sync_data, span_data)
 
     async def _handle_batch_response(self, reader: asyncio.StreamReader,
                                      writer: asyncio.StreamWriter) -> None:

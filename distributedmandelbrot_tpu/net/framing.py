@@ -35,8 +35,21 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def send_all(sock: socket.socket, data: bytes) -> None:
+def send_all(sock: socket.socket, data) -> None:
+    """Send a complete buffer (bytes or a memoryview — the zero-copy
+    upload path hands the ndarray's own buffer straight to the socket)."""
     sock.sendall(data)
+
+
+def send_parts(sock: socket.socket, parts) -> None:
+    """Send several buffers back to back without concatenating them.
+
+    The session upload frame is a small packed header followed by a
+    16 MiB pixel body; joining them would re-copy the body and defeat
+    the memoryview send path, so each part goes to ``sendall`` as-is.
+    """
+    for part in parts:
+        sock.sendall(part)
 
 
 def recv_u32(sock: socket.socket) -> int:

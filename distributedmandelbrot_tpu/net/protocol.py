@@ -27,6 +27,21 @@ Distributer protocol (default port 59010).  Connection purpose byte, then:
   purpose byte and drops the connection; the worker takes the EOF as
   "spans unsupported", disables the push permanently, and keeps working
   — tracing degrades, tiles don't.
+- ``PURPOSE_SESSION`` (0x05, extension): upgrade the connection to a
+  persistent multiplexed session.  Hello: client sends ``SESSION_HELLO``
+  (a u32 capability bitfield, ``SESSION_FLAG_*``); server replies
+  ``SESSION_ACCEPT`` + ``SESSION_HELLO`` echoing the negotiated subset.
+  From then on the connection carries ``SESSION_FRAME``-headed frames
+  (type u8, seq u16, payload length u32): lease requests/grants, result
+  uploads (raw or RLE bodies, per ``WIRE_CODEC_*``), upload acks that
+  may piggyback fresh lease grants (steady state: one round trip per
+  tile), and fire-and-forget span reports.  Client frames carry a
+  strictly incrementing (mod 2^16) seq; server reply frames echo the
+  seq of the frame they answer, which is how a pipelined worker
+  correlates N in-flight uploads with their accept flags.  A legacy
+  coordinator drops the connection on the unknown 0x05 byte; the
+  client takes the EOF during the hello as "sessions unsupported" and
+  falls back to connection-per-exchange.
 
 DataServer protocol (default port 59011): client sends 3 x uint32 LE
 ``(level, index_real, index_imag)``; server replies ``QUERY_ACCEPT`` +
@@ -50,6 +65,7 @@ PURPOSE_RESPONSE = 0x01
 PURPOSE_BATCH_REQUEST = 0x02  # extension
 PURPOSE_BATCH_RESPONSE = 0x03  # extension
 PURPOSE_SPANS = 0x04  # extension: worker span report push
+PURPOSE_SESSION = 0x05  # extension: persistent multiplexed session
 
 # Distributer: workload availability
 WORKLOAD_AVAILABLE = 0x10
@@ -63,6 +79,31 @@ RESPONSE_REJECT = 0x21
 # only: a coordinator that speaks 0x04 always ingests; one that doesn't
 # closes the connection, which is the worker's degradation signal.
 SPANS_ACCEPT = 0x30
+
+# Distributer: session hello acceptance (0x05 extension).  Like spans,
+# one code only — a coordinator that doesn't speak sessions closes the
+# connection instead, which is the client's fallback signal.
+SESSION_ACCEPT = 0x50
+
+# Session capability bitfield (SESSION_HELLO payload).  The server
+# replies with the intersection of what both sides offered; a bit the
+# server did not echo must never appear on the wire afterwards.
+SESSION_FLAG_RLE = 0x1  # uploads may carry WIRE_CODEC_RLE bodies
+
+# Session frame types (SESSION_FRAME.type).  Deliberately NOT named
+# ``PURPOSE_*``: frames live inside an established session, purposes
+# select a handler on a fresh connection — the proto-dispatch rule
+# discovers purposes by prefix and must not conflate the two layers.
+FRAME_LEASE_REQ = 0x01  # client->server: u32 max count
+FRAME_LEASE_GRANT = 0x02  # server->client: u32 n + n x 16-byte workloads
+FRAME_UPLOAD = 0x03  # client->server: workload echo + UPLOAD_HEADER + body
+FRAME_UPLOAD_ACK = 0x04  # server->client: accept byte + piggyback grants
+FRAME_SPANS = 0x05  # client->server: span report body; no ack
+
+# Upload result codecs (UPLOAD_HEADER.codec).  RLE reuses the storage
+# codec's body format (codecs/rle.py, code 0x01) so wire and disk agree.
+WIRE_CODEC_RAW = 0x00
+WIRE_CODEC_RLE = 0x01
 
 # DataServer: query status
 QUERY_ACCEPT = 0x00
@@ -114,6 +155,25 @@ SPAN_SYNC_WIRE_SIZE = 28
 SPAN_RECORD = struct.Struct("<IIIBBHdd")
 SPAN_RECORD_WIRE_SIZE = 32
 
+# Session hello payload: one u32 capability bitfield (SESSION_FLAG_*),
+# sent by the client after PURPOSE_SESSION and echoed (masked) by the
+# server after SESSION_ACCEPT.
+SESSION_HELLO = struct.Struct("<I")
+SESSION_HELLO_WIRE_SIZE = 4
+# Session frame header: (frame type u8 FRAME_*, seq u16, payload length
+# u32).  Client seqs increment mod 2^16; server frames echo the seq of
+# the client frame they answer.
+SESSION_FRAME = struct.Struct("<BHI")
+SESSION_FRAME_WIRE_SIZE = 7
+# Upload frame sub-header, after the 16-byte workload echo: (codec u8
+# WIRE_CODEC_*, want_lease u32 — how many fresh grants to piggyback on
+# the ack), then the codec body.
+UPLOAD_HEADER = struct.Struct("<BI")
+UPLOAD_HEADER_WIRE_SIZE = 5
+
+# Client frame seqs wrap at the u16 the header carries.
+MAX_SESSION_SEQ = 0xFFFF
+
 # Wire codes for span stages (names live in obs/names.py; the wire uses
 # one byte).  Order matches the worker pipeline.
 SPAN_STAGE_PREFETCH = 0
@@ -153,6 +213,19 @@ def validate_count(n: int, bound: int, what: str = "count") -> int:
 def validate_payload_length(n: int) -> int:
     """Bound-check a response payload length before allocating for it."""
     return validate_count(n, MAX_PAYLOAD_BYTES, "payload length")
+
+
+def validate_session_seq(seq: int, expected: int) -> int:
+    """Check a session frame's seq against the stream position.
+
+    Client frames must arrive with strictly incrementing (mod 2^16)
+    seqs; a gap means a frame was lost or injected and every later
+    ack correlation would be wrong, so the session dies here.
+    """
+    if seq != expected:
+        raise ProtocolError(
+            f"session frame seq {seq}, expected {expected}")
+    return seq
 
 
 def query_in_range(level: int, index_real: int, index_imag: int) -> bool:

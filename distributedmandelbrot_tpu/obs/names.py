@@ -110,6 +110,30 @@ SPAN_UPLOAD = "upload"
 SPAN_STAGES = (SPAN_PREFETCH, SPAN_DISPATCH, SPAN_COMPUTE, SPAN_D2H,
                SPAN_UPLOAD)
 
+# -- persistent session wire (PURPOSE_SESSION, 0x05) -----------------------
+
+# Coordinator side: connections accepted (the session e2e asserts a
+# steady-state farm run stays at one per worker lane), sessions
+# upgraded, frames carried, and the off-loop RLE decode latency.
+COORD_CONNECTIONS_ACCEPTED = "coord_connections_accepted"
+COORD_SESSIONS_OPENED = "coord_sessions_opened"
+COORD_SESSION_FRAMES = "coord_session_frames"
+HIST_COORD_DECODE_SECONDS = "coord_decode_seconds"
+# Wire volume split by codec tier, counted identically on both ends
+# (coordinator: bodies ingested; worker: bodies sent) — the farm bench
+# reads the worker's to report the compression win.
+WIRE_RAW_BYTES = "wire_raw_bytes"
+WIRE_COMPRESSED_BYTES = "wire_compressed_bytes"
+# Worker side: sessions opened, fallbacks onto the legacy
+# connection-per-exchange path (legacy coordinator or mid-run session
+# loss), blocking round trips paid (lease exchanges + pipelined-ack
+# waits — the bench divides by tiles for farm_rtts_per_tile), and the
+# per-lane busy-time histogram behind the bench's lane occupancy.
+WORKER_SESSIONS_OPENED = "worker_sessions_opened"
+WORKER_SESSION_FALLBACKS = "worker_session_fallbacks"
+WORKER_WIRE_RTTS = "worker_wire_rtts"
+HIST_UPLOAD_LANE_BUSY_SECONDS = "worker_upload_lane_busy_seconds"
+
 # -- store ----------------------------------------------------------------
 
 HIST_STORE_READ_SECONDS = "store_read_seconds"

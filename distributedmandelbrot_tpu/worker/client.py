@@ -7,6 +7,14 @@ exchange, purpose byte first.  Adds the batched request/response exchanges
 opt-in reconnect policy (capped exponential backoff + jitter) so a
 coordinator restart — now survivable server-side thanks to
 checkpoint/restore — no longer kills the farm run from the client side.
+
+:class:`DistributerSession` is the persistent alternative: one
+``PURPOSE_SESSION`` (0x05) hello upgrades a single connection to a
+long-lived framed stream carrying lease grants, pipelined result
+uploads (with lease-request piggybacking on the acks — one round trip
+per tile steady-state), optional RLE-compressed tile bodies, and
+fire-and-forget span reports.  Against a legacy coordinator the hello
+EOFs and callers fall back to the connection-per-exchange client above.
 """
 
 from __future__ import annotations
@@ -20,12 +28,17 @@ T = TypeVar("T")
 
 import numpy as np
 
+from distributedmandelbrot_tpu.codecs.rle import RleCodec, estimate_ratio
 from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
 from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
 from distributedmandelbrot_tpu.net.protocol import WORKLOAD_WIRE_SIZE
 from distributedmandelbrot_tpu.obs import names as obs_names
+
+# A tile ships RLE only when the estimated (then exact) compression
+# ratio clears this bar — marginal wins don't pay for the decode.
+MIN_WIRE_RATIO = 2.0
 
 # Span stage name (obs/names.py) -> one-byte wire code, pipeline order.
 _STAGE_CODES = {
@@ -169,12 +182,22 @@ class DistributerClient:
     # -- result submission ------------------------------------------------
 
     @staticmethod
-    def _pixel_bytes(pixels: np.ndarray) -> bytes:
-        arr = np.ascontiguousarray(pixels, dtype=np.uint8).ravel()
+    def _pixel_bytes(pixels: np.ndarray):
+        """Flat byte buffer of one result tile, zero-copy when possible.
+
+        A C-contiguous uint8 array is handed to the socket as a
+        memoryview over its own buffer — ``tobytes()`` here used to copy
+        every 16 MiB tile once per upload.  Anything else (wrong dtype,
+        strided slice) pays one normalizing copy, as before.
+        """
+        arr = pixels
+        if not (isinstance(arr, np.ndarray) and arr.dtype == np.uint8
+                and arr.flags["C_CONTIGUOUS"]):
+            arr = np.ascontiguousarray(pixels, dtype=np.uint8)
         if arr.size != CHUNK_PIXELS:
             raise ValueError(
                 f"result must have {CHUNK_PIXELS} pixels, got {arr.size}")
-        return arr.tobytes()
+        return memoryview(arr).cast("B")
 
     def submit(self, workload: Workload, pixels: np.ndarray) -> bool:
         """Push one result; returns True if the coordinator accepted it."""
@@ -220,3 +243,195 @@ class DistributerClient:
                     raise framing.ProtocolError(
                         f"unexpected acceptance code {status:#x}")
         return accepted
+
+
+class DistributerSession:
+    """One persistent multiplexed session (``PURPOSE_SESSION``, 0x05).
+
+    Owned by a single thread (a pipeline upload lane or the lease
+    stage); nothing here is locked.  All methods raise ``OSError`` /
+    ``framing.ProtocolError`` when the session breaks — the owner
+    closes it and falls back to its legacy :class:`DistributerClient`.
+    """
+
+    def __init__(self, host: str, port: int, *,
+                 timeout: Optional[float] = 30.0,
+                 compress: bool = True, counters=None) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.compress_wanted = compress
+        self.counters = counters
+        self.flags = 0  # negotiated capability bits after connect()
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self._codec = RleCodec()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def connect(self) -> bool:
+        """Dial and run the hello.  False means the coordinator is
+        legacy (dropped the unknown 0x05 purpose byte) — the caller
+        should fall back to connection-per-exchange, not retry."""
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            upgraded = self._hello(sock)
+        except BaseException:
+            sock.close()
+            raise
+        if not upgraded:
+            sock.close()
+            self._inc(obs_names.WORKER_SESSION_FALLBACKS)
+            return False
+        self._sock = sock
+        self._seq = 0
+        self._inc(obs_names.WORKER_SESSIONS_OPENED)
+        return True
+
+    def _hello(self, sock: socket.socket) -> bool:
+        want = proto.SESSION_FLAG_RLE if self.compress_wanted else 0
+        framing.send_byte(sock, proto.PURPOSE_SESSION)
+        framing.send_all(sock, proto.SESSION_HELLO.pack(want))
+        try:
+            status = framing.recv_byte(sock)
+        except ConnectionError:
+            return False  # legacy coordinator: EOF on the unknown purpose
+        if status != proto.SESSION_ACCEPT:
+            raise framing.ProtocolError(
+                f"unexpected session hello reply {status:#x}")
+        (self.flags,) = proto.SESSION_HELLO.unpack(
+            framing.recv_exact(sock, proto.SESSION_HELLO_WIRE_SIZE))
+        return True
+
+    def close(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _inc(self, name: str, n: int = 1) -> None:
+        if self.counters is not None:
+            self.counters.inc(name, n)
+
+    # -- framing -----------------------------------------------------------
+
+    def _next_seq(self) -> int:
+        seq = self._seq
+        self._seq = (self._seq + 1) & proto.MAX_SESSION_SEQ
+        return seq
+
+    def _send_frame(self, frame_type: int, parts: Sequence) -> int:
+        seq = self._next_seq()
+        total = sum(len(p) for p in parts)
+        framing.send_parts(self._sock, [
+            proto.SESSION_FRAME.pack(frame_type, seq, total), *parts])
+        return seq
+
+    def _recv_frame_header(self, want_type: int, want_seq: int) -> int:
+        """Validated payload length of the expected reply frame."""
+        frame_type, seq, length = proto.SESSION_FRAME.unpack(
+            framing.recv_exact(self._sock, proto.SESSION_FRAME_WIRE_SIZE))
+        if frame_type != want_type:
+            raise framing.ProtocolError(
+                f"unexpected session frame type {frame_type:#x} "
+                f"(wanted {want_type:#x})")
+        proto.validate_session_seq(seq, want_seq)
+        return proto.validate_payload_length(length)
+
+    def _recv_grants(self, length: int, bound: int) -> list[Workload]:
+        """Grant list payload: u32 n + n workloads, cross-checked
+        against the frame header's declared length."""
+        n = proto.validate_count(framing.recv_u32(self._sock), bound,
+                                 "session grant count")
+        if length != 4 + n * WORKLOAD_WIRE_SIZE:
+            raise framing.ProtocolError(
+                f"grant frame length {length} disagrees with count {n}")
+        return [Workload.from_wire(
+            framing.recv_exact(self._sock, WORKLOAD_WIRE_SIZE))
+            for _ in range(n)]
+
+    # -- exchanges ---------------------------------------------------------
+
+    def request_batch(self, max_count: int) -> list[Workload]:
+        """Pull up to ``max_count`` workloads in one session round trip."""
+        seq = self._next_seq()
+        framing.send_all(self._sock, proto.SESSION_FRAME.pack(
+            proto.FRAME_LEASE_REQ, seq, 4))
+        framing.send_u32(self._sock, max_count)
+        length = self._recv_frame_header(proto.FRAME_LEASE_GRANT, seq)
+        grants = self._recv_grants(length, max_count)
+        self._inc(obs_names.WORKER_WIRE_RTTS)
+        return grants
+
+    def request(self) -> Optional[Workload]:
+        grants = self.request_batch(1)
+        return grants[0] if grants else None
+
+    def submit_pipelined(self, results: Sequence[tuple[Workload, np.ndarray]],
+                         want_lease: int = 0
+                         ) -> tuple[list[bool], list[Workload]]:
+        """Send every result, then collect the acks.
+
+        All uploads go out before the first ack is awaited, so the batch
+        costs one round trip; the last upload asks its ack to piggyback
+        up to ``want_lease`` fresh grants, which replaces the separate
+        lease round trip in steady state.
+        """
+        if not results:
+            return [], []
+        seqs = []
+        for i, (w, pixels) in enumerate(results):
+            body, codec = self._encode_body(pixels)
+            want = want_lease if i == len(results) - 1 else 0
+            seqs.append(self._send_frame(proto.FRAME_UPLOAD, [
+                w.to_wire(), proto.UPLOAD_HEADER.pack(codec, want), body]))
+        accepted: list[bool] = []
+        grants: list[Workload] = []
+        for seq in seqs:
+            length = self._recv_frame_header(proto.FRAME_UPLOAD_ACK, seq)
+            flag = framing.recv_byte(self._sock)
+            if flag not in (proto.RESPONSE_ACCEPT, proto.RESPONSE_REJECT):
+                raise framing.ProtocolError(
+                    f"unexpected acceptance code {flag:#x}")
+            accepted.append(flag == proto.RESPONSE_ACCEPT)
+            grants.extend(self._recv_grants(length - 1, want_lease))
+        self._inc(obs_names.WORKER_WIRE_RTTS)
+        return accepted, grants
+
+    def push_spans(self, worker_id: int, syncs, spans) -> bool:
+        """Span report as a fire-and-forget session frame.
+
+        No fresh connection and no ack round trip — and the clock-sync
+        samples inside it came from this session's own lease/ack round
+        trips, so span alignment costs nothing extra on this path.
+        """
+        buf = bytearray()
+        buf += proto.SPANS_HEADER.pack(worker_id, len(syncs), len(spans))
+        for key, t_req, t_recv in syncs:
+            buf += proto.SPAN_SYNC.pack(*key, t_req, t_recv)
+        for stage, key, t0, t1, device, seq in spans:
+            buf += proto.SPAN_RECORD.pack(*key, _STAGE_CODES[stage],
+                                          device, seq, t0, t1)
+        self._send_frame(proto.FRAME_SPANS, [bytes(buf)])
+        return True
+
+    def _encode_body(self, pixels: np.ndarray) -> tuple:
+        """(body, codec) for one tile, applying the compression bar."""
+        data = DistributerClient._pixel_bytes(pixels)
+        if self.flags & proto.SESSION_FLAG_RLE:
+            arr = np.frombuffer(data, dtype=np.uint8)
+            if estimate_ratio(arr, MIN_WIRE_RATIO) > MIN_WIRE_RATIO:
+                body = self._codec.encode(arr)
+                if len(body) * MIN_WIRE_RATIO <= len(data):
+                    self._inc(obs_names.WIRE_COMPRESSED_BYTES, len(body))
+                    return body, proto.WIRE_CODEC_RLE
+        self._inc(obs_names.WIRE_RAW_BYTES, len(data))
+        return data, proto.WIRE_CODEC_RAW

@@ -10,7 +10,7 @@ rate (1461 Mpix/s chained vs 610 Mpix/s end-to-end).
 This module replaces that two-stage overlap with a bounded in-flight
 window across four stages, one thread each, coupled by queues::
 
-    lease ──> dispatch ──> materialize ──> upload
+    lease ──> dispatch ──> materialize ──> upload lane 0..K-1
       │           │             │             │
       │           └ round-robins tiles over every local device,
       │             at most ``depth`` in flight per device
@@ -23,10 +23,26 @@ window across four stages, one thread each, coupled by queues::
       │                         │ reference immediately, so the
       │                         │ allocator recycles at most ``depth``
       │                         │ output buffers per chip
-      │                                       └ feeds ``submit_batch``
-      │                                         from a queue instead of
-      │                                         one join-before-next-
-      │                                         round thread
+      │                                       └ ``upload_lanes`` threads
+      │                                         share the queue; each
+      │                                         owns one persistent
+      │                                         session (one TCP
+      │                                         connect per lane per
+      │                                         run) when the
+      │                                         coordinator speaks
+      │                                         PURPOSE_SESSION
+
+    With a ``session_factory`` the upload lanes pipeline their batch
+    over a persistent session and piggyback a lease request on the last
+    upload's ack; granted tiles are counted into the window *before*
+    the uploaded batch retires (so the cap never undercounts) and
+    funneled through ``_grant_q`` back to the lease thread, which stays
+    the sole producer of the dispatch queue (keeping end-of-stream
+    ordering trivial).  Steady state then pays one round trip per tile
+    and ``upload_lanes + 1`` TCP connects per run; against a legacy
+    coordinator every session falls back to the shared
+    connection-per-exchange client and behavior is exactly the old
+    single-upload-thread pipeline, minus nothing.
 
     A crash in any stage stops the pipeline, flows shutdown sentinels
     through the queues, and re-raises from :meth:`PipelineExecutor.run`
@@ -152,7 +168,12 @@ class _StageStats:
 
 
 class PipelineExecutor:
-    """Bounded-window staged executor over one coordinator connection.
+    """Bounded-window staged executor over one coordinator endpoint.
+
+    With a ``session_factory``, the lease thread and each of the
+    ``upload_lanes`` lane threads hold one persistent session apiece
+    (``upload_lanes + 1`` TCP connects for the whole run); otherwise all
+    exchanges ride the shared connection-per-exchange ``client``.
 
     ``window`` caps tiles leased-but-unsubmitted across the whole
     pipeline (the lease stage's prefetch credit — what keeps one fat
@@ -168,20 +189,30 @@ class PipelineExecutor:
     def __init__(self, client: DistributerClient,
                  dispatcher: TileDispatcher, *,
                  window: int = 8, depth: int = 2, batch_size: int = 1,
+                 upload_lanes: int = 1,
                  counters: Optional[Counters] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 spans: Optional[SpanRecorder] = None) -> None:
+                 spans: Optional[SpanRecorder] = None,
+                 session_factory: Optional[Callable[[], object]] = None) \
+            -> None:
         if window < 1:
             raise ValueError("window must be >= 1")
         if depth < 1:
             raise ValueError("depth must be >= 1")
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
+        if upload_lanes < 1:
+            raise ValueError("upload_lanes must be >= 1")
         self.client = client
         self.dispatcher = dispatcher
         self.window = window
         self.depth = depth
         self.batch_size = batch_size
+        self.upload_lanes = upload_lanes
+        # Zero-arg callable yielding an UNCONNECTED DistributerSession
+        # (or duck-type); each upload lane and the lease thread open
+        # their own.  None keeps every exchange on ``client``.
+        self.session_factory = session_factory
         self.counters = counters if counters is not None else Counters()
         self.registry = self.counters.registry
         self._hist_labels = {"backend": dispatcher.label}
@@ -198,6 +229,10 @@ class PipelineExecutor:
         self._dispatch_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
         self._mat_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
         self._upload_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
+        # Piggybacked lease grants parked for the lease thread — the
+        # dispatch queue keeps exactly one producer, so the lease
+        # stage's end-of-stream sentinel still trails every workload.
+        self._grant_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
         # _cond guards the window account and the error list; every
         # blocking queue/semaphore/client call happens OUTSIDE it.
         self._cond = threading.Condition()
@@ -207,6 +242,10 @@ class PipelineExecutor:
         self._rounds = 0
         self._stats = {name: _StageStats(name)
                        for name in obs_names.PIPELINE_STAGES}
+        # Upload busy time is accounted per lane (one writer each);
+        # the STAGE_UPLOAD entry above stays zero and readers sum these.
+        self._lane_stats = [_StageStats(f"{obs_names.STAGE_UPLOAD}[{i}]")
+                            for i in range(upload_lanes)]
         self._t_start: Optional[float] = None
         self._t_end: Optional[float] = None
         self.clock = clock
@@ -250,32 +289,97 @@ class PipelineExecutor:
 
     # -- stages ------------------------------------------------------------
 
-    def _acquire(self, want: int) -> list[Workload]:
+    def _open_session(self, role: str):
+        """One persistent session for a stage thread, or None to stay on
+        the legacy client (no factory, or the coordinator declined the
+        hello).  Dial errors propagate — a dead coordinator fails the
+        legacy path identically, and the worker's reconnect loop owns
+        that case."""
+        if self.session_factory is None:
+            return None
+        session = self.session_factory()
+        if session.connect():
+            logger.debug("%s: persistent session open", role)
+            return session
+        logger.info("%s: coordinator declined session hello; "
+                    "using legacy exchanges", role)
+        return None
+
+    def _acquire(self, want: int, session=None) -> list[Workload]:
+        if session is not None and session.connected:
+            return session.request_batch(want)
         if want == 1:
             w = self.client.request()
             return [w] if w is not None else []
         return self.client.request_batch(want)
 
+    def _forward_grants(self) -> int:
+        """Move piggybacked grants into the dispatch queue (lease thread
+        only).  Their window slots were taken by the upload lane that
+        received them, so this is pure hand-off."""
+        n = 0
+        while True:
+            try:
+                w = self._grant_q.get_nowait()
+            except queue.Empty:
+                return n
+            self._dispatch_q.put(w)
+            n += 1
+
+    def _drain_wait(self, stop: Optional[threading.Event]) -> bool:
+        """The coordinator's frontier came up empty, but upload lanes may
+        still be landing piggybacked grants.  Park until either a grant
+        shows up (False: keep leasing) or every in-flight tile retired
+        with none pending (True: the run is over).  A queued grant holds
+        a window slot, so ``in_flight == 0`` implies the grant queue is
+        empty — the extra check is belt and braces."""
+        while not self._stopping(stop):
+            if self._forward_grants():
+                return False
+            with self._cond:
+                if self._in_flight == 0 and self._grant_q.empty():
+                    return True
+                self._cond.wait(timeout=_WAIT_SLICE_S)
+        return True
+
     def _lease_loop(self, poll_interval: float,
                     stop: Optional[threading.Event]) -> None:
         st = self._stats[obs_names.STAGE_LEASE]
+        session = self._open_session("lease")
+        try:
+            self._lease_loop_inner(poll_interval, stop, st, session)
+        finally:
+            if session is not None:
+                session.close()
+
+    def _lease_loop_inner(self, poll_interval: float,
+                          stop: Optional[threading.Event],
+                          st: _StageStats, session) -> None:
         while not self._stopping(stop):
+            self._forward_grants()
             with self._cond:
                 while self._in_flight >= self.window \
                         and not self._stopping(stop):
                     # Sliced so an EXTERNAL stop event (which notifies
-                    # nothing) is still noticed promptly.
+                    # nothing) is still noticed promptly; piggybacked
+                    # grants notify and are forwarded on wake-up.
+                    if not self._grant_q.empty():
+                        break
                     self._cond.wait(timeout=_WAIT_SLICE_S)
                 if self._stopping(stop):
                     return
                 room = self.window - self._in_flight
-            # Lease outside the lock: only this thread ever *adds* to the
-            # window, so ``room`` can only have grown meanwhile and the
-            # prefetch can never exceed ``window`` leases outstanding.
+            if room <= 0:
+                continue  # woken to forward grants, not to lease
+            # Lease outside the lock: only this thread and the upload
+            # lanes *add* to the window, and lanes net-shrink it (grants
+            # never exceed the batch they retire), so ``room`` can only
+            # have grown meanwhile and the prefetch can never exceed
+            # ``window`` leases outstanding.
             want = min(self.batch_size, room)
             s0 = self.spans.clock() if self.spans is not None else 0.0
             t0 = self.clock()
-            got = self._acquire(want)
+            got = self._acquire(want, session)
             dt = self.clock() - t0
             if self.spans is not None and got:
                 # The lease round trip doubles as the clock-sync sample
@@ -290,7 +394,9 @@ class PipelineExecutor:
                 labels={"stage": obs_names.STAGE_LEASE})
             if not got:
                 if poll_interval <= 0:
-                    return  # coordinator drained; let the window flush
+                    if self._drain_wait(stop):
+                        return  # coordinator drained; window flushed
+                    continue  # piggybacked grants arrived; keep going
                 waited = 0.0
                 while waited < poll_interval and not self._stopping(stop):
                     slice_s = min(_WAIT_SLICE_S, poll_interval - waited)
@@ -410,11 +516,36 @@ class PipelineExecutor:
                                   tile_s, labels=self._hist_labels)
             self._upload_q.put((workload, pixels))
 
-    def _submit(self, results: Sequence[tuple[Workload, np.ndarray]]) -> None:
-        st = self._stats[obs_names.STAGE_UPLOAD]
+    def _admit_grants(self, grants: Sequence[Workload], s0: float) -> None:
+        """Count piggybacked grants into the window BEFORE the batch that
+        earned them retires (the cap may transiently read high, never
+        low), then park them for the lease thread to forward."""
+        if not grants:
+            return
+        if self.spans is not None:
+            # The ack round trip is a clock-sync sample exactly like a
+            # lease exchange — no extra connect needed.
+            self.spans.note_grant([w.key for w in grants], s0,
+                                  self.spans.clock())
+        with self._cond:
+            self._in_flight += len(grants)
+        for w in grants:
+            self._grant_q.put(w)
+        with self._cond:
+            self._cond.notify_all()  # wake the parked lease thread
+
+    def _submit(self, results: Sequence[tuple[Workload, np.ndarray]],
+                lane: int, session) -> None:
+        st = self._lane_stats[lane]
         s0 = self.spans.clock() if self.spans is not None else 0.0
         t0 = self.clock()
-        if len(results) == 1:
+        if session is not None and session.connected:
+            # Pipelined: all uploads on the wire before the first ack is
+            # read, lease request piggybacked on the last one's ack.
+            accepted, grants = session.submit_pipelined(
+                results, want_lease=len(results))
+            self._admit_grants(grants, s0)
+        elif len(results) == 1:
             accepted = [self.client.submit(*results[0])]
         else:
             accepted = self.client.submit_batch(results)
@@ -424,12 +555,19 @@ class PipelineExecutor:
             s1 = self.spans.clock()
             for w, _ in results:
                 self.spans.record(obs_names.SPAN_UPLOAD, w.key, s0, s1)
-            # Push rides the upload stage thread — off the compute path.
-            flush_spans(self.spans, self.client, self.counters)
+            # Push rides the upload lane thread — off the compute path.
+            # Over a session it shares the lane's socket (and its clock
+            # sync); legacy keeps the separate PURPOSE_SPANS exchange.
+            flush_spans(self.spans,
+                        session if session is not None
+                        and session.connected else self.client,
+                        self.counters)
         self.counters.inc(obs_names.WORKER_UPLOAD_US, int(dt * 1e6))
         self.registry.observe(
             obs_names.HIST_PIPELINE_STAGE_SECONDS, dt,
             labels={"stage": obs_names.STAGE_UPLOAD})
+        self.registry.observe(obs_names.HIST_UPLOAD_LANE_BUSY_SECONDS, dt,
+                              labels={"lane": str(lane)})
         self.registry.observe(obs_names.HIST_WORKER_UPLOAD_SECONDS, dt,
                               labels=self._hist_labels)
         n_ok = sum(accepted)
@@ -440,33 +578,43 @@ class PipelineExecutor:
             logger.info("%d of %d results rejected (stale leases)",
                         len(accepted) - n_ok, len(accepted))
 
-    def _upload_loop(self) -> None:
-        while True:
-            item = self._upload_q.get()
-            if item is _EOS:
-                return
-            if self._stop.is_set():
-                self._abandon(1)
-                continue
-            batch = [item]
-            saw_eos = False
-            while len(batch) < self.batch_size:
+    def _upload_lane(self, lane: int) -> None:
+        """One of ``upload_lanes`` workers sharing the upload queue.  The
+        single end-of-stream sentinel is re-queued for sibling lanes, so
+        one _EOS from the materialize stage drains them all."""
+        session = self._open_session(f"upload[{lane}]")
+        try:
+            while True:
+                item = self._upload_q.get()
+                if item is _EOS:
+                    self._upload_q.put(_EOS)
+                    return
+                if self._stop.is_set():
+                    self._abandon(1)
+                    continue
+                batch = [item]
+                saw_eos = False
+                while len(batch) < self.batch_size:
+                    try:
+                        more = self._upload_q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if more is _EOS:
+                        saw_eos = True
+                        break
+                    batch.append(more)
                 try:
-                    more = self._upload_q.get_nowait()
-                except queue.Empty:
-                    break
-                if more is _EOS:
-                    saw_eos = True
-                    break
-                batch.append(more)
-            try:
-                self._submit(batch)
-            except BaseException:
-                self._abandon(len(batch))
-                raise
-            self._retire(len(batch))
-            if saw_eos:
-                return
+                    self._submit(batch, lane, session)
+                except BaseException:
+                    self._abandon(len(batch))
+                    raise
+                self._retire(len(batch))
+                if saw_eos:
+                    self._upload_q.put(_EOS)
+                    return
+        finally:
+            if session is not None:
+                session.close()
 
     # -- orchestration -----------------------------------------------------
 
@@ -484,19 +632,31 @@ class PipelineExecutor:
                 with self._cond:
                     self._cond.notify_all()
 
+    def _stage_busy(self, name: str) -> tuple[float, int, float]:
+        """(busy_s, items, capacity) for a stage — capacity is how many
+        threads serve it, so occupancy stays a 0..1 fraction with
+        parallel upload lanes."""
+        if name == obs_names.STAGE_UPLOAD:
+            return (sum(ls.busy_s for ls in self._lane_stats),
+                    sum(ls.items for ls in self._lane_stats),
+                    float(self.upload_lanes))
+        st = self._stats[name]
+        return st.busy_s, st.items, 1.0
+
     def _register_gauges(self) -> None:
-        def occupancy_fn(stats: _StageStats) -> Callable[[], float]:
+        def occupancy_fn(name: str) -> Callable[[], float]:
             def read() -> float:
                 end = self._t_end if self._t_end is not None \
                     else self.clock()
                 wall = max(1e-9, end - (self._t_start or end))
-                return min(1.0, stats.busy_s / wall)
+                busy, _, capacity = self._stage_busy(name)
+                return min(1.0, busy / (wall * capacity))
             return read
 
         for name in obs_names.PIPELINE_STAGES:
             self.registry.gauge(obs_names.GAUGE_PIPELINE_STAGE_OCCUPANCY,
                                 labels={"stage": name},
-                                fn=occupancy_fn(self._stats[name]))
+                                fn=occupancy_fn(name))
         self.registry.gauge(obs_names.GAUGE_PIPELINE_WINDOW_FILL,
                             fn=lambda: self.in_flight / self.window)
 
@@ -523,9 +683,12 @@ class PipelineExecutor:
                 target=self._run_stage, args=(self._materialize_loop,
                                               self._upload_q),
                 name="dmtpu-pipe-materialize", daemon=True),
+        ] + [
             threading.Thread(
-                target=self._run_stage, args=(self._upload_loop, None),
-                name="dmtpu-pipe-upload", daemon=True),
+                target=self._run_stage,
+                args=(lambda i=i: self._upload_lane(i), None),
+                name=f"dmtpu-pipe-upload-{i}", daemon=True)
+            for i in range(self.upload_lanes)
         ]
         for t in threads:
             t.start()
@@ -533,8 +696,10 @@ class PipelineExecutor:
             t.join()
         self._t_end = self.clock()
         # Residual accounting: anything still sitting in a queue after a
-        # crash is a leased tile the pipeline abandoned.
-        for q in (self._dispatch_q, self._mat_q, self._upload_q):
+        # crash is a leased tile the pipeline abandoned (a stranded
+        # piggyback grant in _grant_q holds a window slot too).
+        for q in (self._dispatch_q, self._mat_q, self._upload_q,
+                  self._grant_q):
             while True:
                 try:
                     leftover = q.get_nowait()
@@ -557,10 +722,15 @@ class PipelineExecutor:
                                 else end))
         stages = {}
         for name in obs_names.PIPELINE_STAGES:
-            st = self._stats[name]
-            occ = min(1.0, st.busy_s / wall)
-            stages[name] = {"busy_s": round(st.busy_s, 6),
-                            "items": st.items,
+            busy, items, capacity = self._stage_busy(name)
+            occ = min(1.0, busy / (wall * capacity))
+            stages[name] = {"busy_s": round(busy, 6),
+                            "items": items,
                             "occupancy": round(occ, 4),
                             "bubble": round(1.0 - occ, 4)}
-        return {"wall_s": round(wall, 6), "stages": stages}
+        lanes = [{"busy_s": round(ls.busy_s, 6),
+                  "items": ls.items,
+                  "occupancy": round(min(1.0, ls.busy_s / wall), 4)}
+                 for ls in self._lane_stats]
+        return {"wall_s": round(wall, 6), "stages": stages,
+                "lanes": lanes}

@@ -44,19 +44,29 @@ class Worker:
     def __init__(self, client: DistributerClient, backend: ComputeBackend, *,
                  batch_size: int = 1, overlap_io: bool = True,
                  counters: Optional[Counters] = None,
-                 window: int = 0, depth: int = 2) -> None:
+                 window: int = 0, depth: int = 2,
+                 upload_lanes: int = 0,
+                 use_session: bool = True) -> None:
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
         if window < 0:
             raise ValueError("window must be >= 0 (0 = classic overlap)")
         if depth < 1:
             raise ValueError("depth must be >= 1")
+        if upload_lanes < 0:
+            raise ValueError("upload_lanes must be >= 0 (0 = auto)")
         self.client = client
         self.backend = backend
         self.batch_size = batch_size
         self.overlap_io = overlap_io
         self.window = window
         self.depth = depth
+        # 0 = auto: one lane per local device, capped at 4 (lanes hide
+        # upload latency behind each other; past the device count they
+        # only add idle sockets).  Only the pipelined path (window > 0)
+        # uses lanes.
+        self.upload_lanes = upload_lanes
+        self.use_session = use_session
         self.counters = counters if counters is not None else Counters()
         self.registry = self.counters.registry
         # A client constructed without its own counters adopts the
@@ -176,14 +186,47 @@ class Worker:
 
     # -- loops ------------------------------------------------------------
 
+    def _device_count(self) -> int:
+        devices = getattr(self.backend, "devices", None)
+        if devices is None:
+            return 1
+        try:
+            return max(1, len(list(devices())))
+        except Exception:
+            logger.debug("backend device probe failed; assuming 1 device",
+                         exc_info=True)
+            return 1
+
+    def _session_factory(self):
+        """A zero-arg DistributerSession builder targeting the client's
+        coordinator, or None when sessions are off or the client is a
+        test double without an address."""
+        if not self.use_session:
+            return None
+        host = getattr(self.client, "host", None)
+        port = getattr(self.client, "port", None)
+        if host is None or port is None:
+            return None
+        from distributedmandelbrot_tpu.worker.client import \
+            DistributerSession
+        timeout = getattr(self.client, "timeout", 30.0)
+
+        def make() -> DistributerSession:
+            return DistributerSession(host, port, timeout=timeout,
+                                      counters=self.counters)
+        return make
+
     def _run_pipelined(self, *, poll_interval: float = 0.0,
                        stop: Optional[threading.Event] = None) -> int:
         from distributedmandelbrot_tpu.worker.pipeline import (
             PipelineExecutor, as_dispatcher)
+        lanes = self.upload_lanes or min(4, self._device_count())
         pipe = PipelineExecutor(self.client, as_dispatcher(self.backend),
                                 window=self.window, depth=self.depth,
                                 batch_size=self.batch_size,
-                                counters=self.counters, spans=self.spans)
+                                upload_lanes=lanes,
+                                counters=self.counters, spans=self.spans,
+                                session_factory=self._session_factory())
         self.pipeline = pipe
         return pipe.run(poll_interval=poll_interval, stop=stop)
 

@@ -718,6 +718,88 @@ def test_proto_silent_without_protocol_module():
     assert findings_for({PROTO_CLIENT: CLIENT_SRC}, "proto-dispatch") == []
 
 
+# A stream-upgrade purpose (STREAM_FRAME_SYMBOLS): after the hello the
+# connection multiplexes SESSION_FRAME-headed frames, so sequence parity
+# covers only the ops before the first SESSION_FRAME on each side.
+SESSION_PROTO_SRC = '''
+import struct
+
+PURPOSE_SESSION = 0x05
+
+SESSION_HELLO = struct.Struct("<I")
+SESSION_HELLO_WIRE_SIZE = SESSION_HELLO.size
+SESSION_FRAME = struct.Struct("<BHI")
+SESSION_FRAME_WIRE_SIZE = SESSION_FRAME.size
+'''
+
+SESSION_CLIENT_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (recv_byte, recv_exact,
+                                                   send_all, send_byte)
+
+class Session:
+    def connect(self, sock, want):
+        send_byte(sock, proto.PURPOSE_SESSION)
+        send_all(sock, proto.SESSION_HELLO.pack(want))
+        status = recv_byte(sock)
+        raw = recv_exact(sock, proto.SESSION_HELLO_WIRE_SIZE)
+        return status, proto.SESSION_HELLO.unpack(raw)[0]
+'''
+
+SESSION_SERVER_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (recv_byte, recv_exact,
+                                                   send_all, send_byte)
+
+class Server:
+    def handle(self, sock):
+        purpose = recv_byte(sock)
+        if purpose == proto.PURPOSE_SESSION:
+            raw = recv_exact(sock, proto.SESSION_HELLO.size)
+            (want,) = proto.SESSION_HELLO.unpack(raw)
+            send_byte(sock, 0x50)
+            send_all(sock, proto.SESSION_HELLO.pack(want))
+            while True:
+                hdr = recv_exact(sock, proto.SESSION_FRAME_WIRE_SIZE)
+                kind, seq, length = proto.SESSION_FRAME.unpack(hdr)
+                body = recv_exact(sock, length)
+                send_all(sock, proto.SESSION_FRAME.pack(kind, seq, 0))
+'''
+
+SESSION_SOURCES = {PROTO_MOD: SESSION_PROTO_SRC,
+                   PROTO_CLIENT: SESSION_CLIENT_SRC,
+                   PROTO_SERVER: SESSION_SERVER_SRC}
+
+
+def test_proto_session_parity_checks_hello_prefix_only():
+    # The server arm's frame loop (recv SESSION_FRAME, recv ?, send
+    # SESSION_FRAME) never mirrors the one-shot hello emitter; the
+    # stream truncation keeps parity scoped to the hello handshake.
+    assert findings_for(SESSION_SOURCES, "proto-frames") == []
+    assert findings_for(SESSION_SOURCES, "proto-dispatch") == []
+
+
+def test_proto_session_fires_on_hello_prefix_mismatch():
+    # A drift *inside* the hello prefix still fires: the server stops
+    # writing the accept byte before its hello echo.
+    skewed = dict(SESSION_SOURCES)
+    skewed[PROTO_SERVER] = SESSION_SERVER_SRC.replace(
+        "            send_byte(sock, 0x50)\n", "")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "client awaits [BYTE, SESSION_HELLO]" in found[0].message
+    assert "server writes [SESSION_HELLO]" in found[0].message
+
+
+def test_proto_session_dispatch_fires_without_emitter():
+    gap = dict(SESSION_SOURCES)
+    gap[PROTO_CLIENT] = SESSION_CLIENT_SRC.replace(
+        "        send_byte(sock, proto.PURPOSE_SESSION)\n", "")
+    found = findings_for(gap, "proto-dispatch")
+    assert len(found) == 1
+    assert "PURPOSE_SESSION has no client emitter" in found[0].message
+
+
 # -- res -------------------------------------------------------------------
 
 def test_res_thread_join_fires_on_unjoined_handleless_thread():

@@ -156,6 +156,131 @@ def test_distributer_short_payload_releases_claim_and_counts(tmp_path):
         assert regranted, "dropped tile never returned to the frontier"
 
 
+def _session_hello(sock: socket.socket,
+                   want: int = proto.SESSION_FLAG_RLE) -> int:
+    """Run a well-formed session hello; returns the negotiated flags."""
+    sock.sendall(bytes([proto.PURPOSE_SESSION])
+                 + proto.SESSION_HELLO.pack(want))
+    status = sock.recv(1)
+    assert status and status[0] == proto.SESSION_ACCEPT
+    reply = b""
+    while len(reply) < proto.SESSION_HELLO_WIRE_SIZE:
+        more = sock.recv(proto.SESSION_HELLO_WIRE_SIZE - len(reply))
+        assert more, "hello reply truncated"
+        reply += more
+    return proto.SESSION_HELLO.unpack(reply)[0]
+
+
+def test_session_rejects_malformed_frames_and_stays_alive(tmp_path):
+    """The malformed-session corpus: every case must drop the offending
+    session, bump COORD_FRAMES_REJECTED, and leave the loop serving."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        rejected = 0
+
+        # Truncated session hello: 2 of 4 capability bytes, then close.
+        with _dial(farm.distributer_port) as sock:
+            sock.sendall(bytes([proto.PURPOSE_SESSION]) + b"\x00\x00")
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Interleaved frame with a bad seq: client seqs must strictly
+        # increment from 0; opening with seq 5 kills the session.
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock)
+            sock.sendall(proto.SESSION_FRAME.pack(proto.FRAME_LEASE_REQ,
+                                                  5, 4) + U32.pack(1))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Unknown frame type after a clean hello.
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock)
+            sock.sendall(proto.SESSION_FRAME.pack(0x7F, 0, 0))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Oversized frame payload: declared length past MAX_PAYLOAD_BYTES
+        # is rejected before a single payload byte is read or allocated.
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock)
+            sock.sendall(proto.SESSION_FRAME.pack(proto.FRAME_UPLOAD, 0,
+                                                  0xFFFF_FFFF))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Raw upload with a wrong body length (the "oversized compressed
+        # payload" shape for the exact-size codec).
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock)
+            body_len = 10
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_UPLOAD, 0,
+                16 + proto.UPLOAD_HEADER_WIRE_SIZE + body_len))
+            sock.sendall(b"\x00" * 16
+                         + proto.UPLOAD_HEADER.pack(proto.WIRE_CODEC_RAW, 0)
+                         + b"\x00" * body_len)
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+
+def test_session_rle_bomb_releases_claim_and_stays_alive(tmp_path):
+    """A compression bomb — a tiny RLE body whose declared run lengths
+    sum to far more than a tile — must be rejected by the decoder's
+    total-size check (before any allocation at the claimed size), drop
+    the session, release the claim, and leave the loop alive."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        with _dial(farm.distributer_port) as sock:
+            flags = _session_hello(sock)
+            assert flags & proto.SESSION_FLAG_RLE
+            # Lease the only tile over the session so the upload passes
+            # the claim check and actually reaches the decoder.
+            sock.sendall(proto.SESSION_FRAME.pack(proto.FRAME_LEASE_REQ,
+                                                  0, 4) + U32.pack(1))
+            hdr = b""
+            while len(hdr) < proto.SESSION_FRAME_WIRE_SIZE:
+                hdr += sock.recv(proto.SESSION_FRAME_WIRE_SIZE - len(hdr))
+            frame_type, seq, length = proto.SESSION_FRAME.unpack(hdr)
+            assert frame_type == proto.FRAME_LEASE_GRANT and seq == 0
+            payload = b""
+            while len(payload) < length:
+                payload += sock.recv(length - len(payload))
+            assert U32.unpack(payload[:4])[0] == 1
+            wire = payload[4:20]
+            # 1000 runs of 0xFFFF_FFFF pixels each: ~4 TiB declared in a
+            # 5 KB body.
+            bomb = struct.pack("<IB", 0xFFFF_FFFF, 7) * 1000
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_UPLOAD, 1,
+                16 + proto.UPLOAD_HEADER_WIRE_SIZE + len(bomb)))
+            sock.sendall(wire
+                         + proto.UPLOAD_HEADER.pack(proto.WIRE_CODEC_RLE, 0)
+                         + bomb)
+            assert _recv_all(sock) == b""
+        _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED, 1)
+        _wait_counter(farm, obs_names.COORD_RESULTS_DROPPED, 1)
+        # The claim was released: the tile is grantable again, and serving
+        # the probe at all proves the loop survived the bomb.
+        deadline = time.monotonic() + 10
+        regranted = False
+        while time.monotonic() < deadline and not regranted:
+            with _dial(farm.distributer_port) as sock:
+                sock.sendall(bytes([proto.PURPOSE_REQUEST]))
+                status = sock.recv(1)
+                regranted = status[0] == proto.WORKLOAD_AVAILABLE
+        assert regranted, "bombed tile never returned to the frontier"
+
+
 def test_dataserver_rejects_malformed_queries_and_stays_alive(tmp_path):
     with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
                             exporter=False) as farm:

@@ -572,6 +572,11 @@ def test_kill_and_restart_drains_exact_tile_set(tmp_path):
         sched = _varz(ports2["exporter"])["scheduler"]
         assert sched["completed"] == sched["total"] == 9
         assert sched["outstanding_leases"] == 0  # no stuck leases
+        # The scheduler counts a tile at accept, a beat before its async
+        # save appends the index — wait for the 5 post-restart saves
+        # (9 total minus the 4 appends durable before the crash) so the
+        # kill below cannot race the last tile out of the index.
+        _wait_saved(ports2["exporter"], 9 - 4)
     finally:
         proc2.kill()
         proc2.wait()

@@ -1,0 +1,227 @@
+"""Persistent-session wire tier (PURPOSE_SESSION, 0x05): zero-copy
+uploads, the compression heuristic, piggybacked grants, the
+connection-budget guarantee, and legacy interop in both directions."""
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.codecs.rle import RleCodec, estimate_ratio
+from distributedmandelbrot_tpu.core import LevelSetting
+from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.utils.metrics import Counters
+from distributedmandelbrot_tpu.viewer import DataClient, FetchStatus
+from distributedmandelbrot_tpu.worker import (DistributerClient, JaxBackend,
+                                              NumpyBackend, Worker)
+from distributedmandelbrot_tpu.worker.client import DistributerSession
+
+from harness import CoordinatorHarness
+
+MAX_ITER = 24
+
+
+# -- zero-copy upload buffers ----------------------------------------------
+
+def test_pixel_bytes_is_zero_copy_for_contiguous_uint8():
+    arr = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+    view = DistributerClient._pixel_bytes(arr)
+    assert isinstance(view, memoryview)
+    assert len(view) == CHUNK_PIXELS
+    # The memoryview aliases the array's own buffer — no copy was made.
+    assert np.shares_memory(np.frombuffer(view, dtype=np.uint8), arr)
+    arr[123] = 45
+    assert view[123] == 45
+
+
+def test_pixel_bytes_copies_only_when_it_must():
+    # 2-D C-contiguous uint8 still aliases (ravel of a contiguous array
+    # is a view).
+    arr2d = np.zeros((4096, 4096), dtype=np.uint8)
+    view = DistributerClient._pixel_bytes(arr2d)
+    assert np.shares_memory(np.frombuffer(view, dtype=np.uint8), arr2d)
+    # A strided slice cannot be aliased flat: one normalizing copy.
+    strided = np.zeros(2 * CHUNK_PIXELS, dtype=np.uint8)[::2]
+    view = DistributerClient._pixel_bytes(strided)
+    assert len(view) == CHUNK_PIXELS
+    assert not np.shares_memory(np.frombuffer(view, dtype=np.uint8), strided)
+    # Wrong dtype: converted, not aliased.
+    wide = np.zeros(CHUNK_PIXELS, dtype=np.uint16)
+    view = DistributerClient._pixel_bytes(wide)
+    assert len(view) == CHUNK_PIXELS
+    with pytest.raises(ValueError):
+        DistributerClient._pixel_bytes(np.zeros(7, dtype=np.uint8))
+
+
+# -- compression heuristic -------------------------------------------------
+
+def test_estimate_ratio_flat_vs_noise():
+    flat = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+    assert estimate_ratio(flat) > 100.0
+    rng = np.random.default_rng(7)
+    noise = rng.integers(0, 256, CHUNK_PIXELS, dtype=np.uint8)
+    # No value dominates the strided sample: the histogram stage bails
+    # out without ever scanning run boundaries.
+    assert estimate_ratio(noise) == 1.0
+
+
+def test_estimate_ratio_tracks_exact_encoded_size():
+    # Alternating values: every run has length 1, so the boundary-count
+    # estimate equals the exact encoded size and must agree with the
+    # codec (a sub-1.0 "ratio" — RLE would inflate this tile).
+    data = np.zeros(1 << 20, dtype=np.uint8)
+    data[1::2] = 3
+    est = estimate_ratio(data)
+    exact = data.size / len(RleCodec().encode(data))
+    assert est == pytest.approx(exact, rel=0.01)
+
+
+# -- direct session exchanges ----------------------------------------------
+
+def _checker(value_a=0, value_b=200, period=4096):
+    """A compressible-but-nontrivial tile: long runs of two values."""
+    tile = np.full(CHUNK_PIXELS, value_a, dtype=np.uint8)
+    tile.reshape(-1, period)[::2] = value_b
+    return tile
+
+
+def test_session_roundtrip_compressed_and_raw_bit_identical(tmp_path):
+    """Both wire codecs must land byte-identical chunks on disk, and the
+    codec choice must follow the per-tile heuristic."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, MAX_ITER)]) \
+            as farm:
+        counters = Counters()
+        sess = DistributerSession("127.0.0.1", farm.distributer_port,
+                                  counters=counters)
+        assert sess.connect()
+        assert sess.flags & proto.SESSION_FLAG_RLE
+        grants = sess.request_batch(2)
+        assert len(grants) == 2
+        rng = np.random.default_rng(3)
+        compressible = _checker()
+        noise = rng.integers(0, 256, CHUNK_PIXELS, dtype=np.uint8)
+        accepted, piggyback = sess.submit_pipelined(
+            [(grants[0], compressible), (grants[1], noise)], want_lease=2)
+        assert accepted == [True, True]
+        # The ack on the last upload piggybacked the remaining tiles.
+        assert len(piggyback) == 2
+        # One tile went RLE (far above the 2x bar), one went raw.
+        assert 0 < counters.get(obs_names.WIRE_COMPRESSED_BYTES) \
+            < CHUNK_PIXELS // 4
+        assert counters.get(obs_names.WIRE_RAW_BYTES) == CHUNK_PIXELS
+        accepted, rest = sess.submit_pipelined(
+            [(piggyback[0], compressible), (piggyback[1], noise)])
+        assert accepted == [True, True] and rest == []
+        sess.close()
+        farm.wait_saves_settled(expected_accepted=4)
+
+        fetch = DataClient("127.0.0.1", farm.dataserver_port).fetch
+        for w, sent in [(grants[0], compressible), (grants[1], noise),
+                        (piggyback[0], compressible), (piggyback[1], noise)]:
+            pixels, status = fetch(w.level, w.index_real, w.index_imag)
+            assert status is FetchStatus.OK
+            np.testing.assert_array_equal(pixels, sent)
+        assert farm.counters.get(obs_names.WIRE_COMPRESSED_BYTES) \
+            == counters.get(obs_names.WIRE_COMPRESSED_BYTES)
+        assert farm.counters.get(obs_names.WIRE_RAW_BYTES) \
+            == counters.get(obs_names.WIRE_RAW_BYTES)
+
+
+def test_session_compress_disabled_negotiates_raw(tmp_path):
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)]) \
+            as farm:
+        counters = Counters()
+        sess = DistributerSession("127.0.0.1", farm.distributer_port,
+                                  compress=False, counters=counters)
+        assert sess.connect()
+        assert not sess.flags & proto.SESSION_FLAG_RLE
+        (w,) = sess.request_batch(1)
+        accepted, _ = sess.submit_pipelined([(w, _checker())])
+        assert accepted == [True]
+        sess.close()
+        farm.wait_saves_settled(expected_accepted=1)
+        # Even a perfectly compressible tile ships raw when RLE was not
+        # negotiated.
+        assert counters.get(obs_names.WIRE_COMPRESSED_BYTES) == 0
+        assert counters.get(obs_names.WIRE_RAW_BYTES) == CHUNK_PIXELS
+
+
+# -- pipelined farm over sessions ------------------------------------------
+
+def test_pipelined_farm_one_connection_per_lane(tmp_path):
+    """The connection-budget acceptance check: a whole pipelined run
+    costs one TCP connect per upload lane plus one for the lease
+    thread, with piggybacked grants carrying the steady state."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, MAX_ITER)]) \
+            as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            JaxBackend(dtype=np.float32),
+            batch_size=2, window=4, upload_lanes=2)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=4)
+        assert farm.scheduler.is_complete()
+        assert worker.counters.get(obs_names.WORKER_SESSION_FALLBACKS) == 0
+        assert worker.counters.get(obs_names.WORKER_SESSIONS_OPENED) == 3
+        assert farm.counters.get(obs_names.COORD_SESSIONS_OPENED) == 3
+        assert farm.counters.get(obs_names.COORD_CONNECTIONS_ACCEPTED) == 3
+        assert farm.counters.get(obs_names.COORD_SESSION_FRAMES) > 0
+        # Blocking round trips stay near one per tile (lease exchanges
+        # plus pipelined ack waits; drain probes add a small constant).
+        rtts = worker.counters.get(obs_names.WORKER_WIRE_RTTS)
+        assert 0 < rtts <= 2 * 4 + 4
+        stats = worker.pipeline.stage_stats()
+        assert len(stats["lanes"]) == 2
+        assert sum(ls["items"] for ls in stats["lanes"]) == 4
+
+
+# -- legacy interop, both directions ---------------------------------------
+
+def test_session_worker_against_legacy_coordinator_falls_back(tmp_path):
+    """A session-speaking worker against a coordinator that predates
+    0x05: hello EOFs, every stage falls back to connection-per-exchange,
+    and the stored tile is still bit-identical to the golden path."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, 12)],
+                            accept_session=False) as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            NumpyBackend(), batch_size=1, window=2, upload_lanes=2)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=1)
+        assert farm.scheduler.is_complete()
+        assert worker.counters.get(obs_names.WORKER_SESSIONS_OPENED) == 0
+        assert worker.counters.get(obs_names.WORKER_SESSION_FALLBACKS) == 3
+        assert farm.counters.get(obs_names.COORD_SESSIONS_OPENED) == 0
+        pixels, status = DataClient(
+            "127.0.0.1", farm.dataserver_port).fetch(1, 0, 0)
+        assert status is FetchStatus.OK
+    (tmp_path / "b").mkdir()
+    with CoordinatorHarness(str(tmp_path / "b"), [LevelSetting(1, 12)]) \
+            as farm2:
+        # Same tile through the session path: byte-identical on disk.
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm2.distributer_port),
+            NumpyBackend(), batch_size=1, window=2)
+        worker.run_until_drained()
+        farm2.wait_saves_settled(expected_accepted=1)
+        session_pixels, status = DataClient(
+            "127.0.0.1", farm2.dataserver_port).fetch(1, 0, 0)
+        assert status is FetchStatus.OK
+        np.testing.assert_array_equal(pixels, session_pixels)
+
+
+def test_legacy_worker_against_session_coordinator(tmp_path):
+    """The other direction: a worker pinned to the legacy protocol
+    (use_session=False) against a session-capable coordinator."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, 12)]) as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            NumpyBackend(), batch_size=1, window=2, use_session=False)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=1)
+        assert farm.scheduler.is_complete()
+        assert worker.counters.get(obs_names.WORKER_SESSIONS_OPENED) == 0
+        assert farm.counters.get(obs_names.COORD_SESSIONS_OPENED) == 0
+        pixels, status = DataClient(
+            "127.0.0.1", farm.dataserver_port).fetch(1, 0, 0)
+        assert status is FetchStatus.OK
