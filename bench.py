@@ -1458,6 +1458,130 @@ def bench_recovery(repeats: int, *, levels: str = "64:100",
     return out
 
 
+def bench_storm(repeats: int, *, level: int = 8,
+                crowd_phases: str = "steady:150x3,spike:900x3,steady:150x3",
+                scale_phases: str = "steady:400x6",
+                gateway_rate: float = 250.0,
+                replica_rate: float = 150.0) -> dict:
+    """Million-viewer read-path shape (no accelerator): an open-loop
+    Poisson/Zipf storm against the serving tier.  Two legs:
+
+    - flash crowd vs an embedded coordinator's gateway: a pre-seeded
+      level grid, steady -> 6x spike -> steady, with the admission
+      token bucket sized so ``QUERY_OVERLOADED`` engages during the
+      spike and the recovery phase goes clean again;
+    - replica scaling: the same storm against a 1- then 2-replica
+      :class:`GatewayFleet` sharing one object store, tile cache off so
+      every request pays admission — the goodput ratio is the
+      horizontal-read headline.
+
+    Open loop throughout: arrivals follow the schedule, never the
+    server, so queue collapse shows up as shed fraction and tail
+    latency instead of silently slowing the generator down.
+    """
+    import asyncio
+    import tempfile
+
+    from distributedmandelbrot_tpu import loadgen
+    from distributedmandelbrot_tpu.cli import parse_level_settings
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    from distributedmandelbrot_tpu.core.chunk import Chunk
+    from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+    from distributedmandelbrot_tpu.loadgen.driver import GatewayDriver
+    from distributedmandelbrot_tpu.loadgen.replicas import GatewayFleet
+    from distributedmandelbrot_tpu.storage.backends import (
+        MemoryObjectStore, ObjectStoreBackend)
+    from distributedmandelbrot_tpu.storage.store import ChunkStore
+
+    # RLE-friendly pixels: every seeded tile's wire payload is ~1 KB, so
+    # both legs measure admission + framing, not payload bandwidth.
+    pixels = np.repeat(np.arange(64, dtype=np.uint8) + 1,
+                       CHUNK_PIXELS // 64)
+    grid = [(level, i, j) for i in range(level) for j in range(level)]
+
+    def run_storm(addresses, phases, sampler) -> tuple[dict, list]:
+        schedule = loadgen.build_schedule(phases, sampler, seed=0)
+        driver = GatewayDriver(addresses, timeout=60.0)
+        recorder = loadgen.StormRecorder()
+        runner = loadgen.OpenLoopRunner(schedule, driver, recorder)
+        duration = asyncio.run(runner.run())
+        return recorder.report(
+            duration=duration,
+            offered=loadgen.schedule.offered_rate(schedule),
+            phases=[p.name for p in phases]), phases
+
+    # -- leg 1: flash crowd vs the embedded coordinator's gateway -----
+    out: dict = {"config": "storm", "storm_level": level,
+                 "storm_crowd_phases": crowd_phases,
+                 "storm_gateway_rate": gateway_rate}
+    with tempfile.TemporaryDirectory() as tmp:
+        settings = parse_level_settings(f"{level}:100")
+        seeder = ChunkStore(tmp)
+        seeder.setup()
+        for key in grid:
+            seeder.save(Chunk(*key, pixels))
+        with EmbeddedCoordinator(tmp, settings, exporter=False,
+                                 gateway_cache_tiles=2,
+                                 gateway_rate=gateway_rate,
+                                 gateway_burst=50.0,
+                                 gateway_max_queue_depth=256) as co:
+            crowd, phases = run_storm(
+                [("127.0.0.1", co.gateway_port)],
+                loadgen.parse_phases(crowd_phases),
+                loadgen.ZipfTiles(level, s=1.1, seed=0))
+            out["storm_gateway_overloaded"] = \
+                co.counters.get("gateway_overloaded")
+    spike = crowd["phases"][phases[1].name]
+    recovery = crowd["phases"][phases[2].name]
+    out.update({
+        "storm_requests": crowd["requests"],
+        "storm_completed": crowd["completed"],
+        "storm_shed": crowd["shed"],
+        "storm_errors": crowd["errors"],
+        "storm_offered_rate": crowd["offered_rate"],
+        "storm_goodput": crowd["goodput"],
+        "storm_shed_fraction": crowd["shed_fraction"],
+        "storm_p50_s": crowd["p50"], "storm_p99_s": crowd["p99"],
+        "storm_p999_s": crowd["p999"],
+        "storm_spike_completed": spike["completed"],
+        "storm_spike_shed": spike["shed"],
+        "storm_recovery_completed": recovery["completed"],
+        "storm_recovery_shed": recovery["shed"],
+        # The admission-control story in one flag: sheds during the
+        # spike, (near-)none once the crowd passes.
+        "storm_overload_engaged": spike["shed"] > 0,
+        "storm_overload_recovered":
+            recovery["shed"] * 20 <= max(recovery["completed"], 1),
+    })
+
+    # -- leg 2: horizontal reads, 1 vs 2 replicas ---------------------
+    kv = MemoryObjectStore()
+    seeder = ChunkStore(backend=ObjectStoreBackend(kv))
+    for key in grid:
+        seeder.save(Chunk(*key, pixels))
+    goodput: dict[int, float] = {}
+    for replicas in (1, 2):
+        with GatewayFleet(kv, replicas=replicas, cache_tiles=0,
+                          rate=replica_rate, burst=15.0,
+                          max_queue_depth=512) as fleet:
+            report, _ = run_storm(
+                fleet.addresses, loadgen.parse_phases(scale_phases),
+                loadgen.ZipfTiles(level, s=0.05, seed=1))
+        goodput[replicas] = report["goodput"]
+        out[f"storm_goodput_{replicas}r"] = report["goodput"]
+        out[f"storm_shed_fraction_{replicas}r"] = report["shed_fraction"]
+    speedup = goodput[2] / goodput[1] if goodput[1] else 0.0
+    out.update({
+        "metric": f"loadgen storm: goodput scaling, 2 vs 1 gateway "
+                  f"replicas over one object store "
+                  f"(rate-bound at {replica_rate}/s per replica)",
+        "value": round(speedup, 2), "unit": "x",
+        "storm_scale_phases": scale_phases,
+        "storm_replica_rate": replica_rate,
+    })
+    return out
+
+
 def _ensure_live_backend(probe_timeout: float = 120.0) -> bool:
     """Guard against a dead accelerator tunnel: on this rig the TPU is
     reached through a network tunnel whose failure mode is jax backend
@@ -1534,11 +1658,21 @@ def main() -> int:
                              "(restart-to-first-grant latency, full vs "
                              "checkpoint+suffix index replay throughput; "
                              "no accelerator needed)")
+    parser.add_argument("--storm", action="store_true",
+                        help="run only the loadgen storm config "
+                             "(open-loop flash crowd vs the gateway: "
+                             "p50/p99/p999, goodput vs offered, shed "
+                             "fraction, 1-vs-2-replica goodput scaling; "
+                             "no accelerator needed)")
     args = parser.parse_args()
     if args.recovery:
         # Pure coordinator/storage path — skip the accelerator probe
         # entirely so this leg runs anywhere (CI, laptops, dead tunnels).
         print(json.dumps(bench_recovery(args.repeats)), flush=True)
+        return 0
+    if args.storm:
+        # Read path over pre-seeded tiles — equally accelerator-free.
+        print(json.dumps(bench_storm(args.repeats)), flush=True)
         return 0
     fell_back = _ensure_live_backend()
 

@@ -80,6 +80,13 @@ QUERY_EXCHANGES = (
     ("query",
      f"{PACKAGE}/viewer/client.py::DataClient._fetch_once",
      f"{PACKAGE}/coordinator/dataserver.py::DataServer._handle_connection"),
+    # The rendered-tile framing: both qualnames cover the post-magic
+    # exchange (the client's magic u32 is sent by its caller, mirroring
+    # the server, whose accept loop consumes the magic before
+    # dispatching to the handler).
+    ("render_query",
+     f"{PACKAGE}/viewer/client.py::DataClient._render_exchange",
+     f"{PACKAGE}/serve/gateway.py::TileGateway._serve_render"),
 )
 
 # Purpose bytes that upgrade the connection to a multiplexed frame

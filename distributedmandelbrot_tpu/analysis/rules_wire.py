@@ -9,8 +9,9 @@ exactly one place and these rules keep it that way:
 ``struct.pack``/``unpack``/``unpack_from``/``pack_into``/``calcsize``)
 in any module outside the canonical set.  Canonical modules:
 net/protocol.py, net/framing.py, core/workload.py, storage/index.py,
-and codecs/ (each owns its own on-disk format).  Everyone else must
-import the precompiled ``struct.Struct`` objects from net/protocol.py.
+serve/render.py (the PNG container), and codecs/ (each owns its own
+on-disk format).  Everyone else must import the precompiled
+``struct.Struct`` objects from net/protocol.py.
 
 ``wire-size`` — inside the canonical modules, every ``NAME_WIRE_SIZE =
 <int>`` constant must equal ``struct.calcsize`` of the ``NAME = struct.
@@ -52,6 +53,9 @@ CANONICAL = frozenset({
     f"{PACKAGE}/net/framing.py",
     f"{PACKAGE}/core/workload.py",
     f"{PACKAGE}/storage/index.py",
+    # The PNG container: big-endian chunk/IHDR formats are PNG's, not
+    # the dmtpu wire protocol's, and live only in the render module.
+    f"{PACKAGE}/serve/render.py",
 })
 CANONICAL_PREFIXES = (f"{PACKAGE}/codecs/",)
 

@@ -592,6 +592,9 @@ def cmd_serve(argv: Sequence[str]) -> int:
                              "(0 disables)")
     parser.add_argument("--cache-tiles", type=int, default=256,
                         help="decoded-tile LRU capacity, in tiles")
+    parser.add_argument("--render-cache-tiles", type=int, default=64,
+                        help="rendered palette-PNG LRU capacity, in "
+                             "entries (one per tile+colormap)")
     parser.add_argument("--max-queue-depth", type=int, default=1024,
                         help="max queries in service before shedding "
                              "with OVERLOADED")
@@ -629,6 +632,7 @@ def cmd_serve(argv: Sequence[str]) -> int:
             checkpoint_period=args.checkpoint_period,
             gateway_port=args.gateway_port,
             gateway_cache_tiles=args.cache_tiles,
+            gateway_render_tiles=args.render_cache_tiles,
             gateway_max_queue_depth=args.max_queue_depth,
             gateway_rate=args.rate, gateway_burst=args.burst,
             ondemand_deadline=args.ondemand_deadline,
@@ -1519,6 +1523,203 @@ def cmd_check(argv: Sequence[str]) -> int:
     return 0 if report.clean else 1
 
 
+def cmd_loadgen(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu loadgen",
+        description="Open-loop storm harness for the gateway read path: "
+                    "Poisson arrivals, Zipf tile popularity, scripted "
+                    "flash-crowd phases, replica fleets over one shared "
+                    "object store.  Reports p50/p99/p999, goodput vs "
+                    "offered load, and the shed fraction.")
+    parser.add_argument("--smoke", action="store_true",
+                        help="self-check on a virtual clock against a "
+                             "stub gateway — no sockets, no jax, no "
+                             "matplotlib (CI-safe)")
+    parser.add_argument("--phases",
+                        default="steady:200x5,spike:1200x2,steady:200x3",
+                        help="schedule spec: kind:rate[-hi]xduration "
+                             "segments, comma-separated (kinds: steady, "
+                             "spike, ramp; e.g. ramp:200-2000x5)")
+    parser.add_argument("--level", type=int, default=8,
+                        help="tile level whose keyspace the Zipf sampler "
+                             "draws from")
+    parser.add_argument("--zipf", type=float, default=1.1,
+                        help="Zipf popularity exponent s (P(rank k) ~ "
+                             "k**-s)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="schedule + sampler seed (same seed, same "
+                             "storm)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="gateway replicas sharing one object store")
+    parser.add_argument("--render", action="store_true",
+                        help="issue rendered-tile queries (palette PNG "
+                             "bodies) instead of raw codec payloads")
+    parser.add_argument("--colormap", default="jet",
+                        help="colormap for --render "
+                             "(jet, viridis, plasma)")
+    parser.add_argument("--rate", type=float, default=None,
+                        help="per-replica admission token-bucket rate "
+                             "(default: unlimited)")
+    parser.add_argument("--burst", type=float, default=64.0,
+                        help="per-replica token-bucket burst")
+    parser.add_argument("--queue-depth", type=int, default=64,
+                        help="per-replica cap on queries in service")
+    parser.add_argument("--seed-tiles", type=int, default=16,
+                        help="pre-seed the hottest N tiles into the "
+                             "shared store before the storm")
+    parser.add_argument("--timeout", type=float, default=30.0,
+                        help="per-request client timeout (seconds)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON")
+    args = parser.parse_args(argv)
+
+    # Lazy: the smoke path must work in the lint-only CI environment
+    # (numpy + pytest, no jax/matplotlib), which the loadgen package and
+    # the serve stack under it are built to allow.
+    from distributedmandelbrot_tpu import loadgen
+
+    try:
+        phases = loadgen.parse_phases(args.phases)
+    except ValueError as e:
+        print(f"dmtpu loadgen: {e}", file=sys.stderr)
+        return 2
+    sampler = loadgen.ZipfTiles(args.level, s=args.zipf, seed=args.seed)
+    schedule = loadgen.build_schedule(phases, sampler, seed=args.seed)
+    if not schedule:
+        print("dmtpu loadgen: schedule is empty (rate 0?)", file=sys.stderr)
+        return 2
+    if args.smoke:
+        return _loadgen_smoke(phases, schedule)
+    return _loadgen_storm(args, phases, schedule)
+
+
+def _loadgen_smoke(phases, schedule) -> int:
+    """Virtual-clock self-check: stub gateway, deterministic, instant.
+
+    The stub models a server with bounded concurrency: requests past its
+    depth are shed immediately, admitted ones cost a fixed service time.
+    The checks are consistency invariants, not performance numbers.
+    """
+    import asyncio
+
+    from distributedmandelbrot_tpu import loadgen
+    from distributedmandelbrot_tpu.loadgen import recorder as rec
+
+    timebase = loadgen.VirtualTimebase()
+    recorder = loadgen.StormRecorder()
+    inflight = 0
+
+    async def stub(level: int, i: int, j: int) -> tuple[str, int]:
+        nonlocal inflight
+        if inflight >= 64:
+            return rec.OUTCOME_SHED, 0
+        inflight += 1
+        try:
+            await timebase.sleep(0.1)  # 640/s capacity vs 1200/s spike
+        finally:
+            inflight -= 1
+        return rec.OUTCOME_OK, 1024
+
+    runner = loadgen.OpenLoopRunner(schedule, stub, recorder,
+                                    timebase=timebase)
+
+    async def drive() -> float:
+        task = asyncio.ensure_future(runner.run())
+        await timebase.drain(until=task)
+        return task.result()
+
+    duration = asyncio.run(drive())
+    report = recorder.report(duration=duration,
+                             offered=loadgen.schedule.offered_rate(schedule),
+                             phases=[p.name for p in phases])
+    issued = report["requests"]
+    settled = (report["completed"] + report["shed"]
+               + report["unavailable"] + report["errors"])
+    problems = []
+    if issued != len(schedule):
+        problems.append(f"issued {issued} != scheduled {len(schedule)}")
+    if settled != issued:
+        problems.append(f"settled {settled} != issued {issued}")
+    if report["completed"] == 0 or report["p50"] is None:
+        problems.append("no completed requests / empty latency histogram")
+    if problems:
+        print("dmtpu loadgen --smoke FAILED: " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    print(f"loadgen smoke ok: {issued} arrivals over "
+          f"{len(phases)} phase(s) in {duration:.1f} virtual s — "
+          f"{report['completed']} completed, {report['shed']} shed, "
+          f"p50 {report['p50']:.3f}s goodput {report['goodput']}/s")
+    return 0
+
+
+def _loadgen_storm(args, phases, schedule) -> int:
+    """A real storm: threaded replica fleet over a shared in-memory
+    object store, seeded with the Zipf head, driven open-loop."""
+    import asyncio
+    import json as json_mod
+
+    import numpy as np
+
+    from distributedmandelbrot_tpu import loadgen
+    from distributedmandelbrot_tpu.core.chunk import Chunk
+    from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+    from distributedmandelbrot_tpu.loadgen.driver import GatewayDriver
+    from distributedmandelbrot_tpu.loadgen.replicas import GatewayFleet
+    from distributedmandelbrot_tpu.net import protocol as proto
+    from distributedmandelbrot_tpu.storage.backends import (
+        MemoryObjectStore, ObjectStoreBackend)
+    from distributedmandelbrot_tpu.storage.store import ChunkStore
+
+    colormap_ids = {name: cid for cid, name in proto.COLORMAPS.items()}
+    if args.render and args.colormap not in colormap_ids:
+        print(f"dmtpu loadgen: unknown colormap {args.colormap!r} "
+              f"(have {sorted(colormap_ids)})", file=sys.stderr)
+        return 2
+
+    kv = MemoryObjectStore()
+    seeder = ChunkStore(backend=ObjectStoreBackend(kv))
+    # RLE-friendly non-constant pixels: long runs, a few distinct values.
+    pixels = np.repeat(np.arange(64, dtype=np.uint8) + 1,
+                       CHUNK_PIXELS // 64)
+    sampler = loadgen.ZipfTiles(args.level, s=args.zipf, seed=args.seed)
+    for level, i, j in sampler.hottest(args.seed_tiles):
+        seeder.save(Chunk(level, i, j, pixels))
+
+    fleet = GatewayFleet(kv, replicas=args.replicas, rate=args.rate,
+                         burst=args.burst,
+                         max_queue_depth=args.queue_depth)
+    with fleet:
+        driver = GatewayDriver(fleet.addresses, render=args.render,
+                               colormap_id=colormap_ids.get(args.colormap,
+                                                            0),
+                               timeout=args.timeout)
+        recorder = loadgen.StormRecorder()
+        runner = loadgen.OpenLoopRunner(schedule, driver, recorder)
+        duration = asyncio.run(runner.run())
+        report = recorder.report(
+            duration=duration,
+            offered=loadgen.schedule.offered_rate(schedule),
+            phases=[p.name for p in phases])
+        report["replicas"] = args.replicas
+        report["gateway_overloaded"] = fleet.counter("gateway_overloaded")
+        report["gateway_served"] = (fleet.counter("gateway_served")
+                                    + fleet.counter(
+                                        "gateway_render_served"))
+    if args.json:
+        print(json_mod.dumps(report, indent=2, sort_keys=True))
+    else:
+        for key in ("requests", "completed", "shed", "unavailable",
+                    "errors", "offered_rate", "goodput", "shed_fraction",
+                    "p50", "p99", "p999", "bytes", "replicas",
+                    "gateway_overloaded", "gateway_served"):
+            print(f"{key:20} {report[key]}")
+        for phase, stats in (report.get("phases") or {}).items():
+            print(f"  {phase:18} p50={stats['p50']} p99={stats['p99']} "
+                  f"p999={stats['p999']}")
+    return 0
+
+
 class _NoFile:
     """Stand-in for findings on unparseable files (no suppressions)."""
 
@@ -1534,7 +1735,7 @@ COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
             "serve": cmd_serve, "viewer": cmd_viewer, "render": cmd_render,
             "animate": cmd_animate, "compact": cmd_compact,
             "stats": cmd_stats, "trace": cmd_trace, "admin": cmd_admin,
-            "check": cmd_check}
+            "check": cmd_check, "loadgen": cmd_loadgen}
 
 
 def _enable_compile_cache() -> None:
@@ -1592,7 +1793,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
               "{coordinator|worker|serve|viewer|render|animate|compact|"
-              "stats|trace|admin|check} [options]\n"
+              "stats|trace|admin|check|loadgen} [options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

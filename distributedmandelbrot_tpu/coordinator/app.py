@@ -56,6 +56,7 @@ class Coordinator:
                  gateway_max_queue_depth: int = 1024,
                  gateway_rate: Optional[float] = None,
                  gateway_burst: float = 256.0,
+                 gateway_render_tiles: int = 64,
                  ondemand_deadline: float = proto.DEFAULT_ONDEMAND_DEADLINE,
                  exporter_port: Optional[int] = None,
                  accept_spans: bool = True,
@@ -141,6 +142,7 @@ class Coordinator:
                     read_timeout=read_timeout,
                     max_queue_depth=gateway_max_queue_depth,
                     rate=gateway_rate, burst=gateway_burst,
+                    render_cache_tiles=gateway_render_tiles,
                     counters=self.counters, trace=self.trace)
             # Durability checkpoints: periodic when checkpoint_period > 0,
             # on-demand always (POST /checkpoint, final write on stop).

@@ -39,7 +39,8 @@ class EmbeddedCoordinator:
         # The embedded form serves tests and benches, so the gateway is on
         # by default (ephemeral port).  gateway_kwargs passes the admission
         # knobs straight through (gateway_max_queue_depth, gateway_rate,
-        # gateway_burst, gateway_cache_tiles, ondemand_deadline).
+        # gateway_burst, gateway_cache_tiles, gateway_render_tiles,
+        # ondemand_deadline).
         if gateway:
             self._kwargs["gateway_port"] = 0
         # The metrics exporter rides along the same way: on by default at

@@ -47,6 +47,17 @@ DataServer protocol (default port 59011): client sends 3 x uint32 LE
 ``(level, index_real, index_imag)``; server replies ``QUERY_ACCEPT`` +
 uint32 payload length + codec payload, ``QUERY_REJECT`` (invalid indices),
 or ``QUERY_NOT_AVAILABLE``.
+
+Gateway rendered-tile query (extension, gateway port only): a query whose
+first u32 is ``GATEWAY_RENDER_MAGIC`` is followed by ``RENDER_QUERY_TAIL``
+— ``(level, index_real, index_imag, colormap u8, flags u8)`` — and
+answered with the standard status byte + length-prefixed body, except the
+body is a colormapped palette PNG of the tile (~50-200 KB hot) instead of
+the 16 MiB escape-count payload.  ``colormap`` must be a registered
+``COLORMAP_*`` id and ``flags`` must be zero (reserved); either violation
+drops the connection via the sanctioned validators.  A legacy DataServer
+would read the magic as a (rejected) level, so only gateways understand
+this framing — same degradation story as ``GATEWAY_BATCH_MAGIC``.
 """
 
 from __future__ import annotations
@@ -119,6 +130,21 @@ QUERY_OVERLOADED = 0x03
 # query.  The value is an impossible level (a level-4294967295 grid), so
 # the two framings can never collide.
 GATEWAY_BATCH_MAGIC = 0xFFFFFFFF
+# Gateway rendered-tile request: the next impossible level down selects
+# the server-side render framing (RENDER_QUERY_TAIL follows the magic).
+GATEWAY_RENDER_MAGIC = 0xFFFFFFFE
+
+# Rendered-tile colormap ids (RENDER_QUERY_TAIL.colormap).  The names are
+# matplotlib colormap names; the table is the wire registry — an id not
+# in it is a protocol violation, not a KeyError deep in the render path.
+COLORMAP_JET = 0x00
+COLORMAP_VIRIDIS = 0x01
+COLORMAP_PLASMA = 0x02
+COLORMAPS: dict[int, str] = {
+    COLORMAP_JET: "jet",
+    COLORMAP_VIRIDIS: "viridis",
+    COLORMAP_PLASMA: "plasma",
+}
 
 # Canonical precompiled wire structs.  These are THE definitions: server
 # and client modules import them instead of re-typing format strings (the
@@ -136,6 +162,12 @@ QUERY_TAIL_WIRE_SIZE = 8
 # Gateway batch header: (GATEWAY_BATCH_MAGIC, count), 2 x u32 LE.
 BATCH_HEADER = struct.Struct("<II")
 BATCH_HEADER_WIRE_SIZE = 8
+# Gateway rendered-tile query minus its leading GATEWAY_RENDER_MAGIC u32:
+# (level, index_real, index_imag, colormap u8 COLORMAP_*, flags u8 —
+# reserved, must be zero).  Like QUERY_TAIL, this is what the gateway
+# still has to read after sniffing the magic.
+RENDER_QUERY_TAIL = struct.Struct("<IIIBB")
+RENDER_QUERY_TAIL_WIRE_SIZE = 14
 
 # Span-report push (PURPOSE_SPANS).  Header: (worker_id u64 — a random
 # per-process id, stable across the worker's many short connections;
@@ -215,6 +247,18 @@ def validate_payload_length(n: int) -> int:
     return validate_count(n, MAX_PAYLOAD_BYTES, "payload length")
 
 
+def validate_colormap(colormap_id: int) -> int:
+    """Check a rendered-tile query's colormap id against the registry.
+
+    Returns the id unchanged when registered; an unknown id is a hostile
+    or version-skewed frame and kills the connection like every other
+    validator failure (the caller bumps its named counter first).
+    """
+    if colormap_id not in COLORMAPS:
+        raise ProtocolError(f"unknown colormap id {colormap_id:#x}")
+    return colormap_id
+
+
 def validate_session_seq(seq: int, expected: int) -> int:
     """Check a session frame's seq against the stream position.
 
@@ -233,11 +277,12 @@ def query_in_range(level: int, index_real: int, index_imag: int) -> bool:
 
     A level-``n`` grid has ``n x n`` tiles, so indices live in
     ``[0, level)``; level 0 does not exist, and ``GATEWAY_BATCH_MAGIC``
-    is reserved as the batch-framing sentinel, never a real level.
-    Unlike :func:`validate_count` this is a predicate: an out-of-range
-    query gets a ``QUERY_REJECT`` reply, not a dropped connection.
+    / ``GATEWAY_RENDER_MAGIC`` are reserved as framing sentinels, never
+    real levels.  Unlike :func:`validate_count` this is a predicate: an
+    out-of-range query gets a ``QUERY_REJECT`` reply, not a dropped
+    connection.
     """
-    if level < 1 or level == GATEWAY_BATCH_MAGIC:
+    if level < 1 or level in (GATEWAY_BATCH_MAGIC, GATEWAY_RENDER_MAGIC):
         return False
     return 0 <= index_real < level and 0 <= index_imag < level
 

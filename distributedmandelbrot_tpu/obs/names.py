@@ -189,6 +189,26 @@ TILE_CACHE_STORE_MISSES = "tile_cache_store_misses"
 GAUGE_TIER1_HIT_RATIO = "tile_cache_tier1_hit_ratio"
 GAUGE_TIER2_HIT_RATIO = "tile_cache_tier2_hit_ratio"
 
+# Rendered-tile tier (GATEWAY_RENDER_MAGIC framing): query/serve volume,
+# the palette-PNG render cache's movement counters and live hit ratio,
+# the render latency histogram, and the named reject counter the fuzz
+# suite pins for unknown colormap ids.
+GATEWAY_RENDER_QUERIES = "gateway_render_queries"
+GATEWAY_RENDER_SERVED = "gateway_render_served"
+GATEWAY_RENDER_CACHE_HITS = "gateway_render_cache_hits"
+GATEWAY_RENDER_CACHE_MISSES = "gateway_render_cache_misses"
+GATEWAY_RENDER_CACHE_EVICTIONS = "gateway_render_cache_evictions"
+GATEWAY_RENDER_UNKNOWN_COLORMAP = "gateway_render_unknown_colormap"
+GAUGE_RENDER_HIT_RATIO = "gateway_render_hit_ratio"
+HIST_GATEWAY_RENDER_SECONDS = "gateway_render_seconds"
+
+# Serve-side RLE recompression of cold raw payloads (legacy raw-only data
+# dirs): payloads re-encoded on promotion, payloads left raw (estimate
+# said RLE cannot win), and wire bytes saved by the re-encode.
+SERVE_RLE_RECOMPRESSIONS = "serve_rle_recompressions"
+SERVE_RLE_SKIPPED = "serve_rle_skipped"
+SERVE_RLE_BYTES_SAVED = "serve_rle_bytes_saved"
+
 COALESCE_LEADERS = "coalesce_leaders"
 COALESCE_FOLLOWERS = "coalesce_followers"
 ONDEMAND_REQUESTS = "ondemand_requests"
@@ -203,6 +223,27 @@ OUTCOME_COMPUTED = "computed"
 OUTCOME_UNAVAILABLE = "unavailable"
 OUTCOME_REJECTED = "rejected"
 OUTCOME_OVERLOADED = "overloaded"
+# Rendered-tile outcomes: served straight from the render cache, or
+# rendered on this request (pixels from tier-1/store/compute).
+OUTCOME_RENDER_CACHE = "render_hit"
+OUTCOME_RENDERED = "rendered"
+
+# -- loadgen (open-loop storm harness) --------------------------------------
+
+# Per-phase request accounting (labels: phase=<phase name>): requests
+# issued on the open-loop schedule, completions by class (OK payloads,
+# OVERLOADED sheds, NOT_AVAILABLE misses, transport/protocol errors),
+# and issues dropped because the client itself ran out of in-flight
+# budget (counted separately — client saturation must never masquerade
+# as server goodput).
+LOADGEN_REQUESTS = "loadgen_requests"
+LOADGEN_COMPLETED = "loadgen_completed"
+LOADGEN_SHED = "loadgen_shed"
+LOADGEN_UNAVAILABLE = "loadgen_unavailable"
+LOADGEN_ERRORS = "loadgen_errors"
+LOADGEN_CLIENT_SATURATED = "loadgen_client_saturated"
+LOADGEN_BYTES = "loadgen_bytes"
+HIST_LOADGEN_LATENCY_SECONDS = "loadgen_latency_seconds"
 
 # -- legacy aliases -------------------------------------------------------
 

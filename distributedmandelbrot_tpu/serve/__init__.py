@@ -4,7 +4,9 @@ The read-side subsystem in front of the store/scheduler/worker farm.  See
 :mod:`.gateway` for the wire formats and admission-control model.
 """
 
-from distributedmandelbrot_tpu.serve.cache import CachedTile, DecodedTileCache
+from distributedmandelbrot_tpu.serve.cache import (CachedTile,
+                                                   DecodedTileCache,
+                                                   RenderedTileCache)
 from distributedmandelbrot_tpu.serve.coalesce import SingleFlight
 from distributedmandelbrot_tpu.serve.gateway import TileGateway, TokenBucket
 from distributedmandelbrot_tpu.serve.ondemand import OnDemandComputer
@@ -12,6 +14,7 @@ from distributedmandelbrot_tpu.serve.ondemand import OnDemandComputer
 __all__ = [
     "CachedTile",
     "DecodedTileCache",
+    "RenderedTileCache",
     "SingleFlight",
     "TileGateway",
     "TokenBucket",

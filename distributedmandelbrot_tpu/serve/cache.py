@@ -8,6 +8,16 @@ decoded pixel array, keyed like the store on ``(level, index_real,
 index_imag)``.  A tier-1 hit serves a query with zero store traffic; a
 tier-1 miss that the store satisfies *promotes* the payload into tier 1.
 
+Promotion is also where cold raw payloads get one shot at the wire-RLE
+win: a payload stored with the Raw codec (legacy raw-only data dirs —
+this repo's own save path already picks the smallest codec) runs the
+RLE ``estimate_ratio`` heuristic and is re-encoded before it enters the
+cache when RLE clearly wins, so every later hit ships the small body.
+
+:class:`RenderedTileCache` is the third tier: colormapped palette-PNG
+bodies keyed by ``(level, index_real, index_imag, colormap_id)`` — a hot
+rendered tile ships ~50-200 KB instead of the 16 MiB escape payload.
+
 Every movement is counted through :class:`~distributedmandelbrot_tpu.utils.
 metrics.Counters` (``tile_cache_hits`` / ``tile_cache_misses`` /
 ``tile_cache_evictions`` / ``tile_cache_promotions``) so the serving bench
@@ -22,12 +32,16 @@ from typing import Optional
 
 import numpy as np
 
+from distributedmandelbrot_tpu import codecs
+from distributedmandelbrot_tpu.codecs.base import RAW_CODE
+from distributedmandelbrot_tpu.codecs.rle import estimate_ratio
 from distributedmandelbrot_tpu.core.chunk import Chunk
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils.metrics import Counters
 
 Key = tuple[int, int, int]
+RenderKey = tuple[int, int, int, int]
 
 
 class CachedTile:
@@ -60,9 +74,13 @@ class DecodedTileCache:
     """
 
     def __init__(self, store: ChunkStore, *, capacity: int = 64,
+                 recompress_min_ratio: float = 2.0,
                  counters: Optional[Counters] = None) -> None:
         self.store = store
         self.capacity = capacity
+        # Minimum estimated RLE ratio before a cold raw payload is
+        # re-encoded on promotion; <= 0 disables the recompression pass.
+        self.recompress_min_ratio = recompress_min_ratio
         self.counters = counters if counters is not None else Counters()
         self._entries: OrderedDict[Key, CachedTile] = OrderedDict()
         self._lock = threading.Lock()
@@ -130,4 +148,90 @@ class DecodedTileCache:
             self.counters.inc(obs_names.TILE_CACHE_STORE_MISSES)
             return None
         self.counters.inc("tile_cache_promotions")
-        return self.put(key, payload)
+        return self.put(key, self._maybe_recompress(payload))
+
+    def _maybe_recompress(self, payload: bytes) -> bytes:
+        """Re-encode a raw-codec payload to RLE when the estimate says the
+        wire win is clear (>= ``recompress_min_ratio``).
+
+        Runs once per promotion, on the store-read thread, so the cost
+        (a strided histogram, plus one boundary pass only for plausible
+        tiles) is paid off-loop and only on cold fetches.  Payloads this
+        repo saved are already pick-smallest encoded; this path is for
+        data dirs written by raw-only writers (the reference's early
+        builds).
+        """
+        if self.recompress_min_ratio <= 0 or not payload \
+                or payload[0] != RAW_CODE:
+            return payload
+        pixels = np.frombuffer(payload, dtype=np.uint8, offset=1)
+        if estimate_ratio(pixels,
+                          self.recompress_min_ratio) < self.recompress_min_ratio:
+            self.counters.inc(obs_names.SERVE_RLE_SKIPPED)
+            return payload
+        body = codecs.RLE.encode(pixels)
+        recoded = bytes([codecs.RLE.code]) + body
+        if len(recoded) >= len(payload):
+            # The estimate was optimistic; keep the bytes we trust.
+            self.counters.inc(obs_names.SERVE_RLE_SKIPPED)
+            return payload
+        self.counters.inc(obs_names.SERVE_RLE_RECOMPRESSIONS)
+        self.counters.inc(obs_names.SERVE_RLE_BYTES_SAVED,
+                          len(payload) - len(recoded))
+        return recoded
+
+
+class RenderedTileCache:
+    """Tier-3 LRU of rendered palette-PNG bodies.
+
+    Keyed by ``(level, index_real, index_imag, colormap_id)`` — the same
+    tile rendered under two colormaps is two entries.  Thread-safe like
+    the decoded-tile tier (the gateway's loop reads inline while renders
+    happen on worker threads); ``capacity`` is in entries, since bodies
+    are already deflate-compressed and roughly uniform for a workload.
+    """
+
+    def __init__(self, *, capacity: int = 64,
+                 counters: Optional[Counters] = None) -> None:
+        self.capacity = capacity
+        self.counters = counters if counters is not None else Counters()
+        self._entries: OrderedDict[RenderKey, bytes] = OrderedDict()
+        self._lock = threading.Lock()
+        registry = self.counters.registry
+
+        def _hit_ratio() -> float:
+            hits = registry.counter_value(
+                obs_names.GATEWAY_RENDER_CACHE_HITS) or 0
+            misses = registry.counter_value(
+                obs_names.GATEWAY_RENDER_CACHE_MISSES) or 0
+            total = hits + misses
+            return hits / total if total else 0.0
+
+        registry.gauge(obs_names.GAUGE_RENDER_HIT_RATIO,
+                       help="rendered-tile LRU hits / lookups",
+                       fn=_hit_ratio)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, key: RenderKey) -> Optional[bytes]:
+        with self._lock:
+            body = self._entries.get(key)
+            if body is None:
+                self.counters.inc(obs_names.GATEWAY_RENDER_CACHE_MISSES)
+                return None
+            self._entries.move_to_end(key)
+            self.counters.inc(obs_names.GATEWAY_RENDER_CACHE_HITS)
+            return body
+
+    def put(self, key: RenderKey, body: bytes) -> bytes:
+        if self.capacity <= 0:
+            return body
+        with self._lock:
+            self._entries[key] = body
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.counters.inc(obs_names.GATEWAY_RENDER_CACHE_EVICTIONS)
+        return body

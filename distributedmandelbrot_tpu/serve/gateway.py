@@ -13,6 +13,13 @@ port:
   queries``; the reply is ``count`` single-query responses in request
   order.  Items resolve concurrently, so a batch of neighbours rides the
   coalescer and the store's readahead instead of serializing round trips.
+- **Rendered query** — first u32 is
+  :data:`~distributedmandelbrot_tpu.net.protocol.GATEWAY_RENDER_MAGIC`,
+  followed by the 14-byte ``RENDER_QUERY_TAIL``; the accept payload is a
+  colormapped palette PNG (:mod:`.render`) instead of the escape-count
+  codec body.  A viewer that only displays tiles downloads ~50-200 KB
+  instead of 16 MiB, which is what makes million-viewer read fan-out a
+  bandwidth problem the gateway can actually win.
 
 On top of the :class:`DataServer` semantics the gateway adds:
 
@@ -34,12 +41,15 @@ import logging
 import time
 from typing import Callable, Optional
 
+from distributedmandelbrot_tpu.core.chunk import Chunk
 from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.trace import TraceLog
-from distributedmandelbrot_tpu.serve.cache import DecodedTileCache
+from distributedmandelbrot_tpu.serve import render
+from distributedmandelbrot_tpu.serve.cache import (DecodedTileCache,
+                                                   RenderedTileCache)
 from distributedmandelbrot_tpu.serve.coalesce import SingleFlight
 from distributedmandelbrot_tpu.serve.ondemand import OnDemandComputer
 from distributedmandelbrot_tpu.utils.metrics import Counters
@@ -89,6 +99,7 @@ class TileGateway:
                  max_queue_depth: int = 1024,
                  rate: Optional[float] = None,
                  burst: float = 256.0,
+                 render_cache_tiles: int = 64,
                  counters: Optional[Counters] = None,
                  trace: Optional[TraceLog] = None) -> None:
         self.cache = cache
@@ -102,6 +113,8 @@ class TileGateway:
         self.trace = trace if trace is not None else TraceLog()
         self.bucket = TokenBucket(rate, burst)
         self.singleflight = SingleFlight(self.counters)
+        self.render_cache = RenderedTileCache(capacity=render_cache_tiles,
+                                              counters=self.counters)
         # Compute-on-read needs the depth the run renders each level at;
         # the scheduler's work definition is the source of truth.
         self._level_max_iter: dict[int, int] = {}
@@ -158,6 +171,8 @@ class TileGateway:
                     break  # clean EOF / idle close between queries
                 if first == proto.GATEWAY_BATCH_MAGIC:
                     await self._serve_batch(reader, writer)
+                elif first == proto.GATEWAY_RENDER_MAGIC:
+                    await self._serve_render(reader, writer)
                 else:
                     rest = await self._read(framing.read_exact(
                         reader, proto.QUERY_TAIL.size))
@@ -202,6 +217,30 @@ class TileGateway:
             *(self._resolve_admitted(*q) for q in queries))
         for status, payload in results:
             self._write_response(writer, status, payload)
+
+    async def _serve_render(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        """One rendered-tile exchange: 14-byte tail in, status (+ PNG) out.
+
+        The tail's colormap id and flags are wire-controlled bytes and go
+        through the sanctioned validators before anything dereferences
+        them; an unknown colormap bumps its own named counter so a fleet
+        of version-skewed viewers shows up as a spike, then kills the
+        connection like every other validator failure.
+        """
+        raw = await self._read(framing.read_exact(
+            reader, proto.RENDER_QUERY_TAIL.size))
+        (level, index_real, index_imag,
+         colormap_id, flags) = proto.RENDER_QUERY_TAIL.unpack(raw)
+        try:
+            proto.validate_colormap(colormap_id)
+        except framing.ProtocolError:
+            self.counters.inc(obs_names.GATEWAY_RENDER_UNKNOWN_COLORMAP)
+            raise
+        proto.validate_count(flags, 0, "render flags")
+        status, payload = await self._resolve_render(
+            level, index_real, index_imag, colormap_id)
+        self._write_response(writer, status, payload)
 
     def _write_response(self, writer: asyncio.StreamWriter, status: int,
                         payload: Optional[bytes]) -> None:
@@ -264,6 +303,92 @@ class TileGateway:
                     obs_names.OUTCOME_UNAVAILABLE)
         self.counters.inc("gateway_served")
         return proto.QUERY_ACCEPT, payload, outcome
+
+    # -- the render path --------------------------------------------------
+
+    async def _resolve_render(
+            self, level: int, index_real: int, index_imag: int,
+            colormap_id: int) -> tuple[int, Optional[bytes]]:
+        """Render-path twin of :meth:`_resolve_admitted`: same admission
+        gates, same latency histogram (new ``outcome`` values), payload is
+        a palette PNG instead of the codec body."""
+        t0 = time.monotonic()
+        status, payload, outcome = await self._render_outcome(
+            level, index_real, index_imag, colormap_id)
+        self.registry.observe(obs_names.HIST_GATEWAY_REQUEST_SECONDS,
+                              time.monotonic() - t0,
+                              labels={"outcome": outcome})
+        if status == proto.QUERY_ACCEPT:
+            self.trace.record(
+                "render_served",
+                (level, index_real, index_imag, colormap_id))
+        return status, payload
+
+    async def _render_outcome(
+            self, level: int, index_real: int, index_imag: int,
+            colormap_id: int) -> tuple[int, Optional[bytes], str]:
+        self.counters.inc(obs_names.GATEWAY_RENDER_QUERIES)
+        if not proto.query_in_range(level, index_real, index_imag):
+            self.counters.inc("gateway_rejected")
+            return proto.QUERY_REJECT, None, obs_names.OUTCOME_REJECTED
+        # Like tier-1 raw hits, rendered-cache hits are answered before
+        # admission: a hot body is a memcpy, and the render cache is the
+        # whole point under flash-crowd load.
+        render_key = (level, index_real, index_imag, colormap_id)
+        body = self.render_cache.get(render_key)
+        if body is not None:
+            self.counters.inc(obs_names.GATEWAY_RENDER_SERVED)
+            return (proto.QUERY_ACCEPT, body,
+                    obs_names.OUTCOME_RENDER_CACHE)
+        if self._active >= self.max_queue_depth \
+                or not self.bucket.try_acquire():
+            self.counters.inc("gateway_overloaded")
+            logger.info("shed render (%d,%d,%d): %d in service",
+                        level, index_real, index_imag, self._active)
+            return proto.QUERY_OVERLOADED, None, obs_names.OUTCOME_OVERLOADED
+        self._active += 1
+        try:
+            body = await self._render(level, index_real, index_imag,
+                                      colormap_id)
+        finally:
+            self._active -= 1
+        if body is None:
+            self.counters.inc("gateway_unavailable")
+            return (proto.QUERY_NOT_AVAILABLE, None,
+                    obs_names.OUTCOME_UNAVAILABLE)
+        self.counters.inc(obs_names.GATEWAY_RENDER_SERVED)
+        return proto.QUERY_ACCEPT, body, obs_names.OUTCOME_RENDERED
+
+    async def _render(self, level: int, index_real: int, index_imag: int,
+                      colormap_id: int) -> Optional[bytes]:
+        """Resolve the escape payload through the raw serve path (tier-1 /
+        store / compute-on-read, coalesced), then colormap + PNG-encode on
+        a worker thread.  Single-flight per (tile, colormap): a stampede
+        on one hot rendered tile costs one render."""
+        max_iter = self._level_max_iter.get(level)
+        flight_key = ("render", level, max_iter, index_real, index_imag,
+                      colormap_id)
+
+        async def supplier() -> Optional[bytes]:
+            payload, _outcome = await self._resolve(level, index_real,
+                                                    index_imag)
+            if payload is None:
+                return None
+            t0 = time.monotonic()
+            body = await asyncio.to_thread(
+                self._render_body, payload, colormap_id)
+            self.registry.observe(obs_names.HIST_GATEWAY_RENDER_SECONDS,
+                                  time.monotonic() - t0)
+            return self.render_cache.put(
+                (level, index_real, index_imag, colormap_id), body)
+
+        return await self.singleflight.run(flight_key, supplier)
+
+    def _render_body(self, payload: bytes, colormap_id: int) -> bytes:
+        """Blocking decode + render; runs on a worker thread."""
+        pixels = Chunk.deserialize_data(payload)
+        return render.render_tile_png(pixels,
+                                      proto.COLORMAPS[colormap_id])
 
     async def _resolve(self, level: int, index_real: int,
                        index_imag: int) -> tuple[Optional[bytes], str]:

@@ -1,0 +1,225 @@
+"""Shared colormapping core + server-side tile rendering to palette PNG.
+
+The colormap pipeline used to live in ``viewer/render.py`` (client-side
+only, every viewer shipping the raw 16 MiB payload first); it moved here
+so the gateway can render on the server and the viewer keeps consuming
+the exact same functions — the golden parity test pins server bytes ==
+viewer bytes.  ``value_to_rgba`` reproduces the reference viewer's
+pipeline exactly (``DistributedMandelbrotViewer.py:110-135``): normalize
+/256, invert, apply matplotlib's ``jet``, then paint in-set pixels
+(value 0, i.e. inverted 1.0) black.
+
+Server-side rendering exploits that a colormapped escape-count tile has
+at most 256 distinct colors (one per uint8 value, with value 0 forced
+black): the wire image is an 8-bit *palette* PNG whose PLTE is the
+colormap LUT and whose index plane is the escape counts themselves.
+Smooth interior tiles deflate to ~50-200 KB; the worst case (boundary
+soup) stays under the raw 16 MiB, so the render body always fits the
+``MAX_PAYLOAD_BYTES`` bound.  Encoder and decoder are stdlib ``zlib``
+only — no imaging dependency, and byte-deterministic for the parity
+test.
+
+matplotlib is imported lazily inside the colormap calls, so importing
+this module (and everything above it: gateway, loadgen, ``dmtpu check``)
+stays matplotlib-free.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import zlib
+
+import numpy as np
+
+PNG_SIGNATURE = b"\x89PNG\r\n\x1a\n"
+
+# LUT memo: building one costs a 256-element matplotlib colormap call;
+# the gateway renders thousands of tiles per colormap.
+_LUT_LOCK = threading.Lock()
+_LUTS: dict[str, np.ndarray] = {}
+
+
+def _masked_colormap(vs: np.ndarray, in_set: np.ndarray,
+                     colormap: str) -> np.ndarray:
+    """Shared tail of both render paths: colormap ``vs``, paint in-set
+    pixels black."""
+    import matplotlib
+
+    mapped = matplotlib.colormaps[colormap](vs).astype(float)
+    black = np.array((0.0, 0.0, 0.0, 1.0))
+    return np.where(in_set[..., None], black, mapped)
+
+
+def value_to_rgba(values: np.ndarray, colormap: str = "jet") -> np.ndarray:
+    """Flat or 2-D uint8 values -> float RGBA array (reference pipeline)."""
+    if values.ndim == 1:
+        side = int(round(values.size ** 0.5))
+        if side * side != values.size:
+            raise ValueError(f"cannot square-reshape {values.size} pixels")
+        values = values.reshape((side, side))
+    vs = 1.0 - values.astype(float) / 256.0
+    return _masked_colormap(vs, vs == 1.0, colormap)
+
+
+def smooth_to_rgba(nu: np.ndarray, max_iter: int,
+                   colormap: str = "jet",
+                   normalize: bool = False) -> np.ndarray:
+    """Continuous escape values (:func:`...ops.escape_smooth`) -> RGBA.
+
+    Same visual convention as :func:`value_to_rgba` — in-set (0) pixels
+    black, others through the inverted colormap — but band-free: the
+    fractional part of ``nu`` varies continuously across iteration
+    boundaries.  Log-scaled so deep zooms (large max_iter) keep contrast.
+
+    ``normalize`` stretches the view's OWN escaped-value range over the
+    full colormap (log-domain min-max): deep windows occupy a sliver of
+    the absolute scale (a span-1e-10 view at budget 50000 spans ~6% of
+    it — near-flat color), and auto-contrast is what makes them
+    readable.  View-dependent by construction, so animations must NOT
+    use it per-frame (the stretch would flicker as ranges drift).
+    """
+    nu = np.asarray(nu, float)
+    logs = np.log1p(np.maximum(nu, 0.0))
+    escaped = nu > 0.0
+    if normalize and escaped.any():
+        sel = logs[escaped]
+        lo, hi = float(sel.min()), float(sel.max())
+        vs = (logs - lo) / max(hi - lo, 1e-12)
+    else:
+        vs = logs / np.log1p(float(max_iter))
+    return _masked_colormap(1.0 - np.clip(vs, 0.0, 1.0), nu <= 0.0, colormap)
+
+
+def to_rgba8(rgba: np.ndarray) -> np.ndarray:
+    """Quantize float RGBA in [0, 1] to uint8 — THE quantization step.
+
+    Both the viewer's save path and the server's palette build go through
+    this one function, which is what makes "server-rendered bytes ==
+    viewer-rendered bytes" a theorem instead of a hope.
+    """
+    return (np.clip(np.asarray(rgba, float), 0.0, 1.0) * 255.0
+            + 0.5).astype(np.uint8)
+
+
+def value_lut(colormap: str = "jet") -> np.ndarray:
+    """(256, 4) uint8 RGBA lookup table: LUT[v] is the rendered color of
+    escape value ``v`` under :func:`value_to_rgba` + :func:`to_rgba8`.
+
+    Built by pushing all 256 values through the float pipeline once, so
+    ``LUT[tile]`` is elementwise identical to quantizing the viewer's
+    full-tile render (matplotlib colormaps are pointwise).
+    """
+    with _LUT_LOCK:
+        lut = _LUTS.get(colormap)
+        if lut is None:
+            values = np.arange(256, dtype=np.uint8)
+            lut = to_rgba8(value_to_rgba(values, colormap)).reshape(256, 4)
+            lut.setflags(write=False)
+            _LUTS[colormap] = lut
+        return lut
+
+
+def render_tile_rgba8(values: np.ndarray,
+                      colormap: str = "jet") -> np.ndarray:
+    """Render flat or 2-D uint8 escape values to a uint8 RGBA image via
+    the colormap LUT (the server's render path)."""
+    square = _as_square(values)
+    return value_lut(colormap)[square]
+
+
+def _as_square(values: np.ndarray) -> np.ndarray:
+    values = np.ascontiguousarray(values, dtype=np.uint8)
+    if values.ndim == 1:
+        side = int(round(values.size ** 0.5))
+        if side * side != values.size:
+            raise ValueError(f"cannot square-reshape {values.size} pixels")
+        values = values.reshape((side, side))
+    return values
+
+
+def _png_chunk(tag: bytes, body: bytes) -> bytes:
+    return (struct.pack(">I", len(body)) + tag + body
+            + struct.pack(">I", zlib.crc32(tag + body)))
+
+
+def render_tile_png(values: np.ndarray, colormap: str = "jet", *,
+                    compress_level: int = 6) -> bytes:
+    """Encode a tile as an 8-bit palette PNG (color type 3, filter 0).
+
+    The index plane IS the escape-count tile; the PLTE is the colormap
+    LUT's RGB (alpha is 255 everywhere by construction, so no tRNS).
+    Deterministic: fixed filter, fixed zlib level, no ancillary chunks.
+    """
+    square = _as_square(values)
+    height, width = square.shape
+    lut = value_lut(colormap)
+    # Each scanline is a filter byte (0 = None) then the raw indices.
+    scanlines = np.zeros((height, width + 1), dtype=np.uint8)
+    scanlines[:, 1:] = square
+    ihdr = struct.pack(">IIBBBBB", width, height, 8, 3, 0, 0, 0)
+    return (PNG_SIGNATURE
+            + _png_chunk(b"IHDR", ihdr)
+            + _png_chunk(b"PLTE", lut[:, :3].tobytes())
+            + _png_chunk(b"IDAT", zlib.compress(scanlines.tobytes(),
+                                                compress_level))
+            + _png_chunk(b"IEND", b""))
+
+
+def decode_rendered_png(data: bytes) -> np.ndarray:
+    """Decode a :func:`render_tile_png` body back to uint8 RGBA.
+
+    Intentionally narrow — palette PNGs with filter 0 only, i.e. exactly
+    what this module emits — so the parity test and the loadgen's body
+    validation don't need an imaging library.  Raises ``ValueError`` on
+    anything else.
+    """
+    if not data.startswith(PNG_SIGNATURE):
+        raise ValueError("not a PNG")
+    pos = len(PNG_SIGNATURE)
+    ihdr = None
+    palette = None
+    idat = bytearray()
+    while pos + 8 <= len(data):
+        (length,) = struct.unpack_from(">I", data, pos)
+        tag = data[pos + 4:pos + 8]
+        body = data[pos + 8:pos + 8 + length]
+        pos += 12 + length  # length + tag + body + crc
+        if tag == b"IHDR":
+            ihdr = struct.unpack(">IIBBBBB", body)
+        elif tag == b"PLTE":
+            palette = np.frombuffer(body, np.uint8).reshape(-1, 3)
+        elif tag == b"IDAT":
+            idat += body
+        elif tag == b"IEND":
+            break
+    if ihdr is None or palette is None or not idat:
+        raise ValueError("missing IHDR/PLTE/IDAT chunk")
+    width, height, depth, color_type, _, _, interlace = ihdr
+    if (depth, color_type, interlace) != (8, 3, 0):
+        raise ValueError(
+            f"unsupported PNG shape: depth={depth} color={color_type} "
+            f"interlace={interlace}")
+    # Bounded inflate: IHDR fixes the decoded size, so cap decompression
+    # there instead of letting a 200-byte deflate bomb expand to
+    # gigabytes before the size check (same posture as the RLE codec's
+    # bomb guard).
+    expected = height * (width + 1)
+    inflater = zlib.decompressobj()
+    decoded = inflater.decompress(bytes(idat), expected)
+    if not inflater.eof or inflater.unconsumed_tail \
+            or inflater.decompress(b"", 1):
+        raise ValueError(
+            f"IDAT decodes past the {expected} bytes IHDR promises")
+    raw = np.frombuffer(decoded, np.uint8)
+    if raw.size != expected:
+        raise ValueError(f"IDAT decodes to {raw.size} bytes, expected "
+                         f"{expected}")
+    scanlines = raw.reshape(height, width + 1)
+    if np.any(scanlines[:, 0] != 0):
+        raise ValueError("unsupported PNG filter (encoder emits 0 only)")
+    indices = scanlines[:, 1:]
+    rgba = np.empty((height, width, 4), dtype=np.uint8)
+    rgba[..., :3] = palette[indices]
+    rgba[..., 3] = 255
+    return rgba

@@ -1,5 +1,16 @@
-"""Cross-cutting utilities: precision, logging, metrics."""
+"""Cross-cutting utilities: precision, logging, metrics.
 
-from distributedmandelbrot_tpu.utils.precision import ensure_x64, x64_enabled
+The precision helpers are re-exported lazily (PEP 562): they import jax,
+and an eager re-export would make *every* transitive importer of this
+package (storage, serve, loadgen, the analysis CLI) require jax at
+import time — the read path and the checkers are jax-free by design.
+"""
 
 __all__ = ["ensure_x64", "x64_enabled"]
+
+
+def __getattr__(name: str):
+    if name in __all__:
+        from distributedmandelbrot_tpu.utils import precision
+        return getattr(precision, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -99,6 +99,50 @@ class DataClient:
         payload = framing.recv_exact(sock, length)
         return Chunk.deserialize_data(payload), FetchStatus.OK
 
+    def fetch_render(self, level: int, index_real: int, index_imag: int,
+                     colormap_id: int = proto.COLORMAP_JET
+                     ) -> tuple[Optional[bytes], FetchStatus]:
+        """Fetch one tile server-rendered as a palette PNG (gateway
+        extension): returns the PNG body bytes instead of escape counts.
+
+        Decode with :func:`distributedmandelbrot_tpu.serve.render.
+        decode_rendered_png` (or any PNG library) — the bytes are pinned
+        bit-identical to rendering the raw tile client-side.  Gateway
+        only, like :meth:`fetch_many`.
+        """
+        try:
+            return self._fetch_render_once(level, index_real, index_imag,
+                                           colormap_id)
+        except (ConnectionError, OSError):
+            self.close()
+            return self._fetch_render_once(level, index_real, index_imag,
+                                           colormap_id)
+
+    def _fetch_render_once(self, level: int, index_real: int,
+                           index_imag: int, colormap_id: int
+                           ) -> tuple[Optional[bytes], FetchStatus]:
+        sock = self._connected()
+        framing.send_u32(sock, proto.GATEWAY_RENDER_MAGIC)
+        return self._render_exchange(sock, level, index_real, index_imag,
+                                     colormap_id)
+
+    def _render_exchange(self, sock: socket.socket, level: int,
+                         index_real: int, index_imag: int, colormap_id: int
+                         ) -> tuple[Optional[bytes], FetchStatus]:
+        """The post-magic exchange: 14-byte tail out, status (+ PNG) in.
+        (Split from :meth:`_fetch_render_once` so it mirrors the server's
+        post-magic handler frame for frame.)"""
+        framing.send_all(sock, proto.RENDER_QUERY_TAIL.pack(
+            level, index_real, index_imag, colormap_id, 0))
+        status = framing.recv_byte(sock)
+        miss = _STATUS_BY_BYTE.get(status)
+        if miss is not None:
+            return None, miss
+        if status != proto.QUERY_ACCEPT:
+            raise framing.ProtocolError(f"unknown query status {status:#x}")
+        length = proto.validate_payload_length(framing.recv_u32(sock))
+        return framing.recv_exact(sock, length), FetchStatus.OK
+
     def fetch_many(self, queries: list[tuple[int, int, int]]
                    ) -> list[tuple[Optional[np.ndarray], FetchStatus]]:
         """Batched fetch (gateway extension): one round trip for N tiles.

@@ -1,9 +1,11 @@
 """Rendering: uint8 pixel values -> RGBA images, plus multi-chunk stitching.
 
-``value_to_rgba`` reproduces the reference viewer's colormap pipeline
-exactly (``DistributedMandelbrotViewer.py:110-135``): normalize /256,
-invert, apply matplotlib's ``jet``, then paint in-set pixels (value 0,
-i.e. inverted 1.0) black.
+The colormap core (``value_to_rgba`` / ``smooth_to_rgba`` and their
+shared ``_masked_colormap`` tail) lives in
+:mod:`distributedmandelbrot_tpu.serve.render` since the gateway renders
+the same pipeline server-side; this module re-exports it so every
+existing viewer import keeps working, and the golden parity test pins
+that both consumers see identical bytes.
 
 Stitching a whole level into one image is a natural capability extension
 (the reference renders only single chunks).
@@ -16,57 +18,9 @@ from typing import Callable, Optional
 import numpy as np
 
 from distributedmandelbrot_tpu.core.geometry import CHUNK_WIDTH
-
-
-def _masked_colormap(vs: np.ndarray, in_set: np.ndarray,
-                     colormap: str) -> np.ndarray:
-    """Shared tail of both render paths: colormap ``vs``, paint in-set
-    pixels black."""
-    import matplotlib
-
-    mapped = matplotlib.colormaps[colormap](vs).astype(float)
-    black = np.array((0.0, 0.0, 0.0, 1.0))
-    return np.where(in_set[..., None], black, mapped)
-
-
-def value_to_rgba(values: np.ndarray, colormap: str = "jet") -> np.ndarray:
-    """Flat or 2-D uint8 values -> float RGBA array (reference pipeline)."""
-    if values.ndim == 1:
-        side = int(round(values.size ** 0.5))
-        if side * side != values.size:
-            raise ValueError(f"cannot square-reshape {values.size} pixels")
-        values = values.reshape((side, side))
-    vs = 1.0 - values.astype(float) / 256.0
-    return _masked_colormap(vs, vs == 1.0, colormap)
-
-
-def smooth_to_rgba(nu: np.ndarray, max_iter: int,
-                   colormap: str = "jet",
-                   normalize: bool = False) -> np.ndarray:
-    """Continuous escape values (:func:`...ops.escape_smooth`) -> RGBA.
-
-    Same visual convention as :func:`value_to_rgba` — in-set (0) pixels
-    black, others through the inverted colormap — but band-free: the
-    fractional part of ``nu`` varies continuously across iteration
-    boundaries.  Log-scaled so deep zooms (large max_iter) keep contrast.
-
-    ``normalize`` stretches the view's OWN escaped-value range over the
-    full colormap (log-domain min-max): deep windows occupy a sliver of
-    the absolute scale (a span-1e-10 view at budget 50000 spans ~6% of
-    it — near-flat color), and auto-contrast is what makes them
-    readable.  View-dependent by construction, so animations must NOT
-    use it per-frame (the stretch would flicker as ranges drift).
-    """
-    nu = np.asarray(nu, float)
-    logs = np.log1p(np.maximum(nu, 0.0))
-    escaped = nu > 0.0
-    if normalize and escaped.any():
-        sel = logs[escaped]
-        lo, hi = float(sel.min()), float(sel.max())
-        vs = (logs - lo) / max(hi - lo, 1e-12)
-    else:
-        vs = logs / np.log1p(float(max_iter))
-    return _masked_colormap(1.0 - np.clip(vs, 0.0, 1.0), nu <= 0.0, colormap)
+# Canonical re-exports of the shared colormap core (see module docstring).
+from distributedmandelbrot_tpu.serve.render import (  # noqa: F401
+    _masked_colormap, smooth_to_rgba, value_to_rgba)
 
 
 def stitch_level(fetch: Callable[[int, int], Optional[np.ndarray]],

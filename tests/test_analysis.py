@@ -800,6 +800,89 @@ def test_proto_session_dispatch_fires_without_emitter():
     assert "PURPOSE_SESSION has no client emitter" in found[0].message
 
 
+# The magic-dispatched rendered-tile exchange (QUERY_EXCHANGES entry
+# "render_query"): no purpose byte — the gateway sniffs a magic u32 —
+# so client emitter and server handler are paired by qualname.
+RENDER_PROTO_SRC = PROTO_SRC + '''
+RENDER_QUERY_TAIL = struct.Struct("<IIIBB")
+RENDER_QUERY_TAIL_WIRE_SIZE = RENDER_QUERY_TAIL.size
+'''
+
+RENDER_CLIENT = f"{P}/viewer/client.py"
+RENDER_CLIENT_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (recv_byte, recv_exact,
+                                                   recv_u32, send_all)
+
+class DataClient:
+    def _render_exchange(self, sock, level, i, j, colormap_id):
+        send_all(sock, proto.RENDER_QUERY_TAIL.pack(level, i, j,
+                                                    colormap_id, 0))
+        status = recv_byte(sock)
+        length = recv_u32(sock)
+        return recv_exact(sock, length), status
+'''
+
+RENDER_SERVER = f"{P}/serve/gateway.py"
+RENDER_SERVER_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (read_exact, write_byte,
+                                                   write_u32)
+
+class TileGateway:
+    async def _serve_render(self, reader, writer):
+        raw = await read_exact(reader, proto.RENDER_QUERY_TAIL.size)
+        level, i, j, colormap_id, flags = proto.RENDER_QUERY_TAIL.unpack(raw)
+        body = self._render(level, i, j, colormap_id)
+        write_byte(writer, 0x10)
+        write_u32(writer, len(body))
+        writer.write(body)
+'''
+
+RENDER_SOURCES = {PROTO_MOD: RENDER_PROTO_SRC,
+                  RENDER_CLIENT: RENDER_CLIENT_SRC,
+                  RENDER_SERVER: RENDER_SERVER_SRC}
+
+
+def test_proto_render_exchange_clean_when_sequences_match():
+    for rule in ("proto-frames", "proto-exact-read"):
+        assert findings_for(RENDER_SOURCES, rule) == []
+
+
+def test_proto_render_exchange_fires_when_client_sends_wrong_struct():
+    # Version-skew drift: a client still speaking the raw-tile QUERY at
+    # a render endpoint must be caught as a sequence mismatch.
+    skewed = dict(RENDER_SOURCES)
+    skewed[RENDER_CLIENT] = RENDER_CLIENT_SRC.replace(
+        "proto.RENDER_QUERY_TAIL.pack(level, i, j,\n"
+        "                                                    colormap_id, 0)",
+        "proto.QUERY.pack(level, i, j)")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "render_query" in found[0].message
+    assert "client sends [QUERY]" in found[0].message
+    assert "server reads [RENDER_QUERY_TAIL]" in found[0].message
+    assert found[0].path == RENDER_SERVER
+
+
+def test_proto_render_exchange_fires_when_server_drops_status_byte():
+    skewed = dict(RENDER_SOURCES)
+    skewed[RENDER_SERVER] = RENDER_SERVER_SRC.replace(
+        "        write_byte(writer, 0x10)\n", "")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "client awaits [BYTE, U32, ?]" in found[0].message
+    assert "server writes [U32, ?]" in found[0].message
+
+
+def test_proto_render_exchange_skipped_when_one_side_absent():
+    # Exchange parity only applies when both qualnames exist — fixture
+    # projects (and partial source sets) must stay silent.
+    one_sided = {PROTO_MOD: RENDER_PROTO_SRC,
+                 RENDER_CLIENT: RENDER_CLIENT_SRC}
+    assert findings_for(one_sided, "proto-frames") == []
+
+
 # -- res -------------------------------------------------------------------
 
 def test_res_thread_join_fires_on_unjoined_handleless_thread():

@@ -337,3 +337,52 @@ def test_gateway_rejects_malformed_frames_and_stays_alive(tmp_path):
         rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
                                  rejected + 1)
         _assert_gateway_alive(farm)
+
+
+def test_gateway_rejects_malformed_render_frames_and_stays_alive(tmp_path):
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        rejected = 0
+
+        # Truncated render tail: magic promised 14 bytes, 6 arrive.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_RENDER_MAGIC)
+                         + proto.RENDER_QUERY_TAIL.pack(
+                             1, 0, 0, proto.COLORMAP_JET, 0)[:6])
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_gateway_alive(farm)
+
+        # Unknown colormap id: clean drop, its own named counter (a fleet
+        # of version-skewed viewers must show up as a spike), plus the
+        # generic frames-rejected trail.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_RENDER_MAGIC)
+                         + proto.RENDER_QUERY_TAIL.pack(1, 0, 0, 0xEE, 0))
+            assert _recv_all(sock) == b""
+        assert _wait_counter(
+            farm, obs_names.GATEWAY_RENDER_UNKNOWN_COLORMAP, 1) >= 1
+        rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_gateway_alive(farm)
+
+        # Reserved flags set: dropped before any render work happens.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_RENDER_MAGIC)
+                         + proto.RENDER_QUERY_TAIL.pack(
+                             1, 0, 0, proto.COLORMAP_JET, 0x80))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_gateway_alive(farm)
+
+        # Out-of-range render query (level 0): an in-band REJECT, not a
+        # drop — same contract as the raw framing.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_RENDER_MAGIC)
+                         + proto.RENDER_QUERY_TAIL.pack(
+                             0, 0, 0, proto.COLORMAP_JET, 0))
+            status = sock.recv(1)
+            assert status[0] == proto.QUERY_REJECT
+        _assert_gateway_alive(farm)

@@ -1,0 +1,230 @@
+"""Server-side rendering: golden parity, PNG codec guards, cache tiers.
+
+The headline contract is bit-parity: the bytes a gateway serves for a
+rendered tile must equal the bytes the viewer would have produced by
+fetching the raw tile and colormapping it locally.  Parity holds by
+construction (both paths share one quantization + LUT), and these tests
+pin the construction — over every escape value, every registered
+colormap, and end-to-end through a real replica fleet.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core.chunk import Chunk
+from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+from distributedmandelbrot_tpu.net import framing
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.serve import render
+from distributedmandelbrot_tpu.serve.cache import (DecodedTileCache,
+                                                   RenderedTileCache)
+from distributedmandelbrot_tpu.storage.backends import (MemoryObjectStore,
+                                                        ObjectStoreBackend)
+from distributedmandelbrot_tpu.storage.store import ChunkStore
+from distributedmandelbrot_tpu.utils.metrics import Counters
+from distributedmandelbrot_tpu.viewer.client import DataClient, FetchStatus
+
+from distributedmandelbrot_tpu.loadgen.replicas import GatewayFleet
+
+
+def _tile_pixels() -> np.ndarray:
+    """A full-size tile touching every escape value, plus in-set runs."""
+    pixels = np.tile(np.arange(256, dtype=np.uint8), CHUNK_PIXELS // 256)
+    pixels[:4096] = 0  # an in-set (forced-black) band
+    return pixels
+
+
+# -- golden parity ----------------------------------------------------------
+
+def test_lut_render_matches_viewer_float_pipeline_all_values():
+    pytest.importorskip("matplotlib")
+    values = np.arange(256, dtype=np.uint8).reshape(16, 16)
+    for colormap in proto.COLORMAPS.values():
+        via_lut = render.render_tile_rgba8(values, colormap)
+        via_floats = render.to_rgba8(render.value_to_rgba(values, colormap))
+        assert np.array_equal(via_lut, via_floats), colormap
+    # Value 0 (in-set) is painted opaque black in every colormap.
+    assert np.array_equal(render.value_lut("jet")[0], [0, 0, 0, 255])
+
+
+def test_png_roundtrip_is_lossless_and_deterministic():
+    pytest.importorskip("matplotlib")
+    rng = np.random.default_rng(5)
+    values = rng.integers(0, 256, size=(64, 64), dtype=np.uint8)
+    body = render.render_tile_png(values, "viridis")
+    assert body == render.render_tile_png(values, "viridis")
+    rgba = render.decode_rendered_png(body)
+    assert np.array_equal(rgba, render.render_tile_rgba8(values, "viridis"))
+
+
+def test_server_rendered_bytes_equal_viewer_rendered_bytes_e2e():
+    """The acceptance criterion, through real sockets: fetch the raw
+    tile and the server-rendered PNG from a replica fleet, render the
+    raw tile viewer-side, compare bytes."""
+    pytest.importorskip("matplotlib")
+    pixels = _tile_pixels()
+    kv = MemoryObjectStore()
+    ChunkStore(backend=ObjectStoreBackend(kv)).save(Chunk(2, 1, 0, pixels))
+    with GatewayFleet(kv, replicas=1) as fleet:
+        host, port = fleet.addresses[0]
+        with DataClient(host, port) as client:
+            raw, status = client.fetch(2, 1, 0)
+            assert status is FetchStatus.OK
+            body, status = client.fetch_render(2, 1, 0,
+                                               proto.COLORMAP_PLASMA)
+            assert status is FetchStatus.OK
+            # Second fetch is a rendered-cache hit; bytes must not drift.
+            again, _ = client.fetch_render(2, 1, 0, proto.COLORMAP_PLASMA)
+    assert np.array_equal(raw, pixels)
+    viewer_rgba = render.to_rgba8(render.value_to_rgba(raw, "plasma"))
+    server_rgba = render.decode_rendered_png(body)
+    assert np.array_equal(server_rgba, viewer_rgba)
+    assert again == body
+    assert fleet.counter(obs_names.GATEWAY_RENDER_CACHE_HITS) >= 1
+    # The hot body is the bandwidth story: tiny next to the raw payload.
+    assert len(body) < CHUNK_PIXELS // 10
+
+
+def test_render_unavailable_and_overload_statuses_flow_to_client():
+    kv = MemoryObjectStore()
+    with GatewayFleet(kv, replicas=1, rate=0.001, burst=1.0) as fleet:
+        host, port = fleet.addresses[0]
+        with DataClient(host, port) as client:
+            # Burst token pays for the first query: a store miss.
+            body, status = client.fetch_render(1, 0, 0)
+            assert body is None and status is FetchStatus.NOT_AVAILABLE
+            # Bucket empty: admission control sheds before resolving.
+            body, status = client.fetch_render(1, 0, 0)
+            assert body is None and status is FetchStatus.OVERLOADED
+
+
+# -- PNG decoder guards -----------------------------------------------------
+
+def test_png_decoder_rejects_bombs_and_foreign_shapes():
+    import struct
+    import zlib
+
+    values = np.zeros((8, 8), dtype=np.uint8)
+    body = render.render_tile_png(values)
+
+    with pytest.raises(ValueError):
+        render.decode_rendered_png(b"GIF89a" + body)
+
+    # IHDR promises 8x8 but IDAT inflates to a megabyte: the bounded
+    # inflate must refuse without materializing the bomb.
+    bomb_idat = zlib.compress(b"\x00" * (1 << 20))
+    pos = len(render.PNG_SIGNATURE)
+    chunks = []
+    data = body
+    while pos + 8 <= len(data):
+        (length,) = struct.unpack_from(">I", data, pos)
+        tag = data[pos + 4:pos + 8]
+        chunks.append((tag, data[pos + 8:pos + 8 + length]))
+        pos += 12 + length
+    rebuilt = render.PNG_SIGNATURE
+    for tag, chunk_body in chunks:
+        if tag == b"IDAT":
+            chunk_body = bomb_idat
+        rebuilt += (struct.pack(">I", len(chunk_body)) + tag + chunk_body
+                    + struct.pack(">I", zlib.crc32(tag + chunk_body)))
+    with pytest.raises(ValueError, match="IHDR promises|expected"):
+        render.decode_rendered_png(rebuilt)
+
+    # Truthful truecolor PNG: refused as a foreign shape, not decoded.
+    ihdr = struct.pack(">IIBBBBB", 8, 8, 8, 2, 0, 0, 0)
+    foreign = render.PNG_SIGNATURE + b"".join(
+        struct.pack(">I", len(b)) + t + b
+        + struct.pack(">I", zlib.crc32(t + b))
+        for t, b in ((b"IHDR", ihdr), (b"PLTE", b"\x00" * 768),
+                     (b"IDAT", zlib.compress(b"\x00" * (8 * 25))),
+                     (b"IEND", b"")))
+    with pytest.raises(ValueError, match="unsupported PNG shape"):
+        render.decode_rendered_png(foreign)
+
+
+def test_render_rejects_non_square_pixel_counts():
+    with pytest.raises(ValueError, match="square"):
+        render.render_tile_png(np.zeros(37, dtype=np.uint8))
+
+
+# -- rendered-tile cache tier ----------------------------------------------
+
+def test_rendered_cache_lru_counters_and_gauge():
+    counters = Counters()
+    cache = RenderedTileCache(capacity=2, counters=counters)
+    k1, k2, k3 = (1, 0, 0, 0), (2, 0, 0, 0), (2, 1, 0, 1)
+    assert cache.get(k1) is None
+    cache.put(k1, b"one")
+    cache.put(k2, b"two")
+    assert cache.get(k1) == b"one"  # refreshes k1; k2 is now LRU
+    cache.put(k3, b"three")
+    assert len(cache) == 2
+    assert cache.get(k2) is None  # evicted
+    assert counters.get(obs_names.GATEWAY_RENDER_CACHE_EVICTIONS) == 1
+    hits = counters.get(obs_names.GATEWAY_RENDER_CACHE_HITS)
+    misses = counters.get(obs_names.GATEWAY_RENDER_CACHE_MISSES)
+    assert (hits, misses) == (1, 2)
+    gauges = counters.registry.snapshot()["gauges"]
+    assert gauges[obs_names.GAUGE_RENDER_HIT_RATIO] == pytest.approx(
+        hits / (hits + misses))
+
+
+# -- promotion-time RLE recompression ---------------------------------------
+
+class _RawPayloadStore:
+    """Stub store handing back raw-codec payloads (a legacy data dir)."""
+
+    def __init__(self, pixels: np.ndarray) -> None:
+        self.payload = bytes([0x00]) + pixels.tobytes()
+
+    def load_payload(self, level, i, j):
+        return self.payload
+
+
+def test_promotion_recompresses_raw_runs_and_counts_savings():
+    # Interior-dominated tile: estimate_ratio's histogram pre-filter
+    # demands one escape count hold most of the tile before it pays for
+    # an exact run count (see codecs/rle.py).
+    pixels = np.full(CHUNK_PIXELS, 200, dtype=np.uint8)
+    pixels[:4096] = np.repeat(np.arange(16, dtype=np.uint8), 256)
+    counters = Counters()
+    cache = DecodedTileCache(_RawPayloadStore(pixels), capacity=4,
+                             counters=counters)
+    entry = cache.load((1, 0, 0))
+    assert entry.payload[0] != 0x00  # re-encoded away from Raw
+    assert len(entry.payload) < len(pixels.tobytes()) // 100
+    assert np.array_equal(entry.pixels, pixels)  # still decodes intact
+    assert counters.get(obs_names.SERVE_RLE_RECOMPRESSIONS) == 1
+    saved = counters.get(obs_names.SERVE_RLE_BYTES_SAVED)
+    assert saved == len(pixels) + 1 - len(entry.payload)
+
+
+def test_promotion_skips_incompressible_and_disabled():
+    rng = np.random.default_rng(9)
+    noise = rng.integers(0, 256, size=CHUNK_PIXELS, dtype=np.uint8)
+    counters = Counters()
+    cache = DecodedTileCache(_RawPayloadStore(noise), capacity=4,
+                             counters=counters)
+    entry = cache.load((1, 0, 0))
+    assert entry.payload == bytes([0x00]) + noise.tobytes()  # untouched
+    assert counters.get(obs_names.SERVE_RLE_SKIPPED) == 1
+    assert counters.get(obs_names.SERVE_RLE_RECOMPRESSIONS) == 0
+
+    runs = np.repeat(np.arange(16, dtype=np.uint8), CHUNK_PIXELS // 16)
+    off = DecodedTileCache(_RawPayloadStore(runs), capacity=4,
+                           recompress_min_ratio=0.0, counters=Counters())
+    assert off.load((1, 0, 0)).payload[0] == 0x00  # pass disabled
+
+
+def test_gateway_render_magic_never_validates_as_level():
+    assert not proto.query_in_range(proto.GATEWAY_RENDER_MAGIC, 0, 0)
+    assert not proto.query_in_range(proto.GATEWAY_BATCH_MAGIC, 0, 0)
+    with pytest.raises(framing.ProtocolError):
+        proto.validate_colormap(0x77)
+    for cid in proto.COLORMAPS:
+        assert proto.validate_colormap(cid) == cid
