@@ -129,8 +129,8 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
     from jax import lax
 
     from distributedmandelbrot_tpu.ops.pallas_escape import (
-        _pallas_escape, _pallas_escape_batch, fit_blocks, DEFAULT_BLOCK_H,
-        prefer_batch_grid)
+        _pallas_escape, _pallas_escape_mega, fit_blocks, DEFAULT_BLOCK_H,
+        SCOUT_MIN_ITER, SCOUT_SEGMENTS_DEFAULT)
 
     from distributedmandelbrot_tpu.parallel.sharding import widen_square_pitch
 
@@ -140,20 +140,26 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
     params = jnp.asarray(widen_square_pitch(params_np), jnp.float32)
     k = params.shape[0]
 
-    batch = k > 1 and prefer_batch_grid(max_iter, tile, tile, block_h,
-                                        block_w)
+    # K > 1 rides the megakernel — the default fused dispatch route
+    # (PallasBackend.dispatch_many), so the headline benches exactly
+    # what production launches.  Scout default mirrors
+    # compute_tiles_mega_pallas; pass scout_segments=0 for pure-f32
+    # controls (the roofline's iters_exact counts f32 work only).
+    scout_segments = kernel_kw.pop(
+        "scout_segments",
+        SCOUT_SEGMENTS_DEFAULT if max_iter >= SCOUT_MIN_ITER else 0)
     mrds = jnp.full((k, 1), max_iter, jnp.int32)
 
     def one_rep(params):
-        if batch:
-            # Deep budgets: one batch-grid launch (same dispatch policy
-            # as the production sharded path,
-            # sharding._batched_pallas_sharded).
-            out = _pallas_escape_batch(params, mrds, k=k, height=tile,
-                                       width=tile, max_iter=max_iter,
-                                       block_h=block_h, block_w=block_w,
-                                       **kernel_kw)
-            return jnp.sum(out.astype(jnp.int32), dtype=jnp.int32)
+        if k > 1:
+            out, scout = _pallas_escape_mega(
+                params, mrds, k=k, height=tile, width=tile,
+                max_iter=max_iter, block_h=block_h, block_w=block_w,
+                scout_segments=int(scout_segments), **kernel_kw)
+            # The scout census joins the checksum so the second output
+            # can't be dead-code-eliminated out of the timed graph.
+            return jnp.sum(out.astype(jnp.int32), dtype=jnp.int32) \
+                + jnp.sum(scout, dtype=jnp.int32)
 
         def one(p):
             out = _pallas_escape(p[None, :], height=tile, width=tile,
@@ -165,6 +171,38 @@ def _pallas_chain(params_np: np.ndarray, tile: int, max_iter: int,
         return jnp.sum(lax.map(one, params), dtype=jnp.int32)
 
     return _reps_chain(one_rep, params, reps)
+
+
+def _mega_scout_share(params_np: np.ndarray, tile: int, max_iter: int,
+                      **kernel_kw) -> float:
+    """Untimed probe for the attribution fields: the fraction of the
+    batch's pixels the bf16 scouting pass predicts escape inside its
+    window (0.0 when the batch is a singleton or the scout is disarmed
+    at this budget).  Advisory telemetry only — the scout never changes
+    counts (the parity-guard contract in ops/mixed_precision.py)."""
+    import jax.numpy as jnp
+
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        _pallas_escape_mega, fit_blocks, DEFAULT_BLOCK_H,
+        SCOUT_MIN_ITER, SCOUT_SEGMENTS_DEFAULT)
+
+    from distributedmandelbrot_tpu.parallel.sharding import widen_square_pitch
+
+    k = params_np.shape[0]
+    scout_segments = (SCOUT_SEGMENTS_DEFAULT
+                      if max_iter >= SCOUT_MIN_ITER else 0)
+    if k < 2 or scout_segments == 0:
+        return 0.0
+    block_h, block_w = fit_blocks(
+        tile, tile, block_h=kernel_kw.pop("block_h", DEFAULT_BLOCK_H),
+        block_w=kernel_kw.pop("block_w", None))
+    params = jnp.asarray(widen_square_pitch(params_np), jnp.float32)
+    mrds = jnp.full((k, 1), max_iter, jnp.int32)
+    _, scout = _pallas_escape_mega(
+        params, mrds, k=k, height=tile, width=tile, max_iter=max_iter,
+        block_h=block_h, block_w=block_w,
+        scout_segments=scout_segments, **kernel_kw)
+    return round(float(jnp.sum(scout)) / (k * tile * tile), 4)
 
 
 # Measured dense-kernel ceiling of this chip, chained-delta methodology:
@@ -352,13 +390,24 @@ def bench_throughput(tile: int, tiles: int, max_iter: int, dtype: str,
                                             reps=r), pixels, repeats)
                 results["pallas"] = df["benched_mpix_s"]
                 _copy_device_fields(extra_fields, df)
+                if k > 1:
+                    # Fused-launch attribution: the megakernel pays ONE
+                    # dispatch constant for the K-tile batch, so the
+                    # per-tile overhead is the headline's divided by K.
+                    extra_fields["fusion_width"] = k
+                    if "call_overhead_s" in extra_fields:
+                        extra_fields["call_overhead_per_tile_s"] = round(
+                            extra_fields["call_overhead_s"] / k, 6)
+                    extra_fields["bf16_share"] = _mega_scout_share(
+                        params, tile, max_iter)
                 params_u = _grid_params(*UNIFORM_VIEW, tile, k)
                 extra_fields.update(
                     {f: v for f, v in _device_fields(
                         lambda r: _pallas_chain(params_u, tile, max_iter,
                                                 reps=r,
                                                 interior_check=False,
-                                                cycle_check=False),
+                                                cycle_check=False,
+                                                scout_segments=0),
                         pixels, repeats,
                         iters_exact=pixels * (max_iter - 1)).items()
                      if f in ("giter_s", "vpu_util_frac")})
@@ -418,6 +467,35 @@ def bench_throughput(tile: int, tiles: int, max_iter: int, dtype: str,
 
 def _mpix(pixels: int, seconds: float) -> float:
     return pixels / seconds / 1e6
+
+
+def bench_kernel_batch(tile: int, max_iter: int, repeats: int,
+                       ks: list[int]) -> dict:
+    """``--kernel-batch``: sweep the megakernel's fusion width K at the
+    headline view/budget — one latency-decomposed row per K, so the
+    BENCH_* trajectory can attribute the fused-dispatch win (the
+    per-tile call overhead falls ~1/K while the device rate stays
+    flat).  K=1 is the unfused control (per-tile kernel, no scout)."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import pallas_available
+    interp = not pallas_available()  # off-TPU: correctness-only numbers
+    rows = []
+    for k in ks:
+        params = _bench_params(tile, k)
+        pixels = k * tile * tile
+        df = _device_fields(
+            lambda r, p=params: _pallas_chain(p, tile, max_iter, reps=r,
+                                              interpret=interp),
+            pixels, repeats)
+        row = {"k": k, "fusion_width": k, **df}
+        if "call_overhead_s" in df:
+            row["call_overhead_per_tile_s"] = round(
+                df["call_overhead_s"] / k, 6)
+        row["bf16_share"] = _mega_scout_share(params, tile, max_iter,
+                                              interpret=interp)
+        rows.append(row)
+    return {"metric": f"megakernel fusion-width sweep "
+                      f"({tile}^2, max_iter={max_iter}, seahorse valley)",
+            "unit": "Mpix/s per row", "rows": rows}
 
 
 def bench_config1(repeats: int) -> dict:
@@ -535,7 +613,8 @@ def bench_config3(repeats: int, segment: int) -> dict:
             df_raw = _device_fields(
                 lambda r: _pallas_chain(params, 1024, 5000, reps=r,
                                         interior_check=False,
-                                        cycle_check=False),
+                                        cycle_check=False,
+                                        scout_segments=0),
                 pixels, repeats, iters_exact=executed)
             _copy_device_fields(out, df_raw, prefix="raw_")
             if "giter_s" in df_raw:
@@ -818,7 +897,8 @@ def bench_worstcase(repeats: int, *, tile: int | None = None,
             kw = {"burning": True} if view["burning"] else {}
             per_path["raw"] = pixels / _time_chain(
                 _pallas_chain(params, tile, mi, interior_check=False,
-                              cycle_check=False, **kw), repeats) / 1e6
+                              cycle_check=False, scout_segments=0, **kw),
+                repeats) / 1e6
             per_path["full"] = pixels / _time_chain(
                 _pallas_chain(params, tile, mi, **kw), repeats) / 1e6
             # Production call class: benched + latency-decomposed.
@@ -860,7 +940,8 @@ def bench_worstcase(repeats: int, *, tile: int | None = None,
         out.update({k: v for k, v in _device_fields(
             lambda r: _pallas_chain(params_u, tile, mi_u, reps=r,
                                     interior_check=False,
-                                    cycle_check=False),
+                                    cycle_check=False,
+                                    scout_segments=0),
             pixels_u, repeats,
             iters_exact=pixels_u * (mi_u - 1)).items()
             if k in ("giter_s", "vpu_util_frac")})
@@ -1605,7 +1686,11 @@ def _ensure_live_backend(probe_timeout: float = 120.0) -> bool:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--tile", type=int, default=1024)
-    parser.add_argument("--tiles", type=int, default=64)
+    # 256 tiles = the fused megakernel's canonical batch: one dispatch
+    # constant amortized over 268 Mpix (the 64-tile batch of BENCH_r05
+    # and earlier could not bench past ~600 Mpix/s no matter how fast
+    # the kernel, because a ~70 ms call constant dominated 67 Mpix).
+    parser.add_argument("--tiles", type=int, default=256)
     parser.add_argument("--max-iter", type=int, default=1000)
     parser.add_argument("--dtype", choices=["f32", "f64"], default="f32")
     parser.add_argument("--repeats", type=int, default=3)
@@ -1645,6 +1730,11 @@ def main() -> int:
     parser.add_argument("--worst", action="store_true",
                         help="run only the worst-case boundary-view config "
                              "(raw vs shortcut per view)")
+    parser.add_argument("--kernel-batch", metavar="KS", default="",
+                        help="sweep the megakernel fusion width: "
+                             "comma-separated K values (e.g. "
+                             "'1,16,64,256'); one latency-decomposed "
+                             "row per K at --tile/--max-iter")
     parser.add_argument("--tileshape", action="store_true",
                         help="run only the 4096^2-vs-1024^2 production "
                              "tile-shape config (latency-decomposed)")
@@ -1701,6 +1791,12 @@ def main() -> int:
 
     if args.worst:
         emit(bench_worstcase(args.repeats))
+        return 0
+
+    if args.kernel_batch:
+        ks = [int(s) for s in args.kernel_batch.split(",") if s.strip()]
+        emit(bench_kernel_batch(args.tile, args.max_iter, args.repeats,
+                                ks))
         return 0
 
     if args.tileshape:

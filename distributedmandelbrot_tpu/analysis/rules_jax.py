@@ -20,6 +20,15 @@ downgrade to 32-bit unless x64 mode is on; modules must route through
 utils/precision (``ensure_x64``).  The warning fires only in modules
 that do NOT import ``ensure_x64`` — escape_time.py and families.py
 import it and their host wrappers call it before dispatching into jit.
+
+``jax-dtype-mix`` — half-precision dtype literals (``bfloat16`` /
+``float16`` / ``half``) in a traced function: a bf16 value that leaks
+into an output expression silently costs ~3 decimal digits, and escape
+counts are a bit-exact contract.  Mirrors the x64 gate: the warning is
+silenced in modules that import from ``ops/mixed_precision`` — the
+reviewed opt-in whose helpers (``scout_cast``/``scout_const``) mark
+half precision as advisory-only (see that module's parity-guard
+contract).
 """
 
 from __future__ import annotations
@@ -41,6 +50,9 @@ RULES = (
     Rule("jax-dtype", "jax", "warning",
          "64-bit dtype literal in a traced function bypassing "
          "utils/precision"),
+    Rule("jax-dtype-mix", "jax", "warning",
+         "half-precision dtype literal in a traced function bypassing "
+         "ops/mixed_precision"),
 )
 
 SCOPE_DIRS = ("ops", "parallel")
@@ -48,6 +60,8 @@ SCOPE_DIRS = ("ops", "parallel")
 JIT_NAMES = ("jit", "pjit")
 
 DTYPE_64 = frozenset({"float64", "int64", "uint64", "complex128"})
+
+DTYPE_HALF = frozenset({"bfloat16", "float16", "half"})
 
 NUMPY_HEADS = ("np", "numpy", "jnp")
 
@@ -82,8 +96,10 @@ def check(project: Project) -> list[Finding]:
     findings: list[Finding] = []
     for sf in project.in_dirs(*SCOPE_DIRS):
         has_precision = _imports_ensure_x64(sf)
+        has_mixed = _imports_mixed_precision(sf)
         for fn in _traced_functions(sf):
-            findings.extend(_check_traced(sf, fn, has_precision))
+            findings.extend(_check_traced(sf, fn, has_precision,
+                                          has_mixed))
     return findings
 
 
@@ -97,8 +113,22 @@ def _imports_ensure_x64(sf: SourceFile) -> bool:
                for d in dotted_names(sf.tree))
 
 
+def _imports_mixed_precision(sf: SourceFile) -> bool:
+    """The half-precision opt-in: any import from ops/mixed_precision
+    (or a dotted use of its helpers) marks the module as a reviewed
+    mixed-precision site.  mixed_precision.py itself hosts the only
+    sanctioned literal (at module scope, outside any trace)."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.endswith("mixed_precision"):
+            return True
+    return any(".mixed_precision." in d or d.startswith("mixed_precision.")
+               for d in dotted_names(sf.tree))
+
+
 def _check_traced(sf: SourceFile, fn: FunctionNode,
-                  has_precision: bool) -> list[Finding]:
+                  has_precision: bool,
+                  has_mixed: bool = False) -> list[Finding]:
     out: list[Finding] = []
 
     def flag(rule: str, severity: str, line: int, msg: str) -> None:
@@ -157,4 +187,21 @@ def _check_traced(sf: SourceFile, fn: FunctionNode,
                     flag("jax-dtype", "warning", node.lineno,
                          f"dtype literal {node.value.id}.{node.attr} "
                          f"without utils/precision.ensure_x64 in the module")
+    if not has_mixed:
+        for stmt in fn.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value in DTYPE_HALF:
+                    flag("jax-dtype-mix", "warning", node.lineno,
+                         f'dtype literal "{node.value}" without the '
+                         f"ops/mixed_precision opt-in in the module")
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr in DTYPE_HALF \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in NUMPY_HEADS:
+                    flag("jax-dtype-mix", "warning", node.lineno,
+                         f"dtype literal {node.value.id}.{node.attr} "
+                         f"without the ops/mixed_precision opt-in "
+                         f"in the module")
     return out

@@ -722,6 +722,11 @@ def cmd_worker(argv: Sequence[str]) -> int:
                              "persistent session to the coordinator "
                              "(default 0 = one per local device, capped "
                              "at 4)")
+    parser.add_argument("--batch-tiles", type=int, default=0,
+                        help="pipelined executor: queued leases fused "
+                             "into one megakernel launch per device "
+                             "(pallas backends only; capped at --depth; "
+                             "default 0 = fuse up to depth)")
     parser.add_argument("--no-session", action="store_true",
                         help="force the legacy connection-per-exchange "
                              "wire protocol even against a session-"
@@ -825,6 +830,7 @@ def cmd_worker(argv: Sequence[str]) -> int:
                     backend,
                     batch_size=batch_size, window=window, depth=args.depth,
                     upload_lanes=args.upload_lanes,
+                    batch_tiles=args.batch_tiles,
                     use_session=not args.no_session)
     profiling = False
     if args.profile:
@@ -848,6 +854,12 @@ def cmd_worker(argv: Sequence[str]) -> int:
                 print(f"pipeline stage occupancy: {occ} "
                       f"(window={worker.window}, depth={worker.depth})",
                       flush=True)
+                fus = ss.get("fusion", {})
+                if fus.get("launches"):
+                    print(f"dispatch fusion: {fus['tiles']} tiles in "
+                          f"{fus['launches']} launch(es), "
+                          f"{fus['tiles_per_launch']:.1f} tiles/launch",
+                          flush=True)
             if args.stats_json:
                 import json
                 payload = {"counters": stats, "rounds": rounds}

@@ -81,6 +81,16 @@ HIST_BACKEND_PHASE_SECONDS = "worker_backend_phase_seconds"
 PHASE_DISPATCH = "dispatch"
 PHASE_MATERIALIZE = "materialize"
 
+# Megakernel fusion route (PallasBackend.dispatch_many): how many fused
+# launches ran, how many tiles rode them (tiles/launch = the effective
+# fusion width, the dispatch-amortization factor of ROADMAP item 4), and
+# how many pixels the bf16 scouting pass predicted escape inside its
+# window (advisory census only — counts never cross the precision
+# boundary; see ops/mixed_precision.py).
+WORKER_KERNEL_FUSED_LAUNCHES = "worker_kernel_fused_launches"
+WORKER_KERNEL_FUSED_TILES = "worker_kernel_fused_tiles"
+WORKER_KERNEL_BF16_PRUNED = "worker_kernel_bf16_pruned_pixels"
+
 # -- distributed tracing (cross-process spans) ----------------------------
 
 # Worker-side span push over PURPOSE_SPANS (0x04): records pushed,

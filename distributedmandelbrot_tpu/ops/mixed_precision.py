@@ -1,0 +1,44 @@
+"""The sanctioned gateway for half-precision arithmetic in kernel code.
+
+Why a module for two helpers: the static checker (``dmtpu check``,
+rule ``jax-dtype-mix``) flags half-precision dtype literals inside
+traced functions under ``ops/``/``parallel/`` — a bf16 value that leaks
+into an output expression silently costs ~3 decimal digits, and escape
+COUNTS are a bit-exact contract (the golden tests diff uint8 planes).
+Importing from THIS module is the opt-in: it marks the file as a
+reviewed mixed-precision site, the same way ``ensure_x64`` marks the
+reviewed f64 sites for the ``jax-dtype`` rule.
+
+The parity-guard contract every caller must keep (and the one the
+megakernel's guard test pins): half precision may only ever feed
+*advisory* products — scouting classifications, occupancy censuses,
+scheduling hints — never the authoritative iteration state or anything
+derived into tile output.  The f32 recurrence always runs from z0 and
+alone decides escape counts, so scout-on vs scout-off is bit-identical
+by construction.  There is no sound shortcut here: a bf16 orbit
+diverges from the f32 orbit after a handful of steps on chaotic
+boundary pixels (the iteration map amplifies the ~2^-8 mantissa gap
+exponentially), so no conservative margin can hand a *count* across the
+precision boundary — only a prediction.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# The scouting dtype: bf16 keeps f32's exponent range (escape-radius
+# tests never spuriously overflow out of range, only out of precision)
+# and packs two lanes per f32 slot on the VPU.
+SCOUT_DTYPE = jnp.bfloat16
+
+
+def scout_cast(x):
+    """Demote an f32 operand into the scouting precision (advisory lanes
+    only — see the parity-guard contract in the module docstring)."""
+    return x.astype(SCOUT_DTYPE)
+
+
+def scout_const(value):
+    """A scalar constant in the scouting precision (e.g. the escape
+    radius squared) — the one place a half dtype literal is sanctioned."""
+    return jnp.asarray(value, SCOUT_DTYPE)

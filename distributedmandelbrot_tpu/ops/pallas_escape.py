@@ -44,6 +44,8 @@ from distributedmandelbrot_tpu.core.geometry import TileSpec
 from distributedmandelbrot_tpu.ops.escape_time import (
     CYCLE_STRIDE,  # noqa: F401 — re-export: the constant lived here in r5
     family_interior, family_step, probe_step, resolve_cycle_check)
+from distributedmandelbrot_tpu.ops.mixed_precision import (scout_cast,
+                                                           scout_const)
 
 def _pallas():
     """Import pallas lazily: on some builds the import itself fails unless
@@ -541,6 +543,323 @@ def _pallas_escape_batch(params, mrds, *, k: int, height: int, width: int,
            if cycle_check else []),
         interpret=interpret,
     )(params, mrds)
+
+
+# --- Megakernel (fused-launch default dispatch route) ------------------------
+#
+# The batch-grid kernel above already folds K tiles into one pallas call;
+# the megakernel extends it into the DEFAULT dispatch route for fused
+# worker batches (PallasBackend.dispatch_many) and the bench kernel leg:
+#
+#   * the ~64 ms per-call dispatch/sync constant (BENCH_r05: benched 610
+#     vs device 1461 Mpix/s on 64x1024^2) is paid once per K-tile batch
+#     instead of once per tile;
+#   * the per-block prologue — coordinate generation feeding the
+#     interior classification, live-count reduction, and the bf16 scout
+#     — is software-pipelined across grid steps with double-buffered
+#     (ping-pong) scratch slots: block g+1's prologue runs at the tail
+#     of step g, after step g's uint8 store has been issued, so it
+#     overlaps the output window's copy-out DMA (Mosaic already
+#     double-buffers the out windows across grid steps — this extends
+#     the overlap to our own prologue vector work).  Only the INTEGER
+#     prologue products ride the slots; the float state re-seeds inline
+#     so the escape loop's float graph stays structurally identical to
+#     the single-tile kernel (see the kernel docstring for why that is
+#     load-bearing for bit-identity);
+#   * the uint8 plane is written straight from the VMEM iteration state
+#     per block (no post-hoc int32 plane + XLA cast pass as on the
+#     packed-kernel route);
+#   * an optional bf16 scouting pass shadows the first segments of each
+#     block in half precision and reports how many pixels it predicts
+#     escape inside the scout window (the `worker_kernel_bf16_pruned`
+#     census).  The scout is ADVISORY BY DESIGN: final counts come only
+#     from the f32 loop run from z0, so scout-on vs scout-off output is
+#     bit-identical by construction — see ops/mixed_precision.py for why
+#     no sound count-carrying handoff across the precision boundary
+#     exists, and test_pallas.py's guard test for the pinned contract.
+#
+# Bit-identity across dispatch routes is preserved the same way the
+# batch kernel preserves it: the prologue is _load_block_coords +
+# _interior_init, the loop is _run_seg_loop, the epilogue is the same
+# count classification expression — the pipelining only *reorders*
+# independent per-block computations, never changes them.
+
+# bf16 scouting defaults: one unrolled segment of shadow iteration, armed
+# only for budgets deep enough to amortize it (a sky block that escapes
+# in its first f32 segment shouldn't pay half a segment of prediction).
+SCOUT_SEGMENTS_DEFAULT = 1
+SCOUT_MIN_ITER = 256
+
+
+def _scout_census(g_real, g_imag, c_real, c_imag, act0, *, steps: int,
+                  power: int, burning: bool):
+    """bf16 scouting shadow: iterate a half-precision COPY of the orbit
+    for ``steps`` straight-line steps and count how many initially-live
+    pixels it predicts escape inside the window.  Returns the int32
+    census scalar only — no shadow state ever reaches the f32 loop or
+    the output (the parity-guard contract of ops/mixed_precision.py).
+    Prediction quality is approximate by design (bf16 orbits diverge on
+    boundary pixels; overflow-to-inf/NaN lanes read as escapes), which
+    is fine for an occupancy census."""
+    bzr = scout_cast(g_real)
+    bzi = scout_cast(g_imag)
+    bcr = scout_cast(c_real)
+    bci = scout_cast(c_imag)
+    four = scout_const(4.0)
+    act = act0
+    zr2 = bzr * bzr
+    zi2 = bzi * bzi
+    for _ in range(steps):
+        if power == 2:
+            cross = (bzr + bzr) * bzi
+            bzi = (jnp.abs(cross) if burning else cross) + bci
+            bzr = zr2 - zi2 + bcr
+        else:
+            bzr, bzi = family_step(bzr, bzi, bcr, bci, power=power,
+                                   burning=burning)
+        zr2 = bzr * bzr
+        zi2 = bzi * bzi
+        act = jnp.where(zr2 + zi2 < four, act, 0)
+    return (jnp.sum(act0, dtype=jnp.int32)
+            - jnp.sum(act, dtype=jnp.int32))
+
+
+def _escape_mega_kernel(params_ref, mrd_ref, out_ref, scout_ref, zr_ref,
+                        zi_ref, act_ref, n_ref, live_ref, census_ref,
+                        *snap_refs, k: int, gh: int, gw: int, max_iter: int,
+                        unroll: int, block_h: int, block_w: int, clamp: bool,
+                        interior_check: bool, cycle_check: bool,
+                        scout_steps: int, julia: bool = False,
+                        power: int = 2, burning: bool = False):
+    """One (block_h, block_w) block of tile ``t = program_id(0)``, with
+    the INTEGER half of the prologue software-pipelined one grid step
+    ahead.
+
+    ``act``/``n`` scratch carry a leading ping-pong axis of 2; flat
+    block index ``g`` selects slot ``g % 2``.  Step ``g`` consumes the
+    slot its predecessor seeded — the interior classification, its live
+    count, and the bf16 scouting census, i.e. the expensive prologue
+    vector work — runs the shared escape loop and the uint8 epilogue,
+    then seeds slot ``(g+1) % 2`` for its successor AFTER its own
+    output store, so the successor's classification/scout overlaps the
+    out-window copy-out.  ``live_ref``/``census_ref`` are (2,) SMEM
+    slots carrying the scalar products the same way.
+
+    The FLOAT dataflow is deliberately NOT pipelined: coordinates are
+    regenerated inline (4 vector ops) and ``zr/zi`` (and the cycle
+    snapshots) live in plain un-slotted scratch, so the escape loop's
+    float graph is structurally identical to the single-tile kernel's.
+    Routing floats through dynamically-indexed slots measurably shifts
+    where the compiler contracts mul+add chains into FMAs, and 300
+    iterations amplify that last-ulp difference into a moved count
+    bucket on a chaotic pixel — the exact failure the bit-identity
+    contract forbids.  Integer products can't contract, so slotting
+    them is bit-safe, and they are the expensive part of the prologue
+    anyway (the mask is ~20 vector ops plus a reduction; the armed
+    scout is a full unrolled bf16 segment).
+    """
+    pl, _ = _pallas()
+    t = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    shape = (block_h, block_w)
+    per_tile = gh * gw
+    g = (t * gh + i) * gw + j
+    total = k * per_tile
+
+    if max_iter - 1 <= 0:
+        out_ref[...] = jnp.zeros((1,) + shape, jnp.uint8)
+
+        @pl.when((i == 0) & (j == 0))
+        def _():
+            scout_ref[0, 0] = jnp.int32(0)
+        return
+
+    def prologue(t2, i2, j2, s):
+        """Seed integer slot ``s`` for block (t2, i2, j2): the interior
+        classification of the single-tile prologue (shared helpers) plus
+        the bf16 scouting shadow, whose census rides the SMEM slot."""
+        g_real2, g_imag2, c_real2, c_imag2, mrd2 = _load_block_coords(
+            params_ref, mrd_ref, t2, i2, j2, shape, block_h, block_w, julia)
+        act0, n_sat, live0 = _interior_init(
+            c_real2, c_imag2, mrd2 - 1, shape, interior_check and not julia,
+            power=power, burning=burning)
+        act_ref[s] = act0
+        n_ref[s] = n_sat
+        live_ref[s] = live0
+        if scout_steps:
+            census_ref[s] = _scout_census(g_real2, g_imag2, c_real2,
+                                          c_imag2, act0, steps=scout_steps,
+                                          power=power, burning=burning)
+
+    p = g % 2
+
+    @pl.when(g == 0)
+    def _():
+        prologue(t, i, j, 0)  # warm-up: the first block seeds itself
+
+    # Float prologue, inline — byte-identical dataflow to the
+    # single-tile kernel's _escape_tile_body (see the docstring note).
+    g_real, g_imag, c_real, c_imag, mrd = _load_block_coords(
+        params_ref, mrd_ref, t, i, j, shape, block_h, block_w, julia)
+    dyn_steps = mrd - 1  # this tile's own budget (traced, <= cap)
+    zr_ref[:] = g_real  # z0: the pixel grid (Mandelbrot: equals c)
+    zi_ref[:] = g_imag
+    if cycle_check:
+        szr_ref, szi_ref = snap_refs
+        szr_ref[:] = g_real  # snapshot of z_0
+        szi_ref[:] = g_imag
+
+    _run_seg_loop(zr_ref, zi_ref, act_ref.at[p], n_ref.at[p], snap_refs,
+                  c_real, c_imag, live_ref[p], cond_cap=dyn_steps,
+                  sat_steps=dyn_steps, unroll=unroll,
+                  cycle_check=cycle_check, power=power, burning=burning)
+
+    n = n_ref[p]
+    counts = jnp.where(n >= dyn_steps, 0, n + 1)
+    vals = (counts * 256 + (mrd - 1)) // mrd
+    if clamp:
+        vals = jnp.minimum(vals, 255)
+    out_ref[0] = vals.astype(jnp.uint8)
+
+    # Per-tile scout census: the (t, 0) SMEM window stays resident across
+    # this tile's 64 consecutive blocks, so init-on-first + accumulate.
+    @pl.when((i == 0) & (j == 0))
+    def _():
+        scout_ref[0, 0] = jnp.int32(0)
+    if scout_steps:
+        scout_ref[0, 0] = scout_ref[0, 0] + census_ref[p]
+
+    @pl.when(g + 1 < total)
+    def _():
+        # Pipelined prologue: seed the successor's slot AFTER this
+        # block's store, overlapping the out-window copy-out.
+        nf = g + 1
+        t2 = nf // per_tile
+        r2 = nf % per_tile
+        prologue(t2, r2 // gw, r2 % gw, 1 - p)
+
+
+@partial(jax.jit, static_argnames=("k", "height", "width", "max_iter",
+                                   "unroll", "block_h", "block_w", "clamp",
+                                   "interpret", "interior_check",
+                                   "cycle_check", "scout_segments", "julia",
+                                   "power", "burning"))
+def _pallas_escape_mega(params, mrds, *, k: int, height: int, width: int,
+                        max_iter: int, unroll: int = DEFAULT_UNROLL,
+                        block_h: int = DEFAULT_BLOCK_H,
+                        block_w: int = DEFAULT_BLOCK_W, clamp: bool = False,
+                        interpret: bool = False, interior_check: bool = True,
+                        cycle_check: bool | None = None,
+                        scout_segments: int = 0, julia: bool = False,
+                        power: int = 2, burning: bool = False):
+    """``k`` tiles in ONE launch with pipelined prologues and the bf16
+    scouting census -> ``((k, height, width) uint8, (k, 1) int32)``.
+    Same params/mrds layout as :func:`_pallas_escape_batch`; outputs are
+    bit-identical to it (and so to k single-tile calls) for every
+    ``scout_segments`` — the scout is advisory only."""
+    pl, pltpu = _pallas()
+    cycle_check = resolve_cycle_check(cycle_check, max_iter)
+    gh = height // block_h
+    gw = width // block_w
+    unroll_eff = max(1, min(unroll, max(1, max_iter - 1)))
+    kernel = partial(_escape_mega_kernel, k=k, gh=gh, gw=gw,
+                     max_iter=max_iter, unroll=unroll_eff, block_h=block_h,
+                     block_w=block_w, clamp=clamp,
+                     interior_check=interior_check, cycle_check=cycle_check,
+                     scout_steps=int(scout_segments) * unroll_eff,
+                     julia=julia, power=power, burning=burning)
+    return pl.pallas_call(
+        kernel,
+        grid=(k, gh, gw),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[pl.BlockSpec((1, block_h, block_w),
+                                lambda t, i, j: (t, i, j)),
+                   pl.BlockSpec((1, 1), lambda t, i, j: (t, 0),
+                                memory_space=pltpu.SMEM)],
+        out_shape=[jax.ShapeDtypeStruct((k, height, width), jnp.uint8),
+                   jax.ShapeDtypeStruct((k, 1), jnp.int32)],
+        # zr/zi (and snapshots) stay un-slotted — the float dataflow must
+        # match the single-tile kernel exactly (see the kernel's note);
+        # the leading axis 2 on act/n/live/census is the ping-pong of
+        # the pipelined integer prologue.
+        scratch_shapes=[pltpu.VMEM((block_h, block_w), jnp.float32),
+                        pltpu.VMEM((block_h, block_w), jnp.float32),
+                        pltpu.VMEM((2, block_h, block_w), jnp.int32),
+                        pltpu.VMEM((2, block_h, block_w), jnp.int32),
+                        pltpu.SMEM((2,), jnp.int32),
+                        pltpu.SMEM((2,), jnp.int32)]
+        + ([pltpu.VMEM((block_h, block_w), jnp.float32)] * 2
+           if cycle_check else []),
+        interpret=interpret,
+    )(params, mrds)
+
+
+def compute_tiles_mega_pallas(specs, max_iters, *,
+                              unroll: int = DEFAULT_UNROLL,
+                              block_h: int = DEFAULT_BLOCK_H,
+                              block_w: int | None = None,
+                              clamp: bool = False,
+                              interpret: bool | None = None,
+                              interior_check: bool = True,
+                              cycle_check: bool | None = None,
+                              scout_segments: int | None = None,
+                              power: int = 2, burning: bool = False,
+                              julia_cs=None,
+                              device: jax.Device | None = None):
+    """Fuse ``k`` same-shaped tiles into ONE megakernel launch; returns
+    ``(tiles, scout)`` still on device — ``tiles`` is (k, height, width)
+    uint8 (slice per-tile handles off it), ``scout`` is (k, 1) int32
+    with the bf16 scouting census per tile (0 when the scout is off).
+
+    This is the default dispatch route for fused worker batches
+    (PallasBackend.dispatch_many) and the bench kernel leg: the per-call
+    dispatch constant is paid once per batch, not per tile.  All specs
+    must share (height, width); budgets are per-tile under one bucketed
+    cap, exactly like the batch-grid path.  ``scout_segments=None``
+    arms :data:`SCOUT_SEGMENTS_DEFAULT` when the deepest budget reaches
+    :data:`SCOUT_MIN_ITER`; pass 0 to disable.  ``device`` pins the
+    launch (and its output buffers) to a specific chip, as in
+    :func:`compute_tile_pallas_device`.  Raises
+    :class:`PallasUnsupported` on the usual shape/pitch/budget limits —
+    fall-back sites dispatch per-tile instead.
+    """
+    k = len(specs)
+    julia = julia_cs is not None
+    _check_dispatch_mode(power, burning, julia)
+    if k < 1:
+        raise ValueError("empty tile batch")
+    if len(max_iters) != k:
+        raise ValueError("specs and max_iters length mismatch")
+    if julia and (len(julia_cs) != k or any(c is None for c in julia_cs)):
+        raise ValueError("julia_cs must give a constant per tile")
+    h, w = specs[0].height, specs[0].width
+    for spec in specs:
+        if (spec.height, spec.width) != (h, w):
+            raise PallasUnsupported("fused tiles must share height/width")
+    cap_req = max(int(m) for m in max_iters)
+    _guard_budget(cap_req)
+    block_h, block_w = fit_blocks(h, w, block_h=block_h, block_w=block_w)
+    if interpret is None:
+        interpret = not pallas_available()
+    rows = [_params_row(spec, julia_cs[idx] if julia else None)
+            for idx, spec in enumerate(specs)]
+    params = jnp.asarray(rows, jnp.float32)
+    mrds = jnp.asarray([[int(m)] for m in max_iters], jnp.int32)
+    if device is not None:
+        params = jax.device_put(params, device)
+        mrds = jax.device_put(mrds, device)
+    if scout_segments is None:
+        scout_segments = (SCOUT_SEGMENTS_DEFAULT
+                          if cap_req >= SCOUT_MIN_ITER else 0)
+    return _pallas_escape_mega(
+        params, mrds, k=k, height=h, width=w, max_iter=bucket_cap(cap_req),
+        unroll=unroll, block_h=block_h, block_w=block_w, clamp=clamp,
+        interpret=interpret, interior_check=interior_check and not julia,
+        cycle_check=resolve_cycle_check(cycle_check, cap_req),
+        scout_segments=int(scout_segments), julia=julia, power=power,
+        burning=burning)
 
 
 # --- Packed multi-tile kernel ------------------------------------------------

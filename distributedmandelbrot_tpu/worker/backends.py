@@ -20,6 +20,7 @@ into one device dispatch; scalar backends just loop.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Optional, Protocol, Sequence
 
@@ -108,6 +109,26 @@ class JaxBackend:
                 for w in workloads]
 
 
+class MegaTileHandle:
+    """One tile's slice of a fused megakernel launch: the on-device uint8
+    plane plus this tile's bf16 scouting census (a 0-d device scalar).
+    Quacks like a plain dispatch handle for the pipeline's materialize
+    stage (``copy_to_host_async`` lookahead included); the census is
+    read only at materialize time, after the pixel wait has already
+    synchronized the launch, so it never adds a device round-trip."""
+
+    __slots__ = ("pixels", "scout")
+
+    def __init__(self, pixels, scout) -> None:
+        self.pixels = pixels
+        self.scout = scout
+
+    def copy_to_host_async(self) -> None:
+        start = getattr(self.pixels, "copy_to_host_async", None)
+        if start is not None:
+            start()
+
+
 class PallasBackend:
     """TPU throughput path: the Pallas block-early-exit kernel (f32 only;
     coordinates generated in-kernel, so nothing but three scalars crosses
@@ -132,8 +153,12 @@ class PallasBackend:
                  clamp: bool = False,
                  registry: Optional[Registry] = None) -> None:
         from distributedmandelbrot_tpu.ops.pallas_escape import (
-            compute_tile_pallas_device)
+            compute_tile_pallas_device, compute_tiles_mega_pallas)
         self._dispatch = compute_tile_pallas_device
+        self._dispatch_mega = compute_tiles_mega_pallas
+        # Escape hatch for the fused route (DMTPU_MEGA=0): dispatch_many
+        # then degrades to a per-tile loop without touching callers.
+        self._mega_enabled = os.environ.get("DMTPU_MEGA", "1") != "0"
         self.definition = definition
         self.clamp = clamp
         self.registry = registry if registry is not None else Registry()
@@ -186,6 +211,44 @@ class PallasBackend:
                             time.monotonic() - t0)
         return handle
 
+    def dispatch_many(self, workloads: Sequence[Workload],
+                      device=None) -> list:
+        """Fuse a same-shaped tile batch into ONE megakernel launch on
+        ``device``; returns per-tile handles (:class:`MegaTileHandle`
+        slices of the fused output) in workload order, so the
+        materialize/upload stages downstream are batch-oblivious.
+
+        This is the default dispatch route for fused batches — the
+        per-call dispatch constant is paid once per batch instead of
+        once per tile (ROADMAP item 4; BENCH_r05's 610-vs-1461 Mpix/s
+        gap).  Falls back to the per-tile :meth:`dispatch_tile` loop
+        (which has its own XLA fallback) when the batch is a singleton,
+        when any tile's shape/pitch/budget is Pallas-unsupported, or
+        under ``DMTPU_MEGA=0``.  One unsupported tile demotes the whole
+        batch: mixed routes would reorder completion against the
+        per-device window the executor leases, for a case (odd shapes
+        on the farm path) that is already the slow path.
+        """
+        from distributedmandelbrot_tpu.ops.pallas_escape import (
+            PallasUnsupported)
+        if len(workloads) == 1 or not self._mega_enabled:
+            return [self.dispatch_tile(w, device) for w in workloads]
+        t0 = time.monotonic()
+        try:
+            specs = [_spec_for(w, self.definition) for w in workloads]
+            tiles, scout = self._dispatch_mega(
+                specs, [w.max_iter for w in workloads], clamp=self.clamp,
+                device=device)
+        except PallasUnsupported:
+            return [self.dispatch_tile(w, device) for w in workloads]
+        self.registry.inc(obs_names.WORKER_KERNEL_FUSED_LAUNCHES)
+        self.registry.inc(obs_names.WORKER_KERNEL_FUSED_TILES,
+                          by=len(workloads))
+        self._observe_phase(obs_names.PHASE_DISPATCH,
+                            time.monotonic() - t0)
+        return [MegaTileHandle(tiles[i], scout[i, 0])
+                for i in range(len(workloads))]
+
     def materialize_tile(self, handle) -> np.ndarray:
         """Device->host transfer of one dispatched tile -> flat uint8.
 
@@ -195,7 +258,16 @@ class PallasBackend:
         output tiles per chip and reuses them across dispatches instead
         of growing with the batch."""
         t0 = time.monotonic()
-        out = np.asarray(handle).reshape(-1)
+        if isinstance(handle, MegaTileHandle):
+            out = np.asarray(handle.pixels).reshape(-1)
+            # The pixel wait above synchronized the launch, so the
+            # census scalar is a free host read.
+            pruned = int(np.asarray(handle.scout))
+            if pruned:
+                self.registry.inc(obs_names.WORKER_KERNEL_BF16_PRUNED,
+                                  by=pruned)
+        else:
+            out = np.asarray(handle).reshape(-1)
         self._observe_phase(obs_names.PHASE_MATERIALIZE,
                             time.monotonic() - t0)
         return out

@@ -110,6 +110,11 @@ class DeviceDispatcher:
     def __init__(self, backend) -> None:
         self._backend = backend
         self.label = type(backend).__name__
+        # Fused launches are opt-in per backend: expose the batched
+        # entry point only when the backend has one, so the pipeline's
+        # getattr gate keeps per-tile dispatch for everything else.
+        if hasattr(backend, "dispatch_many"):
+            self.dispatch_many = backend.dispatch_many
 
     def devices(self) -> list:
         return list(self._backend.devices()) or [None]
@@ -181,6 +186,14 @@ class PipelineExecutor:
     caps kernels in flight per device.  ``batch_size`` is the wire
     granularity for lease and submit exchanges.
 
+    ``batch_tiles`` caps how many already-queued leases the dispatch
+    stage coalesces into one fused launch when the dispatcher exposes
+    ``dispatch_many`` (the PallasBackend megakernel); 0 means "up to
+    ``depth``".  A fused launch holds one device-depth permit per tile
+    (materialize releases them one by one), so the effective fusion
+    width is ``min(batch_tiles or depth, depth)`` — raise ``depth`` to
+    fuse wider.
+
     ``clock`` is the time source for stage accounting (injectable so the
     virtual-clock tests measure overlap deterministically); it never
     drives real blocking waits.
@@ -189,7 +202,7 @@ class PipelineExecutor:
     def __init__(self, client: DistributerClient,
                  dispatcher: TileDispatcher, *,
                  window: int = 8, depth: int = 2, batch_size: int = 1,
-                 upload_lanes: int = 1,
+                 upload_lanes: int = 1, batch_tiles: int = 0,
                  counters: Optional[Counters] = None,
                  clock: Callable[[], float] = time.monotonic,
                  spans: Optional[SpanRecorder] = None,
@@ -203,12 +216,15 @@ class PipelineExecutor:
             raise ValueError("batch_size must be >= 1")
         if upload_lanes < 1:
             raise ValueError("upload_lanes must be >= 1")
+        if batch_tiles < 0:
+            raise ValueError("batch_tiles must be >= 0")
         self.client = client
         self.dispatcher = dispatcher
         self.window = window
         self.depth = depth
         self.batch_size = batch_size
         self.upload_lanes = upload_lanes
+        self.batch_tiles = batch_tiles
         # Zero-arg callable yielding an UNCONNECTED DistributerSession
         # (or duck-type); each upload lane and the lease thread open
         # their own.  None keeps every exchange on ``client``.
@@ -242,6 +258,13 @@ class PipelineExecutor:
         self._rounds = 0
         self._stats = {name: _StageStats(name)
                        for name in obs_names.PIPELINE_STAGES}
+        # Fused-launch account (dispatch thread is the single writer;
+        # stage_stats readers tolerate a torn int — advisory, like the
+        # stage gauges).  Registry counters for the same events live in
+        # the backend's dispatch_many, so these stay plain ints.
+        self._disp_launches = 0
+        self._disp_fused_launches = 0
+        self._disp_tiles = 0
         # Upload busy time is accounted per lane (one writer each);
         # the STAGE_UPLOAD entry above stays zero and readers sum these.
         self._lane_stats = [_StageStats(f"{obs_names.STAGE_UPLOAD}[{i}]")
@@ -415,41 +438,72 @@ class PipelineExecutor:
         st = self._stats[obs_names.STAGE_DISPATCH]
         devices = self._devices
         sems = self._dev_sems
+        fuse = getattr(self.dispatcher, "dispatch_many", None)
+        limit = min(self.batch_tiles or self.depth, self.depth) \
+            if fuse is not None else 1
         i = 0
-        while True:
+        saw_eos = False
+        while not saw_eos:
             item = self._dispatch_q.get()
             if item is _EOS:
                 return
             if self._stop.is_set():
                 self._abandon(1)
                 continue
+            # Coalesce whatever is ALREADY queued (up to the fusion
+            # limit) into one launch.  Never wait for more: an empty
+            # queue means the lease stage is the bottleneck, and a
+            # single-tile launch beats an idle device.
+            batch = [item]
+            while len(batch) < limit:
+                try:
+                    more = self._dispatch_q.get_nowait()
+                except queue.Empty:
+                    break
+                if more is _EOS:
+                    saw_eos = True
+                    break
+                batch.append(more)
             d = i % len(devices)
             i += 1
-            while not sems[d].acquire(timeout=_WAIT_SLICE_S):
-                if self._stop.is_set():
-                    break
+            held = 0
+            while held < len(batch) and not self._stop.is_set():
+                if sems[d].acquire(timeout=_WAIT_SLICE_S):
+                    held += 1
             if self._stop.is_set():
-                # May hold the permit here; the run is over either way,
+                # May hold permits here; the run is over either way,
                 # and permits die with the executor.
-                self._abandon(1)
+                self._abandon(len(batch))
                 continue
             s0 = self.spans.clock() if self.spans is not None else 0.0
             t0 = self.clock()
             try:
-                handle = self.dispatcher.dispatch(item, devices[d])
+                if len(batch) == 1:
+                    handles = [self.dispatcher.dispatch(batch[0],
+                                                        devices[d])]
+                else:
+                    handles = fuse(batch, devices[d])
             except BaseException:
-                sems[d].release()
-                self._abandon(1)
+                for _ in range(held):
+                    sems[d].release()
+                self._abandon(len(batch))
                 raise
             dt = self.clock() - t0
-            st.add(dt)
+            st.add(dt, len(batch))
+            self._disp_launches += 1
+            self._disp_tiles += len(batch)
+            if len(batch) > 1:
+                self._disp_fused_launches += 1
             if self.spans is not None:
-                self.spans.record(obs_names.SPAN_DISPATCH, item.key,
-                                  s0, self.spans.clock(), device=d)
+                s1 = self.spans.clock()
+                for w in batch:
+                    self.spans.record(obs_names.SPAN_DISPATCH, w.key,
+                                      s0, s1, device=d)
             self.registry.observe(
                 obs_names.HIST_PIPELINE_STAGE_SECONDS, dt,
                 labels={"stage": obs_names.STAGE_DISPATCH})
-            self._mat_q.put((item, d, handle, t0, s0))
+            for w, handle in zip(batch, handles):
+                self._mat_q.put((w, d, handle, t0, s0))
 
     @staticmethod
     def _start_host_copy(handle) -> None:
@@ -732,5 +786,15 @@ class PipelineExecutor:
                   "items": ls.items,
                   "occupancy": round(min(1.0, ls.busy_s / wall), 4)}
                  for ls in self._lane_stats]
+        launches = self._disp_launches
+        fusion = {
+            "launches": launches,
+            "fused_launches": self._disp_fused_launches,
+            "tiles": self._disp_tiles,
+            "tiles_per_launch": round(self._disp_tiles / launches, 4)
+            if launches else 0.0,
+            "fused_fraction": round(self._disp_fused_launches / launches,
+                                    4) if launches else 0.0,
+        }
         return {"wall_s": round(wall, 6), "stages": stages,
-                "lanes": lanes}
+                "lanes": lanes, "fusion": fusion}

@@ -45,7 +45,7 @@ class Worker:
                  batch_size: int = 1, overlap_io: bool = True,
                  counters: Optional[Counters] = None,
                  window: int = 0, depth: int = 2,
-                 upload_lanes: int = 0,
+                 upload_lanes: int = 0, batch_tiles: int = 0,
                  use_session: bool = True) -> None:
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
@@ -55,6 +55,8 @@ class Worker:
             raise ValueError("depth must be >= 1")
         if upload_lanes < 0:
             raise ValueError("upload_lanes must be >= 0 (0 = auto)")
+        if batch_tiles < 0:
+            raise ValueError("batch_tiles must be >= 0 (0 = depth)")
         self.client = client
         self.backend = backend
         self.batch_size = batch_size
@@ -66,6 +68,9 @@ class Worker:
         # only add idle sockets).  Only the pipelined path (window > 0)
         # uses lanes.
         self.upload_lanes = upload_lanes
+        # Fused-launch width for the pipelined dispatch stage (0 = fuse
+        # up to ``depth``); only backends exposing dispatch_many fuse.
+        self.batch_tiles = batch_tiles
         self.use_session = use_session
         self.counters = counters if counters is not None else Counters()
         self.registry = self.counters.registry
@@ -225,6 +230,7 @@ class Worker:
                                 window=self.window, depth=self.depth,
                                 batch_size=self.batch_size,
                                 upload_lanes=lanes,
+                                batch_tiles=self.batch_tiles,
                                 counters=self.counters, spans=self.spans,
                                 session_factory=self._session_factory())
         self.pipeline = pipe

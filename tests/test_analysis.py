@@ -585,6 +585,38 @@ def f(x):
     assert findings_for({f"{P}/ops/dt.py": src}, "jax-dtype") == []
 
 
+def test_jax_dtype_mix_fires_on_half_literals_without_optin():
+    src = JIT_HEADER + '''
+@jax.jit
+def f(x):
+    y = x.astype("bfloat16") + jnp.zeros((), jnp.float16)
+    return y.astype("half")
+'''
+    found = findings_for({f"{P}/ops/mix.py": src}, "jax-dtype-mix")
+    assert len(found) == 3
+    assert all(f.severity == "warning" for f in found)
+
+
+def test_jax_dtype_mix_clean_with_mixed_precision_import():
+    src = (JIT_HEADER
+           + 'from distributedmandelbrot_tpu.ops.mixed_precision import '
+             'scout_cast\n'
+           + '''
+@jax.jit
+def f(x):
+    return scout_cast(x) + x.astype("bfloat16")
+''')
+    assert findings_for({f"{P}/ops/mix.py": src}, "jax-dtype-mix") == []
+
+
+def test_jax_dtype_mix_clean_outside_traced_functions():
+    src = JIT_HEADER + '''
+def host(x):
+    return x.astype("bfloat16")
+'''
+    assert findings_for({f"{P}/ops/mix.py": src}, "jax-dtype-mix") == []
+
+
 # -- proto -----------------------------------------------------------------
 
 PROTO_MOD = f"{P}/net/protocol.py"

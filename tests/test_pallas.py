@@ -629,6 +629,236 @@ def test_packed_tiles_julia_and_guards():
         compute_tiles_packed_pallas([spec] * 5, [100] * 5, interpret=True)
 
 
+# --- Megakernel (fused-launch default dispatch route) ------------------------
+
+
+@pytest.mark.parametrize("cycle_check", [None, True])
+def test_mega_matches_single_tile_kernel(cycle_check):
+    """compute_tiles_mega_pallas must be bit-identical to k single-tile
+    dispatches across mixed windows (deep seahorse boundary, interior
+    bulb, fast-escaping sky) and mixed budgets under one bucketed cap —
+    the pipelined prologue and the in-kernel uint8 write-out reorder
+    independent work, never change it.  ``cycle_check=True`` forces the
+    Brent probe (snapshot scratch refs) at budgets that would not arm
+    it."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_pallas_device, compute_tiles_mega_pallas)
+
+    tile = 128
+    specs = [TileSpec(-0.7436, 0.1317, 2e-3, 2e-3, width=tile, height=tile),
+             TileSpec(-0.2, -0.05, 0.1, 0.1, width=tile, height=tile),
+             TileSpec(1.5, 1.5, 0.1, 0.1, width=tile, height=tile),
+             TileSpec(-0.8, 0.1, 0.2, 0.2, width=tile, height=tile)]
+    mis = [300, 150, 80, 260]
+    tiles, scout = compute_tiles_mega_pallas(specs, mis, block_h=32,
+                                             interpret=True,
+                                             cycle_check=cycle_check)
+    assert tiles.shape == (4, tile, tile)
+    assert scout.shape == (4, 1)
+    for s in range(4):
+        ref = compute_tile_pallas_device(specs[s], mis[s], block_h=32,
+                                         interpret=True,
+                                         cycle_check=cycle_check)
+        assert np.array_equal(np.asarray(tiles[s]), np.asarray(ref)), \
+            f"tile {s} diverged from the single-tile kernel"
+
+
+def test_mega_families_and_guards():
+    """Megakernel parity for julia/ship plus the dispatch guards (shape
+    mismatch raises PallasUnsupported; empty batch raises)."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        PallasUnsupported, compute_tile_pallas_device,
+        compute_tiles_mega_pallas)
+
+    tile = 128
+    spec = TileSpec(-1.5, -1.5, 3.0, 3.0, width=tile, height=tile)
+    cs = [-0.8 + 0.156j, 0.285 + 0.01j]
+    tiles, _ = compute_tiles_mega_pallas([spec, spec], [200, 300],
+                                         block_h=32, interpret=True,
+                                         julia_cs=cs)
+    for s, c in enumerate(cs):
+        ref = compute_tile_pallas_device(spec, [200, 300][s], block_h=32,
+                                         interpret=True, julia_c=c)
+        assert np.array_equal(np.asarray(tiles[s]), np.asarray(ref))
+
+    ship = TileSpec(-1.76, -0.04, 0.02, 0.02, width=tile, height=tile)
+    tiles, _ = compute_tiles_mega_pallas([ship, ship], [300, 200],
+                                         block_h=32, interpret=True,
+                                         burning=True,
+                                         interior_check=False)
+    for s, mi in enumerate([300, 200]):
+        ref = compute_tile_pallas_device(ship, mi, block_h=32,
+                                         interpret=True, burning=True,
+                                         interior_check=False)
+        assert np.array_equal(np.asarray(tiles[s]), np.asarray(ref))
+
+    other = TileSpec(-1.5, -1.5, 3.0, 3.0, width=tile, height=64)
+    with pytest.raises(PallasUnsupported, match="share"):
+        compute_tiles_mega_pallas([spec, other], [100, 100],
+                                  interpret=True)
+    with pytest.raises(ValueError, match="empty"):
+        compute_tiles_mega_pallas([], [], interpret=True)
+
+
+def test_mega_golden_parity_against_numpy_backend():
+    """Golden parity of the fused dispatch route end to end through the
+    worker backend: a fast-escaping sky tile is BIT-IDENTICAL to the
+    f64 NumpyBackend (every pixel escapes within a few iterations, so
+    f32/f64 agree exactly); the bulb-straddling tile is bit-identical
+    on the f64-proven interior mask (saturated counts); the deep
+    seahorse-valley tile allows only the usual f32-vs-f64 boundary
+    jitter off the provable pixels (same bound as the per-tile
+    backend parity test above)."""
+    from distributedmandelbrot_tpu.core.workload import Workload
+    from distributedmandelbrot_tpu.worker.backends import (MegaTileHandle,
+                                                           NumpyBackend,
+                                                           PallasBackend)
+
+    sky = Workload(4, 300, 3, 3)        # [1,2]x[1,2]: all-escaping
+    bulb = Workload(4, 300, 1, 1)       # [-1,0]^2: bulb + cardioid lobe
+    seahorse = Workload(4, 900, 1, 2)   # [-1,0]x[0,1]: seahorse valley
+    ws = [sky, bulb, seahorse]
+    backend = PallasBackend(definition=128)
+    handles = backend.dispatch_many(ws)
+    assert all(isinstance(h, MegaTileHandle) for h in handles)
+    got = [backend.materialize_tile(h) for h in handles]
+    golden = NumpyBackend(definition=128).compute_batch(ws)
+
+    assert np.array_equal(got[0], golden[0]), "sky tile diverged"
+
+    for i, w in ((1, bulb), (2, seahorse)):
+        spec = TileSpec.for_chunk(w.level, w.index_real, w.index_imag,
+                                  definition=128)
+        cr, ci = spec.grid_2d()
+        mask = np.asarray(escape_time.mandelbrot_interior(cr, ci)).ravel()
+        assert np.array_equal(got[i][mask], golden[i][mask]), \
+            f"tile {i}: proven-interior pixels diverged from the golden"
+        off = float((got[i][~mask] != golden[i][~mask]).mean())
+        assert off <= 0.02, f"tile {i}: {off:.2%} off-mask mismatch"
+
+
+def test_mega_bf16_scout_never_changes_counts():
+    """The mixed-precision guard: scout on vs scout off must be
+    bit-identical for every tile (the bf16 pass is advisory only — the
+    f32 loop always runs from z0 and alone decides counts), while the
+    census proves the scout actually executed (nonzero on tiles with
+    fast escapes, zero when disarmed)."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tiles_mega_pallas)
+
+    tile = 128
+    specs = [TileSpec(-0.7436, 0.1317, 2e-3, 2e-3, width=tile, height=tile),
+             TileSpec(-0.2, -0.05, 0.1, 0.1, width=tile, height=tile),
+             TileSpec(1.5, 1.5, 0.1, 0.1, width=tile, height=tile)]
+    mis = [500, 400, 300]
+    on, census_on = compute_tiles_mega_pallas(specs, mis, block_h=32,
+                                              interpret=True,
+                                              scout_segments=2)
+    off, census_off = compute_tiles_mega_pallas(specs, mis, block_h=32,
+                                                interpret=True,
+                                                scout_segments=0)
+    assert np.array_equal(np.asarray(on), np.asarray(off)), \
+        "bf16 scouting changed a final escape count"
+    census_on = np.asarray(census_on).ravel()
+    assert int(census_on[2]) == tile * tile, \
+        "scout missed the all-escaping sky tile"
+    assert int(census_on[0]) > 0, "scout saw no escapes on a boundary tile"
+    assert not np.asarray(census_off).any(), "disarmed scout reported work"
+
+
+def test_pallas_backend_dispatch_many_fuses_and_falls_back(monkeypatch):
+    """dispatch_many parity + the two demotion paths: a singleton batch
+    and DMTPU_MEGA=0 both take the per-tile route (no MegaTileHandle),
+    while the fused route slices per-tile handles off one launch and
+    counts it in the worker_kernel_* registry."""
+    from distributedmandelbrot_tpu.core.workload import Workload
+    from distributedmandelbrot_tpu.obs import names as obs_names
+    from distributedmandelbrot_tpu.worker.backends import (MegaTileHandle,
+                                                           PallasBackend)
+
+    ws = [Workload(4, 300, 3, 3), Workload(4, 300, 1, 1)]
+    backend = PallasBackend(definition=128)
+    handles = backend.dispatch_many(ws)
+    assert all(isinstance(h, MegaTileHandle) for h in handles)
+    assert backend.registry.counter_value(
+        obs_names.WORKER_KERNEL_FUSED_LAUNCHES) == 1
+    assert backend.registry.counter_value(
+        obs_names.WORKER_KERNEL_FUSED_TILES) == 2
+    fused = [np.asarray(backend.materialize_tile(h)) for h in handles]
+    per_tile = [np.asarray(backend.materialize_tile(
+        backend.dispatch_tile(w))) for w in ws]
+    for f, p in zip(fused, per_tile):
+        assert np.array_equal(f, p)
+    # The deep tile had fast escapes in the scout window -> the pruned-
+    # pixels census counter moved at materialize time.
+    assert (backend.registry.counter_value(
+        obs_names.WORKER_KERNEL_BF16_PRUNED) or 0) > 0
+
+    single = backend.dispatch_many(ws[:1])
+    assert len(single) == 1
+    assert not isinstance(single[0], MegaTileHandle)
+
+    monkeypatch.setenv("DMTPU_MEGA", "0")
+    gated = PallasBackend(definition=128)
+    assert not any(isinstance(h, MegaTileHandle)
+                   for h in gated.dispatch_many(ws))
+
+
+def test_pipeline_executor_fuses_dispatch_batches():
+    """End-to-end fusion through the pipelined executor: with
+    batch_tiles > 1 the dispatch stage coalesces queued leases into
+    megakernel launches (stage_stats reports the fusion rate), and
+    every submitted tile stays bit-identical to a direct single-tile
+    dispatch."""
+    from distributedmandelbrot_tpu.core.workload import Workload
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_pallas_device)
+    from distributedmandelbrot_tpu.worker import PallasBackend
+    from distributedmandelbrot_tpu.worker.pipeline import (PipelineExecutor,
+                                                           as_dispatcher)
+
+    class MiniClient:
+        def __init__(self, tiles):
+            self._tiles = list(tiles)
+            self.submitted = []
+
+        def request(self):
+            return self._tiles.pop(0) if self._tiles else None
+
+        def request_batch(self, n):
+            got = self._tiles[:n]
+            del self._tiles[:n]
+            return got
+
+        def submit(self, w, p):
+            self.submitted.append((w, p))
+            return True
+
+        def submit_batch(self, results):
+            self.submitted.extend(results)
+            return [True] * len(results)
+
+    tiles = [Workload(4, 300, i % 4, i // 4) for i in range(8)]
+    client = MiniClient(tiles)
+    backend = PallasBackend(definition=128)
+    pipe = PipelineExecutor(client, as_dispatcher(backend),
+                            window=8, depth=4, batch_size=4,
+                            batch_tiles=4)
+    pipe.run()
+    assert len(client.submitted) == 8
+    assert pipe.in_flight == 0
+    fusion = pipe.stage_stats()["fusion"]
+    assert fusion["tiles"] == 8
+    assert fusion["fused_launches"] >= 1
+    assert fusion["tiles_per_launch"] > 1.0
+    for w, pixels in client.submitted:
+        spec = TileSpec.for_chunk(w.level, w.index_real, w.index_imag,
+                                  definition=128)
+        want = np.asarray(compute_tile_pallas_device(
+            spec, w.max_iter, interpret=True)).reshape(-1)
+        assert np.array_equal(np.asarray(pixels), want)
+
+
 # --- Interior fast path + device-targeted dispatch (worker backends) ---------
 
 
