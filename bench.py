@@ -1064,7 +1064,8 @@ def _phase_sums(registry, family: str, label: str) -> dict:
 def bench_farm(repeats: int, *, levels: str = "3:1000",
                definition: int = 4096, batch_size: int = 3,
                backend_name: str = "auto", window: int = 8,
-               depth: int = 2, upload_lanes: int = 0) -> dict:
+               depth: int = 2, upload_lanes: int = 0,
+               grant_batch: int = 0) -> dict:
     """Production shape: coordinator + worker over loopback TCP, 4096^2
     chunks, batched dispatch, full pipeline (lease -> compute -> upload ->
     persist).  Real materialization everywhere — on this rig the device->
@@ -1104,7 +1105,7 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
         client = DistributerClient("127.0.0.1", co.distributer_port)
         worker = Worker(client, backend, batch_size=batch_size,
                         overlap_io=True, window=window, depth=depth,
-                        upload_lanes=upload_lanes)
+                        upload_lanes=upload_lanes, grant_batch=grant_batch)
         # warmup: compile the kernel outside the timed window
         from distributedmandelbrot_tpu.core.workload import Workload
         backend.compute_batch([Workload(settings[0].level,
@@ -1210,8 +1211,25 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
     out["farm_wire_raw_bytes"] = wc.get(obs_names.WIRE_RAW_BYTES, 0)
     out["farm_wire_compressed_bytes"] = \
         wc.get(obs_names.WIRE_COMPRESSED_BYTES, 0)
-    out["farm_rtts_per_tile"] = round(
-        wc.get(obs_names.WORKER_WIRE_RTTS, 0) / n_tiles, 2)
+    rtts = wc.get(obs_names.WORKER_WIRE_RTTS, 0)
+    out["farm_rtts_per_tile"] = round(rtts / n_tiles, 2)
+    # Batched-grant efficiency: tiles granted per DEDICATED lease round
+    # trip — the lease stage's exchanges, empty drain probes included;
+    # grants piggybacked on upload acks ride an RTT the upload already
+    # owed, so they amortize to zero here.  >= 4 vs the exactly-1 of
+    # the one-grant era is the REQN tentpole's acceptance bar.
+    lease_rtts = wc.get(obs_names.PIPELINE_LEASE_EXCHANGES, 0)
+    granted = cc.get("workloads_granted", 0)
+    out["farm_grants_per_rtt"] = \
+        round(granted / lease_rtts, 2) if lease_rtts else 0.0
+    out["farm_grant_batches"] = cc.get(obs_names.COORD_GRANT_BATCHES, 0)
+    # Group-commit shape: index flushes and average tiles per flush —
+    # the persist-amortization half of the tentpole.
+    commits = cc.get(obs_names.STORE_GROUP_COMMITS, 0)
+    flushed = cc.get(obs_names.STORE_FLUSH_TILES, 0)
+    out["persist_group_commits"] = commits
+    out["persist_flush_tiles_avg"] = \
+        round(flushed / commits, 2) if commits else 0.0
     out["farm_sessions"] = wc.get(obs_names.WORKER_SESSIONS_OPENED, 0)
     if farm_trace.get("tiles"):
         out["farm_trace_tiles"] = farm_trace["tiles"]
@@ -1225,19 +1243,12 @@ def bench_farm(repeats: int, *, levels: str = "3:1000",
     return out
 
 
-def bench_farm_multi(repeats: int, *, workers: int = 4,
-                     levels: str = "3:1000", definition: int = 4096,
-                     batch_size: int = 3, backend_name: str = "auto",
-                     window: int = 8, depth: int = 2,
-                     upload_lanes: int = 0) -> dict:
-    """The real farm shape: N worker *subprocesses* racing one
-    coordinator over loopback TCP, each with its own device context,
-    pipelined executor, and session lanes.  Aggregate Mpix/s is wall
-    clock from first spawn to the last chunk fsynced; per-worker wire
-    and lane metrics come back through ``dmtpu worker --stats-json``
-    (subprocess counters are invisible to this process otherwise), and
-    critical-path attribution joins the coordinator's trace with every
-    worker's pushed spans exactly as the single-worker config does."""
+def _farm_multi_point(workers: int, *, levels: str, definition: int,
+                      batch_size: int, backend_name: str, window: int,
+                      depth: int, upload_lanes: int,
+                      grant_batch: int = 0) -> dict:
+    """One scaling-curve point: ``workers`` subprocesses against a fresh
+    coordinator; returns the full per-point stats dict."""
     import os
     import subprocess
     import tempfile
@@ -1260,6 +1271,8 @@ def bench_farm_multi(repeats: int, *, workers: int = 4,
                "--backend", backend_name, "--batch-size", str(batch_size),
                "--window", str(window), "--depth", str(depth),
                "--upload-lanes", str(upload_lanes)]
+        if grant_batch:
+            cmd += ["--grant-batch", str(grant_batch)]
         t0 = time.perf_counter()
         procs = []
         for stats_path, log_path in zip(stats_paths, log_paths):
@@ -1313,6 +1326,19 @@ def bench_farm_multi(repeats: int, *, workers: int = 4,
            "coord_connections":
                cc.get(obs_names.COORD_CONNECTIONS_ACCEPTED, 0),
            "persist_s": round(cc.get("persist_us", 0) / 1e6, 2)}
+    # Same definition as the single-worker leg: tiles granted per
+    # dedicated lease exchange across the fleet (piggybacked grants ride
+    # upload acks at zero marginal RTT).
+    lease_rtts = wsum(obs_names.PIPELINE_LEASE_EXCHANGES)
+    granted = cc.get("workloads_granted", 0)
+    out["farm_grants_per_rtt"] = \
+        round(granted / lease_rtts, 2) if lease_rtts else 0.0
+    out["farm_grant_batches"] = cc.get(obs_names.COORD_GRANT_BATCHES, 0)
+    commits = cc.get(obs_names.STORE_GROUP_COMMITS, 0)
+    flushed = cc.get(obs_names.STORE_FLUSH_TILES, 0)
+    out["persist_group_commits"] = commits
+    out["persist_flush_tiles_avg"] = \
+        round(flushed / commits, 2) if commits else 0.0
     for i, w in enumerate(per_worker):
         for j, lane in enumerate(
                 w.get("stage_stats", {}).get("lanes", [])):
@@ -1325,6 +1351,57 @@ def bench_farm_multi(repeats: int, *, workers: int = 4,
             out[f"farm_trace_{phase}_s"] = farm_trace[f"{phase}_s"]
             out[f"farm_trace_{phase}_share"] = \
                 farm_trace[f"{phase}_share"]
+    return out
+
+
+def bench_farm_multi(repeats: int, *, workers: int = 4,
+                     levels: str = "3:1000", definition: int = 4096,
+                     batch_size: int = 3, backend_name: str = "auto",
+                     window: int = 8, depth: int = 2,
+                     upload_lanes: int = 0, grant_batch: int = 0) -> dict:
+    """The real farm shape: N worker *subprocesses* racing one
+    coordinator over loopback TCP, each with its own device context,
+    pipelined executor, and session lanes.  Aggregate Mpix/s is wall
+    clock from first spawn to the last chunk fsynced; per-worker wire
+    and lane metrics come back through ``dmtpu worker --stats-json``
+    (subprocess counters are invisible to this process otherwise), and
+    critical-path attribution joins the coordinator's trace with every
+    worker's pushed spans exactly as the single-worker config does.
+
+    Runs a 1 -> ``workers`` scaling curve (doubling worker counts, each
+    point a fresh coordinator + store) and reports the top point as the
+    headline, with the per-point aggregate Mpix/s / grants-per-RTT /
+    persist-flush shape in ``scaling_curve`` — the cross-process answer
+    to "does the farm leg actually scale out, and what saturates first".
+    Per-worker lanes stay auto-tuned (one per local device) and every
+    worker is identically configured, so a sub-linear step in the curve
+    localizes to the shared coordinator/store, not worker skew."""
+    counts = []
+    n = 1
+    while n < workers:
+        counts.append(n)
+        n *= 2
+    counts.append(workers)
+    kwargs = dict(levels=levels, definition=definition,
+                  batch_size=batch_size, backend_name=backend_name,
+                  window=window, depth=depth, upload_lanes=upload_lanes,
+                  grant_batch=grant_batch)
+    curve = []
+    for c in counts:
+        point = _farm_multi_point(c, **kwargs)
+        curve.append(point)
+    out = dict(curve[-1])
+    base = curve[0]["value"]
+    out["scaling_curve"] = [
+        {"workers": point["farm_workers"],
+         "mpix_s": point["value"],
+         "total_s": point["total_s"],
+         "speedup_vs_1": round(point["value"] / base, 2) if base else 0.0,
+         "grants_per_rtt": point["farm_grants_per_rtt"],
+         "rtts_per_tile": point["farm_rtts_per_tile"],
+         "persist_group_commits": point["persist_group_commits"],
+         "persist_flush_tiles_avg": point["persist_flush_tiles_avg"]}
+        for point in curve]
     return out
 
 
@@ -1724,6 +1801,10 @@ def main() -> int:
                         help="parallel upload lanes per worker for the "
                              "farm config (0 = one per local device, "
                              "capped at 4)")
+    parser.add_argument("--farm-grant-batch", type=int, default=0,
+                        help="batched lease grants per session round "
+                             "trip for the farm config (0 = auto-size "
+                             "to batch-tiles x devices)")
     parser.add_argument("--serve", action="store_true",
                         help="run only the serving-gateway config "
                              "(cold-miss, warm-hit, coalesced-storm)")
@@ -1778,11 +1859,13 @@ def main() -> int:
                                   backend_name=args.farm_backend,
                                   window=args.farm_window,
                                   depth=args.farm_depth,
-                                  upload_lanes=args.farm_lanes))
+                                  upload_lanes=args.farm_lanes,
+                                  grant_batch=args.farm_grant_batch))
         else:
             emit(bench_farm(args.repeats, backend_name=args.farm_backend,
                             window=args.farm_window, depth=args.farm_depth,
-                            upload_lanes=args.farm_lanes))
+                            upload_lanes=args.farm_lanes,
+                            grant_batch=args.farm_grant_batch))
         return 0
 
     if args.serve:

@@ -95,6 +95,24 @@ QUERY_EXCHANGES = (
 # truncate at the first op carrying the frame-header symbol.
 STREAM_FRAME_SYMBOLS = {"PURPOSE_SESSION": "SESSION_FRAME"}
 
+# Exchanges INSIDE a multiplexed stream, paired by hand like
+# QUERY_EXCHANGES: (label, client exchange method, server frame
+# handler, frame-header struct).  The stream's frame header is read by
+# the server's session loop but written by the client's exchange
+# method, so ops carrying the header symbol are filtered from BOTH
+# sides before comparison — the header itself stays covered by
+# ``proto-exact-read`` and the ``wire-*`` size checks.  This is what
+# extends full sequence parity to the batched lease frames
+# (FRAME_LEASE_REQN/GRANTN), which the hello-prefix truncation above
+# would otherwise leave unchecked.
+SESSION_EXCHANGES = (
+    ("lease_reqn",
+     f"{PACKAGE}/worker/client.py::DistributerSession._request_batchn",
+     f"{PACKAGE}/coordinator/distributer.py::"
+     f"Distributer._session_lease_reqn",
+     "SESSION_FRAME"),
+)
+
 # Frame-sequence wildcard: a payload whose length is data-dependent.
 WILD = "?"
 
@@ -544,6 +562,19 @@ def check(project: Project) -> list[Finding]:
             continue
         client_ops, _ = extractor.function_ops(client_qual)
         server_ops, _ = extractor.function_ops(server_qual)
+        server_info = graph.function(server_qual)
+        findings.extend(_frame_findings(
+            label, client_qual, client_ops, server_info.relpath,
+            server_info.node.lineno, server_ops, table, frames_rule))
+
+    for label, client_qual, server_qual, frame_symbol in SESSION_EXCHANGES:
+        if graph.function(client_qual) is None \
+                or graph.function(server_qual) is None:
+            continue
+        client_ops = [op for op in extractor.function_ops(client_qual)[0]
+                      if op.symbol != frame_symbol]
+        server_ops = [op for op in extractor.function_ops(server_qual)[0]
+                      if op.symbol != frame_symbol]
         server_info = graph.function(server_qual)
         findings.extend(_frame_findings(
             label, client_qual, client_ops, server_info.relpath,

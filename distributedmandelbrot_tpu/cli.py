@@ -727,6 +727,12 @@ def cmd_worker(argv: Sequence[str]) -> int:
                              "into one megakernel launch per device "
                              "(pallas backends only; capped at --depth; "
                              "default 0 = fuse up to depth)")
+    parser.add_argument("--grant-batch", type=int, default=0,
+                        help="batched lease grants per session round "
+                             "trip (FRAME_LEASE_REQN; default 0 = "
+                             "fill the whole --window from one round "
+                             "trip; tune down to share a thin frontier "
+                             "across many workers)")
     parser.add_argument("--no-session", action="store_true",
                         help="force the legacy connection-per-exchange "
                              "wire protocol even against a session-"
@@ -831,6 +837,7 @@ def cmd_worker(argv: Sequence[str]) -> int:
                     batch_size=batch_size, window=window, depth=args.depth,
                     upload_lanes=args.upload_lanes,
                     batch_tiles=args.batch_tiles,
+                    grant_batch=args.grant_batch,
                     use_session=not args.no_session)
     profiling = False
     if args.profile:

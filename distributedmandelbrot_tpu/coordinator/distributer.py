@@ -108,7 +108,15 @@ class Distributer:
         self.on_chunk_saved = on_chunk_saved
         self._server: Optional[asyncio.Server] = None
         self._sweep_task: Optional[asyncio.Task] = None
-        self._save_tasks: set[asyncio.Task] = set()
+        # Group-commit persistence: accepted tiles go through a bounded
+        # queue to one drainer task, which coalesces whatever is backed
+        # up into a single ``store.put_many`` flush per wake-up.  The
+        # bound is backpressure — a store slower than ingest stalls the
+        # uploading sessions instead of growing an unbounded backlog.
+        self._persist_queue: Optional[asyncio.Queue] = None
+        self._persist_task: Optional[asyncio.Task] = None
+        self.persist_queue_depth = 256
+        self.persist_flush_tiles = 64
         # Tiles accepted in the scheduler whose asynchronous save has not
         # landed yet.  The recovery manager excludes these from every
         # checkpoint: completed-in-memory without a durable index entry
@@ -137,7 +145,16 @@ class Distributer:
             self._handle_connection, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
         self._sweep_task = asyncio.create_task(self._sweep_loop())
+        self._start_persist_loop()
+        self.registry.gauge(
+            obs_names.GAUGE_PERSIST_QUEUE_DEPTH,
+            fn=lambda: self._persist_queue.qsize()
+            if self._persist_queue is not None else 0)
         logger.info("distributer listening on %s:%d", self.host, self.port)
+
+    def _start_persist_loop(self) -> None:
+        self._persist_queue = asyncio.Queue(maxsize=self.persist_queue_depth)
+        self._persist_task = asyncio.create_task(self._persist_loop())
 
     async def stop(self) -> None:
         if self._sweep_task is not None:
@@ -149,8 +166,16 @@ class Distributer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
-        if self._save_tasks:
-            await asyncio.gather(*self._save_tasks, return_exceptions=True)
+        if self._persist_task is not None:
+            # Flush: the sentinel trails every enqueued tile, so awaiting
+            # the drainer means every accepted result is durable (or
+            # reopened) before stop() returns.
+            await self._persist_queue.put(None)
+            try:
+                await self._persist_task
+            except asyncio.CancelledError:
+                pass
+            self._persist_task = None
 
     async def _sweep_loop(self) -> None:
         while True:
@@ -326,7 +351,8 @@ class Distributer:
         hello = await self._read(
             framing.read_exact(reader, proto.SESSION_HELLO_WIRE_SIZE))
         (offered,) = proto.SESSION_HELLO.unpack(hello)
-        negotiated = offered & proto.SESSION_FLAG_RLE
+        negotiated = offered & (proto.SESSION_FLAG_RLE
+                                | proto.SESSION_FLAG_GRANTN)
         framing.write_byte(writer, proto.SESSION_ACCEPT)
         writer.write(proto.SESSION_HELLO.pack(negotiated))
         await writer.drain()
@@ -346,6 +372,9 @@ class Distributer:
             self.counters.inc(obs_names.COORD_SESSION_FRAMES)
             if frame_type == proto.FRAME_LEASE_REQ:
                 await self._session_lease(reader, writer, seq, length)
+            elif frame_type == proto.FRAME_LEASE_REQN:
+                await self._session_lease_reqn(reader, writer, seq, length,
+                                               negotiated)
             elif frame_type == proto.FRAME_UPLOAD:
                 await self._session_upload(reader, writer, seq, length,
                                            negotiated, peer)
@@ -373,6 +402,54 @@ class Distributer:
             proto.FRAME_LEASE_GRANT, seq,
             4 + len(grants) * WORKLOAD_WIRE_SIZE))
         self._write_grant_list(writer, grants, _peer_id(writer))
+
+    async def _session_lease_reqn(self, reader: asyncio.StreamReader,
+                                  writer: asyncio.StreamWriter,
+                                  seq: int, length: int,
+                                  negotiated: int) -> None:
+        """Batched lease request: grant up to ``count`` tiles in one round
+        trip, replied as groups no wider than the worker's fusion width so
+        the pipeline's dispatch coalescer can hand each group straight to a
+        megakernel launch without re-slicing."""
+        if not negotiated & proto.SESSION_FLAG_GRANTN:
+            raise framing.ProtocolError(
+                "batched lease request on a session that did not "
+                "negotiate it")
+        if length != proto.LEASE_REQN_WIRE_SIZE:
+            raise framing.ProtocolError(
+                f"batched lease frame length {length}, expected "
+                f"{proto.LEASE_REQN_WIRE_SIZE}")
+        count, width = proto.LEASE_REQN.unpack(await self._read(
+            framing.read_exact(reader, proto.LEASE_REQN_WIRE_SIZE)))
+        count = proto.validate_count(count, MAX_BATCH, "batched lease count")
+        if count == 0:
+            raise framing.ProtocolError(
+                "batched lease count 0 (a worker with no room must not ask)")
+        width = proto.validate_count(width, count, "grant batch width")
+        if width == 0:
+            raise framing.ProtocolError("grant batch width 0")
+        with self.registry.timed(obs_names.HIST_GRANT_SECONDS):
+            grants = self.scheduler.acquire_batch(count)
+        if not grants:
+            # Empty drain probes are visible as requests_denied; counting
+            # them as batches would skew grants-per-batch toward zero.
+            self.counters.inc("requests_denied")
+        else:
+            # Counted BEFORE the reply hits the wire: a client thread can
+            # otherwise read the grants and assert on the counter while
+            # this coroutine is still a few statements from the inc.
+            self.counters.inc(obs_names.COORD_GRANT_BATCHES)
+            self.registry.observe(obs_names.HIST_COORD_GRANTS_PER_BATCH,
+                                  float(len(grants)))
+        batches = [grants[i:i + width] for i in range(0, len(grants), width)]
+        writer.write(proto.SESSION_FRAME.pack(
+            proto.FRAME_LEASE_GRANTN, seq,
+            proto.LEASE_GRANTN_WIRE_SIZE + 4 * len(batches)
+            + len(grants) * WORKLOAD_WIRE_SIZE))
+        writer.write(proto.LEASE_GRANTN.pack(len(batches), len(grants)))
+        peer = _peer_id(writer)
+        for batch in batches:
+            self._write_grant_list(writer, batch, peer)
 
     def _write_grant_list(self, writer: asyncio.StreamWriter, grants,
                           peer: Optional[str]) -> None:
@@ -480,10 +557,7 @@ class Distributer:
         self.trace.record("result_received", w.key, worker=peer)
         chunk = Chunk(w.level, w.index_real, w.index_imag, pixels)
         faults.hit("coord.between_accept_and_persist")
-        self._pending_saves.add(w.key)
-        task = asyncio.create_task(self._save_chunk(w, chunk))
-        self._save_tasks.add(task)
-        task.add_done_callback(self._save_tasks.discard)
+        await self._enqueue_persist(w, chunk)
         self._write_upload_ack(writer, seq, proto.RESPONSE_ACCEPT, want, peer)
 
     async def _session_spans(self, reader: asyncio.StreamReader,
@@ -553,42 +627,77 @@ class Distributer:
         chunk = Chunk(w.level, w.index_real, w.index_imag,
                       np.frombuffer(data, dtype=np.uint8))
         # Crashpoint: the tile is complete in the scheduler but its save
-        # task has not even been scheduled — the widest window where only
+        # has not reached the writer queue — the widest window where only
         # the pending-save exclusion keeps a checkpoint honest.
         faults.hit("coord.between_accept_and_persist")
-        self._pending_saves.add(w.key)
-        task = asyncio.create_task(self._save_chunk(w, chunk))
-        self._save_tasks.add(task)
-        task.add_done_callback(self._save_tasks.discard)
+        await self._enqueue_persist(w, chunk)
 
     def pending_save_keys(self) -> set[Key]:
         """Keys whose persistence is in flight (checkpoint exclusion)."""
         return set(self._pending_saves)
 
-    async def _save_chunk(self, w: Workload, chunk: Chunk) -> None:
+    async def _enqueue_persist(self, w: Workload, chunk: Chunk) -> None:
+        """Hand an accepted tile to the group-commit drainer.  Lazily
+        starts the loop so handler-level tests that never call start()
+        still persist; blocks (backpressuring the session) when the
+        writer queue is full."""
+        self._pending_saves.add(w.key)
+        if self._persist_task is None or self._persist_task.done():
+            self._start_persist_loop()
+        await self._persist_queue.put((w, chunk))
+
+    async def _persist_loop(self) -> None:
+        """Drain the writer queue: block for one tile, then greedily
+        scoop whatever else is backed up (bounded by the flush size) so
+        a busy farm amortises blob writes and index appends into one
+        ``put_many`` flush per wake-up."""
+        while True:
+            item = await self._persist_queue.get()
+            if item is None:
+                return
+            batch = [item]
+            while len(batch) < self.persist_flush_tiles:
+                try:
+                    nxt = self._persist_queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if nxt is None:
+                    # Sentinel drawn mid-drain: flush this batch, then
+                    # let the next wake-up see the sentinel and exit.
+                    self._persist_queue.put_nowait(None)
+                    break
+                batch.append(nxt)
+            await self._persist_batch(batch)
+
+    async def _persist_batch(self, batch) -> None:
         try:
             t0 = time.monotonic()
-            await asyncio.to_thread(self.store.save, chunk)
+            await asyncio.to_thread(self.store.put_many,
+                                    [chunk for _, chunk in batch])
             dt = time.monotonic() - t0
             self.counters.inc(obs_names.COORD_PERSIST_US, int(dt * 1e6))
             self.registry.observe(obs_names.HIST_PERSIST_SECONDS, dt)
-            self.counters.inc(obs_names.COORD_CHUNKS_SAVED)
-            self.trace.record("persisted", chunk.key)
-            logger.info("saved chunk %s", chunk.key)
-            if self.on_chunk_saved is not None:
-                try:
-                    self.on_chunk_saved(chunk.key)
-                except Exception:
-                    # A notification bug must not reopen a saved tile.
-                    logger.exception("on_chunk_saved callback failed")
+            self.counters.inc(obs_names.COORD_CHUNKS_SAVED, len(batch))
+            for _, chunk in batch:
+                self.trace.record("persisted", chunk.key)
+                if self.on_chunk_saved is not None:
+                    try:
+                        self.on_chunk_saved(chunk.key)
+                    except Exception:
+                        # A notification bug must not reopen a saved tile.
+                        logger.exception("on_chunk_saved callback failed")
+            logger.info("saved %d chunks in one flush", len(batch))
         except Exception:
-            # The result's bytes are lost; reopen the tile so it is granted
-            # again rather than leaving a silent hole in a "complete" run.
-            logger.exception("failed to save chunk %s; reopening tile",
-                             chunk.key)
-            self.counters.inc("save_errors")
-            self.scheduler.reopen(w)
+            # The batch's bytes are lost; reopen the tiles so they are
+            # granted again rather than leaving silent holes in a
+            # "complete" run.
+            logger.exception("failed to save batch of %d chunks; "
+                             "reopening tiles", len(batch))
+            self.counters.inc("save_errors", len(batch))
+            for w, _ in batch:
+                self.scheduler.reopen(w)
         finally:
             # Durable (or reopened) either way: checkpoints may include —
-            # or, on reopen, re-grant — this tile from now on.
-            self._pending_saves.discard(w.key)
+            # or, on reopen, re-grant — these tiles from now on.
+            for w, _ in batch:
+                self._pending_saves.discard(w.key)

@@ -38,7 +38,12 @@ Distributer protocol (default port 59010).  Connection purpose byte, then:
   tile), and fire-and-forget span reports.  Client frames carry a
   strictly incrementing (mod 2^16) seq; server reply frames echo the
   seq of the frame they answer, which is how a pipelined worker
-  correlates N in-flight uploads with their accept flags.  A legacy
+  correlates N in-flight uploads with their accept flags.  When both
+  sides offered ``SESSION_FLAG_GRANTN`` the session also carries the
+  batched lease exchange: ``FRAME_LEASE_REQN`` asks for up to N tiles
+  at a declared fusion width and ``FRAME_LEASE_GRANTN`` answers with
+  the grants pre-grouped into dispatch-sized batches, so one round
+  trip feeds a whole megakernel fusion window.  A legacy
   coordinator drops the connection on the unknown 0x05 byte; the
   client takes the EOF during the hello as "sessions unsupported" and
   falls back to connection-per-exchange.
@@ -100,6 +105,11 @@ SESSION_ACCEPT = 0x50
 # replies with the intersection of what both sides offered; a bit the
 # server did not echo must never appear on the wire afterwards.
 SESSION_FLAG_RLE = 0x1  # uploads may carry WIRE_CODEC_RLE bodies
+# Batched lease grants: the session may carry FRAME_LEASE_REQN /
+# FRAME_LEASE_GRANTN frames.  A legacy coordinator never echoes this
+# bit, so a batched-grant worker negotiates down to the one-list
+# FRAME_LEASE_REQ exchange with no wire change it can't parse.
+SESSION_FLAG_GRANTN = 0x2
 
 # Session frame types (SESSION_FRAME.type).  Deliberately NOT named
 # ``PURPOSE_*``: frames live inside an established session, purposes
@@ -110,6 +120,11 @@ FRAME_LEASE_GRANT = 0x02  # server->client: u32 n + n x 16-byte workloads
 FRAME_UPLOAD = 0x03  # client->server: workload echo + UPLOAD_HEADER + body
 FRAME_UPLOAD_ACK = 0x04  # server->client: accept byte + piggyback grants
 FRAME_SPANS = 0x05  # client->server: span report body; no ack
+# Batched lease exchange (SESSION_FLAG_GRANTN only).  The request names
+# both how many tiles it wants AND the worker's fusion width, so the
+# reply can pre-group grants into dispatch-sized batches.
+FRAME_LEASE_REQN = 0x06  # client->server: LEASE_REQN (count, batch_width)
+FRAME_LEASE_GRANTN = 0x07  # server->client: LEASE_GRANTN + grant batches
 
 # Upload result codecs (UPLOAD_HEADER.codec).  RLE reuses the storage
 # codec's body format (codecs/rle.py, code 0x01) so wire and disk agree.
@@ -202,6 +217,22 @@ SESSION_FRAME_WIRE_SIZE = 7
 # the ack), then the codec body.
 UPLOAD_HEADER = struct.Struct("<BI")
 UPLOAD_HEADER_WIRE_SIZE = 5
+# Batched lease request payload (FRAME_LEASE_REQN): (count u32 — how
+# many tiles, in [1, coordinator's MAX_BATCH]; zero is a protocol
+# violation, a worker with no room must simply not ask; batch_width u32
+# — the worker's fusion width, in [1, count]).  The whole payload IS
+# this struct: the frame length must equal LEASE_REQN_WIRE_SIZE.
+LEASE_REQN = struct.Struct("<II")
+LEASE_REQN_WIRE_SIZE = 8
+# Batched grant reply header (FRAME_LEASE_GRANTN): (n_batches u32,
+# n_tiles u32), followed by n_batches grant lists each shaped exactly
+# like a FRAME_LEASE_GRANT payload (u32 width + width x 16-byte
+# workloads).  Widths never exceed the request's batch_width and sum to
+# n_tiles; the frame length must equal LEASE_GRANTN_WIRE_SIZE +
+# 4 * n_batches + WORKLOAD_WIRE_SIZE * n_tiles.  n_batches == 0 (and so
+# n_tiles == 0) is the drained-coordinator reply.
+LEASE_GRANTN = struct.Struct("<II")
+LEASE_GRANTN_WIRE_SIZE = 8
 
 # Client frame seqs wrap at the u16 the header carries.
 MAX_SESSION_SEQ = 0xFFFF

@@ -136,13 +136,26 @@ WIRE_RAW_BYTES = "wire_raw_bytes"
 WIRE_COMPRESSED_BYTES = "wire_compressed_bytes"
 # Worker side: sessions opened, fallbacks onto the legacy
 # connection-per-exchange path (legacy coordinator or mid-run session
-# loss), blocking round trips paid (lease exchanges + pipelined-ack
-# waits — the bench divides by tiles for farm_rtts_per_tile), and the
-# per-lane busy-time histogram behind the bench's lane occupancy.
+# loss), re-dials after the coordinator's idle deadline dropped a quiet
+# lane (expected under slow backends — tiles can out-wait the read
+# timeout between batches), blocking round trips paid (lease exchanges
+# + pipelined-ack waits — the bench divides by tiles for
+# farm_rtts_per_tile), and the per-lane busy-time histogram behind the
+# bench's lane occupancy.
 WORKER_SESSIONS_OPENED = "worker_sessions_opened"
 WORKER_SESSION_FALLBACKS = "worker_session_fallbacks"
+WORKER_SESSION_REDIALS = "worker_session_redials"
 WORKER_WIRE_RTTS = "worker_wire_rtts"
 HIST_UPLOAD_LANE_BUSY_SECONDS = "worker_upload_lane_busy_seconds"
+
+# Batched lease grants (SESSION_FLAG_GRANTN): GRANTN exchanges served
+# and the tiles-per-exchange distribution (the grant-coalescing factor
+# the farm bench divides into round trips), plus the depth of the
+# accept-path's bounded persist queue (a standing backlog here means
+# group commits, not the event loop, are the bottleneck).
+COORD_GRANT_BATCHES = "coord_grant_batches"
+HIST_COORD_GRANTS_PER_BATCH = "coord_grants_per_batch"
+GAUGE_PERSIST_QUEUE_DEPTH = "coord_persist_queue_depth"
 
 # -- store ----------------------------------------------------------------
 
@@ -151,6 +164,11 @@ HIST_STORE_WRITE_SECONDS = "store_write_seconds"
 # Startup tail repair: a crash mid-append left a truncated final entry
 # and setup cut the index back to the last valid boundary.
 STORE_TORN_TAILS_REPAIRED = "store_torn_tails_repaired"
+# Group commits (put_many): batches flushed with one index append and
+# the tiles those flushes carried (tiles/commit = the flush size the
+# scale-out bench reports).
+STORE_GROUP_COMMITS = "store_group_commits"
+STORE_FLUSH_TILES = "store_flush_tiles"
 
 # -- coordinator: durability (checkpoint/restore) -------------------------
 

@@ -194,6 +194,48 @@ class ChunkStore:
                                    time.monotonic() - t0)
         return entry
 
+    def put_many(self, chunks: list[Chunk]) -> list[IndexEntry]:
+        """Group-commit a batch: blob writes first, then ONE index append.
+
+        Same ordering guarantee as :meth:`save` (blobs before index, so a
+        crash orphans blobs rather than dangling entries), but the index
+        entries for the whole batch are concatenated into a single
+        ``append_index`` call — one write + one optional fsync per batch,
+        and a single atomic commit point: a crash before the append loses
+        the whole batch's entries (tiles are re-granted), never a torn
+        subset interleaved with other writers.
+        """
+        if not chunks:
+            return []
+        t0 = time.monotonic()
+        entries: list[IndexEntry] = []
+        for chunk in chunks:
+            if chunk.is_never:
+                entries.append(IndexEntry(*chunk.key, EntryType.NEVER))
+            elif chunk.is_immediate:
+                entries.append(IndexEntry(*chunk.key, EntryType.IMMEDIATE))
+            else:
+                filename = self._generate_filename(chunk)
+                payload = chunk.serialize()
+                with self._file_lock(filename):
+                    faults.hit("store.before_chunk_write")
+                    self.backend.put_blob(filename, payload)
+                faults.hit("store.after_chunk_write")
+                entries.append(
+                    IndexEntry(*chunk.key, EntryType.REGULAR, filename))
+                self._cache_payload(chunk.key, payload)
+        with self._index_lock:
+            self.backend.append_index(
+                b"".join(e.to_bytes() for e in entries),
+                fsync=self._fsync_index)
+            faults.hit("store.after_index_append")
+        if self._registry is not None:
+            self._registry.observe(obs_names.HIST_STORE_WRITE_SECONDS,
+                                   time.monotonic() - t0)
+            self._registry.inc(obs_names.STORE_GROUP_COMMITS)
+            self._registry.inc(obs_names.STORE_FLUSH_TILES, len(entries))
+        return entries
+
     # -- read path --------------------------------------------------------
 
     def entries(self) -> list[IndexEntry]:

@@ -32,7 +32,10 @@ from distributedmandelbrot_tpu.core.geometry import (CHUNK_WIDTH,
 from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.metrics import Registry
-from distributedmandelbrot_tpu.ops import escape_time
+try:
+    from distributedmandelbrot_tpu.ops import escape_time
+except ImportError:  # no jax: NumpyBackend/NativeBackend still work
+    escape_time = None
 from distributedmandelbrot_tpu.ops import reference as ref_ops
 
 logger = logging.getLogger("dmtpu.worker.backends")
@@ -97,10 +100,14 @@ class JaxBackend:
 
     def __init__(self, definition: int = CHUNK_WIDTH,
                  dtype: np.dtype = np.float32,
-                 segment: int = escape_time.DEFAULT_SEGMENT) -> None:
+                 segment: int = 0) -> None:
+        if escape_time is None:
+            raise RuntimeError(
+                "JaxBackend requires jax; use the numpy or native backend")
         self.definition = definition
         self.dtype = dtype
-        self.segment = segment
+        # 0 = the kernel's own default unroll segment.
+        self.segment = segment or escape_time.DEFAULT_SEGMENT
 
     def compute_batch(self, workloads: Sequence[Workload]) -> list[np.ndarray]:
         return [escape_time.compute_tile(_spec_for(w, self.definition),
@@ -355,5 +362,11 @@ def auto_backend(definition: int = CHUNK_WIDTH,
         except Exception:
             logger.debug("native probe failed; falling through",
                          exc_info=True)
+    if escape_time is None:
+        # jax absent entirely (protocol-smoke CI lanes): the golden
+        # numpy path is slow but always importable.
+        logger.warning("jax unavailable; auto backend falling back to "
+                       "NumpyBackend")
+        return NumpyBackend(definition=definition)
     return JaxBackend(definition=definition,
                       dtype=np.float32 if want is None else dtype)

@@ -256,11 +256,16 @@ class DistributerSession:
 
     def __init__(self, host: str, port: int, *,
                  timeout: Optional[float] = 30.0,
-                 compress: bool = True, counters=None) -> None:
+                 compress: bool = True, grantn: bool = True,
+                 counters=None) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
         self.compress_wanted = compress
+        # Batched lease grants (FRAME_LEASE_REQN): capability-flagged so
+        # a legacy one-grant coordinator negotiates the bit away and
+        # request_batchn transparently degrades to request_batch.
+        self.grantn_wanted = grantn
         self.counters = counters
         self.flags = 0  # negotiated capability bits after connect()
         self._sock: Optional[socket.socket] = None
@@ -295,7 +300,8 @@ class DistributerSession:
         return True
 
     def _hello(self, sock: socket.socket) -> bool:
-        want = proto.SESSION_FLAG_RLE if self.compress_wanted else 0
+        want = (proto.SESSION_FLAG_RLE if self.compress_wanted else 0) \
+            | (proto.SESSION_FLAG_GRANTN if self.grantn_wanted else 0)
         framing.send_byte(sock, proto.PURPOSE_SESSION)
         framing.send_all(sock, proto.SESSION_HELLO.pack(want))
         try:
@@ -374,6 +380,55 @@ class DistributerSession:
     def request(self) -> Optional[Workload]:
         grants = self.request_batch(1)
         return grants[0] if grants else None
+
+    def request_batchn(self, max_count: int,
+                       batch_width: int = 0) -> list[Workload]:
+        """Pull up to ``max_count`` workloads, grouped by the coordinator
+        into batches no wider than ``batch_width`` (default: one group).
+
+        The grouping matches the dispatch coalescer's fusion width, so a
+        full grant batch feeds whole megakernel launches without
+        re-slicing.  On a session that did not negotiate
+        ``SESSION_FLAG_GRANTN`` this degrades to a flat
+        :meth:`request_batch` — same tiles, one group.
+        """
+        if not self.flags & proto.SESSION_FLAG_GRANTN:
+            return self.request_batch(max_count)
+        return self._request_batchn(max_count, batch_width)
+
+    def _request_batchn(self, max_count: int,
+                        batch_width: int) -> list[Workload]:
+        width = min(batch_width or max_count, max_count)
+        seq = self._next_seq()
+        framing.send_parts(self._sock, [
+            proto.SESSION_FRAME.pack(proto.FRAME_LEASE_REQN, seq,
+                                     proto.LEASE_REQN_WIRE_SIZE),
+            proto.LEASE_REQN.pack(max_count, width)])
+        length = self._recv_frame_header(proto.FRAME_LEASE_GRANTN, seq)
+        n_batches, n_tiles = proto.LEASE_GRANTN.unpack(framing.recv_exact(
+            self._sock, proto.LEASE_GRANTN_WIRE_SIZE))
+        n_batches = proto.validate_count(n_batches, max_count,
+                                         "grant batch count")
+        n_tiles = proto.validate_count(n_tiles, max_count,
+                                       "batched grant total")
+        if length != (proto.LEASE_GRANTN_WIRE_SIZE + 4 * n_batches
+                      + n_tiles * WORKLOAD_WIRE_SIZE):
+            raise framing.ProtocolError(
+                f"batched grant frame length {length} disagrees with "
+                f"{n_batches} groups / {n_tiles} tiles")
+        grants: list[Workload] = []
+        for _ in range(n_batches):
+            n = proto.validate_count(framing.recv_u32(self._sock), n_tiles,
+                                     "grant group width")
+            grants.extend(Workload.from_wire(
+                framing.recv_exact(self._sock, WORKLOAD_WIRE_SIZE))
+                for _ in range(n))
+        if len(grants) != n_tiles:
+            raise framing.ProtocolError(
+                f"batched grant groups sum to {len(grants)}, header "
+                f"declared {n_tiles}")
+        self._inc(obs_names.WORKER_WIRE_RTTS)
+        return grants
 
     def submit_pipelined(self, results: Sequence[tuple[Workload, np.ndarray]],
                          want_lease: int = 0

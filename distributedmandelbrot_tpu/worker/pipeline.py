@@ -23,12 +23,12 @@ window across four stages, one thread each, coupled by queues::
       │                         │ reference immediately, so the
       │                         │ allocator recycles at most ``depth``
       │                         │ output buffers per chip
-      │                                       └ ``upload_lanes`` threads
-      │                                         share the queue; each
-      │                                         owns one persistent
-      │                                         session (one TCP
-      │                                         connect per lane per
-      │                                         run) when the
+      │                                       └ ``upload_lanes`` threads,
+      │                                         one queue each, fed
+      │                                         round-robin; each owns
+      │                                         one persistent session
+      │                                         (one TCP connect per
+      │                                         lane per run) when the
       │                                         coordinator speaks
       │                                         PURPOSE_SESSION
 
@@ -66,6 +66,7 @@ from typing import Callable, Optional, Protocol, Sequence
 import numpy as np
 
 from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.net import protocol as proto
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.spans import SpanRecorder, flush_spans
 from distributedmandelbrot_tpu.utils.metrics import Counters
@@ -194,6 +195,14 @@ class PipelineExecutor:
     width is ``min(batch_tiles or depth, depth)`` — raise ``depth`` to
     fuse wider.
 
+    ``grant_batch`` sizes batched lease requests (FRAME_LEASE_REQN) when
+    the session negotiated ``SESSION_FLAG_GRANTN``: 0 auto-sizes to
+    ``window`` so one grant round trip fills the whole prefetch window
+    (the reply arrives grouped to the fusion width either way); always
+    capped by ``window``.  Tune it down to share a thin frontier across
+    many workers.  Against a legacy coordinator the capability bit never
+    negotiates and the knob is inert.
+
     ``clock`` is the time source for stage accounting (injectable so the
     virtual-clock tests measure overlap deterministically); it never
     drives real blocking waits.
@@ -203,6 +212,7 @@ class PipelineExecutor:
                  dispatcher: TileDispatcher, *,
                  window: int = 8, depth: int = 2, batch_size: int = 1,
                  upload_lanes: int = 1, batch_tiles: int = 0,
+                 grant_batch: int = 0,
                  counters: Optional[Counters] = None,
                  clock: Callable[[], float] = time.monotonic,
                  spans: Optional[SpanRecorder] = None,
@@ -218,6 +228,8 @@ class PipelineExecutor:
             raise ValueError("upload_lanes must be >= 1")
         if batch_tiles < 0:
             raise ValueError("batch_tiles must be >= 0")
+        if grant_batch < 0:
+            raise ValueError("grant_batch must be >= 0")
         self.client = client
         self.dispatcher = dispatcher
         self.window = window
@@ -244,7 +256,13 @@ class PipelineExecutor:
         # maxsize would add a second, redundant blocking point.
         self._dispatch_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
         self._mat_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
-        self._upload_q: queue.Queue = queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
+        # One queue per upload lane, fed round-robin by the materialize
+        # stage: with a shared queue a burst of tiles (a batched grant
+        # landing at once) was coalesced entirely by whichever lane woke
+        # first, starving the others.
+        self._upload_qs: list[queue.Queue] = [
+            queue.Queue()  # dmtpu: ignore[res-queue-unbounded]
+            for _ in range(upload_lanes)]
         # Piggybacked lease grants parked for the lease thread — the
         # dispatch queue keeps exactly one producer, so the lease
         # stage's end-of-stream sentinel still trails every workload.
@@ -277,6 +295,16 @@ class PipelineExecutor:
         self._devices = list(dispatcher.devices()) or [None]
         self._dev_sems = [threading.Semaphore(self.depth)
                           for _ in self._devices]
+        # Batched-grant sizing (FRAME_LEASE_REQN): how many leases one
+        # round trip asks for when the session negotiated GRANTN.  The
+        # default fills the whole prefetch window from a single grant —
+        # the window is already the anti-hoarding cap, so asking for
+        # less only costs round trips; the reply still arrives grouped
+        # to the fusion width, so every device's fusion launch fills
+        # regardless of the count.  Tune DOWN (``grant_batch`` /
+        # ``--grant-batch``) to share a thin frontier across workers.
+        self._fusion_width = min(self.batch_tiles or self.depth, self.depth)
+        self.grant_batch = min(self.window, grant_batch or self.window)
 
     # -- window + error accounting -----------------------------------------
 
@@ -328,9 +356,40 @@ class PipelineExecutor:
                     "using legacy exchanges", role)
         return None
 
+    @staticmethod
+    def _grantn(session) -> bool:
+        """True when this session negotiated batched lease grants."""
+        return (session is not None and session.connected
+                and bool(getattr(session, "flags", 0)
+                         & proto.SESSION_FLAG_GRANTN))
+
+    def _session_retry(self, session, role: str, op):
+        """One session exchange, re-dialing once on a dead socket.
+
+        The coordinator drops sessions idle past its read deadline by
+        design (a slow backend can out-wait it between batches), and the
+        documented contract is that the worker re-dials.  Safe to replay:
+        a re-requested lease that was granted into the void sweeps back,
+        and a replayed upload of an already-saved tile is rejected as
+        stale while the chunk stays saved — at-least-once either way."""
+        try:
+            return op()
+        except ConnectionError:
+            session.close()
+            if not session.connect():
+                raise  # coordinator went legacy mid-run: surface it
+            self.counters.inc(obs_names.WORKER_SESSION_REDIALS)
+            logger.info("%s: re-dialed session after disconnect", role)
+            return op()
+
     def _acquire(self, want: int, session=None) -> list[Workload]:
+        if self._grantn(session):
+            return self._session_retry(
+                session, "lease",
+                lambda: session.request_batchn(want, self._fusion_width))
         if session is not None and session.connected:
-            return session.request_batch(want)
+            return self._session_retry(
+                session, "lease", lambda: session.request_batch(want))
         if want == 1:
             w = self.client.request()
             return [w] if w is not None else []
@@ -399,7 +458,9 @@ class PipelineExecutor:
             # never exceed the batch they retire), so ``room`` can only
             # have grown meanwhile and the prefetch can never exceed
             # ``window`` leases outstanding.
-            want = min(self.batch_size, room)
+            cap = self.grant_batch if self._grantn(session) \
+                else self.batch_size
+            want = min(cap, room)
             s0 = self.spans.clock() if self.spans is not None else 0.0
             t0 = self.clock()
             got = self._acquire(want, session)
@@ -520,6 +581,7 @@ class PipelineExecutor:
         st = self._stats[obs_names.STAGE_MATERIALIZE]
         sems = self._dev_sems
         nxt = None
+        lane = 0  # round-robin cursor over the upload lanes
         while True:
             item = nxt if nxt is not None else self._mat_q.get()
             nxt = None
@@ -568,21 +630,26 @@ class PipelineExecutor:
                 labels={"stage": obs_names.STAGE_MATERIALIZE})
             self.registry.observe(obs_names.HIST_WORKER_COMPUTE_SECONDS,
                                   tile_s, labels=self._hist_labels)
-            self._upload_q.put((workload, pixels))
+            self._upload_qs[lane].put((workload, pixels))
+            lane = (lane + 1) % len(self._upload_qs)
 
-    def _admit_grants(self, grants: Sequence[Workload], s0: float) -> None:
+    def _admit_grants(self, grants: Sequence[Workload], s0: float,
+                      reserved: int = 0) -> None:
         """Count piggybacked grants into the window BEFORE the batch that
         earned them retires (the cap may transiently read high, never
-        low), then park them for the lease thread to forward."""
-        if not grants:
+        low), then park them for the lease thread to forward.
+        ``reserved`` slots were pre-charged when the want was sized past
+        the retiring batch; settle the difference here (fewer grants
+        than reserved releases the surplus)."""
+        if not grants and not reserved:
             return
-        if self.spans is not None:
+        if self.spans is not None and grants:
             # The ack round trip is a clock-sync sample exactly like a
             # lease exchange — no extra connect needed.
             self.spans.note_grant([w.key for w in grants], s0,
                                   self.spans.clock())
         with self._cond:
-            self._in_flight += len(grants)
+            self._in_flight += len(grants) - reserved
         for w in grants:
             self._grant_q.put(w)
         with self._cond:
@@ -596,9 +663,29 @@ class PipelineExecutor:
         if session is not None and session.connected:
             # Pipelined: all uploads on the wire before the first ack is
             # read, lease request piggybacked on the last one's ack.
-            accepted, grants = session.submit_pipelined(
-                results, want_lease=len(results))
-            self._admit_grants(grants, s0)
+            want = len(results)
+            reserve = 0
+            if self._grantn(session):
+                # Piggyback the NEXT batch: ask past the retiring tiles
+                # up to the grant batch, pre-charging the extra against
+                # the window so the cap never undercounts while the ack
+                # is in flight.
+                with self._cond:
+                    budget = self.window - self._in_flight + len(results)
+                    want = min(self.grant_batch,
+                               max(len(results), budget))
+                    reserve = max(0, want - len(results))
+                    self._in_flight += reserve
+            try:
+                accepted, grants = self._session_retry(
+                    session, f"upload[{lane}]",
+                    lambda: session.submit_pipelined(
+                        results, want_lease=want))
+            except BaseException:
+                if reserve:
+                    self._retire(reserve)
+                raise
+            self._admit_grants(grants, s0, reserved=reserve)
         elif len(results) == 1:
             accepted = [self.client.submit(*results[0])]
         else:
@@ -633,15 +720,16 @@ class PipelineExecutor:
                         len(accepted) - n_ok, len(accepted))
 
     def _upload_lane(self, lane: int) -> None:
-        """One of ``upload_lanes`` workers sharing the upload queue.  The
-        single end-of-stream sentinel is re-queued for sibling lanes, so
-        one _EOS from the materialize stage drains them all."""
+        """One of ``upload_lanes`` workers, each draining its own queue
+        (fed round-robin by the materialize stage).  The end-of-stream
+        sentinel is fanned out to every lane queue, so each lane's own
+        _EOS terminates it."""
+        q = self._upload_qs[lane]
         session = self._open_session(f"upload[{lane}]")
         try:
             while True:
-                item = self._upload_q.get()
+                item = q.get()
                 if item is _EOS:
-                    self._upload_q.put(_EOS)
                     return
                 if self._stop.is_set():
                     self._abandon(1)
@@ -650,7 +738,7 @@ class PipelineExecutor:
                 saw_eos = False
                 while len(batch) < self.batch_size:
                     try:
-                        more = self._upload_q.get_nowait()
+                        more = q.get_nowait()
                     except queue.Empty:
                         break
                     if more is _EOS:
@@ -664,7 +752,6 @@ class PipelineExecutor:
                     raise
                 self._retire(len(batch))
                 if saw_eos:
-                    self._upload_q.put(_EOS)
                     return
         finally:
             if session is not None:
@@ -672,13 +759,19 @@ class PipelineExecutor:
 
     # -- orchestration -----------------------------------------------------
 
-    def _run_stage(self, fn, downstream: Optional[queue.Queue]) -> None:
+    def _run_stage(self, fn, downstream) -> None:
+        """``downstream`` is the next stage's queue, a list of queues
+        (the materialize stage fans its sentinel out to every upload
+        lane), or None for a terminal stage."""
         try:
             fn()
         except BaseException as e:  # re-raised from run()
             self._fail(e)
         finally:
-            if downstream is not None:
+            if isinstance(downstream, list):
+                for q in downstream:
+                    q.put(_EOS)
+            elif downstream is not None:
                 downstream.put(_EOS)
             else:
                 # Terminal stage gone: nothing will retire tiles anymore;
@@ -735,7 +828,7 @@ class PipelineExecutor:
                 name="dmtpu-pipe-dispatch", daemon=True),
             threading.Thread(
                 target=self._run_stage, args=(self._materialize_loop,
-                                              self._upload_q),
+                                              self._upload_qs),
                 name="dmtpu-pipe-materialize", daemon=True),
         ] + [
             threading.Thread(
@@ -752,8 +845,8 @@ class PipelineExecutor:
         # Residual accounting: anything still sitting in a queue after a
         # crash is a leased tile the pipeline abandoned (a stranded
         # piggyback grant in _grant_q holds a window slot too).
-        for q in (self._dispatch_q, self._mat_q, self._upload_q,
-                  self._grant_q):
+        for q in (self._dispatch_q, self._mat_q, self._grant_q,
+                  *self._upload_qs):
             while True:
                 try:
                     leftover = q.get_nowait()

@@ -46,6 +46,7 @@ class Worker:
                  counters: Optional[Counters] = None,
                  window: int = 0, depth: int = 2,
                  upload_lanes: int = 0, batch_tiles: int = 0,
+                 grant_batch: int = 0,
                  use_session: bool = True) -> None:
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
@@ -57,6 +58,8 @@ class Worker:
             raise ValueError("upload_lanes must be >= 0 (0 = auto)")
         if batch_tiles < 0:
             raise ValueError("batch_tiles must be >= 0 (0 = depth)")
+        if grant_batch < 0:
+            raise ValueError("grant_batch must be >= 0 (0 = auto)")
         self.client = client
         self.backend = backend
         self.batch_size = batch_size
@@ -71,6 +74,9 @@ class Worker:
         # Fused-launch width for the pipelined dispatch stage (0 = fuse
         # up to ``depth``); only backends exposing dispatch_many fuse.
         self.batch_tiles = batch_tiles
+        # Batched lease grants per session round trip (0 = auto-size to
+        # the fusion width × device count); pipelined path only.
+        self.grant_batch = grant_batch
         self.use_session = use_session
         self.counters = counters if counters is not None else Counters()
         self.registry = self.counters.registry
@@ -231,6 +237,7 @@ class Worker:
                                 batch_size=self.batch_size,
                                 upload_lanes=lanes,
                                 batch_tiles=self.batch_tiles,
+                                grant_batch=self.grant_batch,
                                 counters=self.counters, spans=self.spans,
                                 session_factory=self._session_factory())
         self.pipeline = pipe

@@ -915,6 +915,98 @@ def test_proto_render_exchange_skipped_when_one_side_absent():
     assert findings_for(one_sided, "proto-frames") == []
 
 
+# The batched lease exchange (SESSION_EXCHANGES entry "lease_reqn"):
+# an exchange INSIDE the multiplexed session stream, so ops carrying
+# the frame-header symbol are filtered from both sides and the payload
+# sequences (REQN out, GRANTN + grant groups back) must mirror.
+GRANTN_PROTO_SRC = '''
+import struct
+
+SESSION_FRAME = struct.Struct("<BHI")
+SESSION_FRAME_WIRE_SIZE = SESSION_FRAME.size
+LEASE_REQN = struct.Struct("<II")
+LEASE_REQN_WIRE_SIZE = LEASE_REQN.size
+LEASE_GRANTN = struct.Struct("<II")
+LEASE_GRANTN_WIRE_SIZE = LEASE_GRANTN.size
+GRANT_WANT = struct.Struct("<I")
+GRANT_WANT_WIRE_SIZE = GRANT_WANT.size
+'''
+
+GRANTN_CLIENT_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (recv_exact, recv_u32,
+                                                   send_all)
+
+class DistributerSession:
+    def _request_batchn(self, sock, max_count, width):
+        send_all(sock, proto.SESSION_FRAME.pack(0x06, 0,
+                                                proto.LEASE_REQN_WIRE_SIZE))
+        send_all(sock, proto.LEASE_REQN.pack(max_count, width))
+        hdr = recv_exact(sock, proto.SESSION_FRAME_WIRE_SIZE)
+        raw = recv_exact(sock, proto.LEASE_GRANTN_WIRE_SIZE)
+        n_batches, n_tiles = proto.LEASE_GRANTN.unpack(raw)
+        for _ in range(n_batches):
+            n = recv_u32(sock)
+        return n_tiles
+'''
+
+GRANTN_SERVER_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import read_exact, write_u32
+
+class Distributer:
+    async def _session_lease_reqn(self, reader, writer, seq):
+        raw = await read_exact(reader, proto.LEASE_REQN_WIRE_SIZE)
+        count, width = proto.LEASE_REQN.unpack(raw)
+        writer.write(proto.SESSION_FRAME.pack(0x07, seq,
+                                              proto.LEASE_GRANTN_WIRE_SIZE))
+        writer.write(proto.LEASE_GRANTN.pack(1, count))
+        write_u32(writer, count)
+'''
+
+GRANTN_SOURCES = {PROTO_MOD: GRANTN_PROTO_SRC,
+                  PROTO_CLIENT: GRANTN_CLIENT_SRC,
+                  PROTO_SERVER: GRANTN_SERVER_SRC}
+
+
+def test_proto_grantn_exchange_clean_when_sequences_match():
+    for rule in ("proto-frames", "proto-exact-read"):
+        assert findings_for(GRANTN_SOURCES, rule) == []
+
+
+def test_proto_grantn_exchange_fires_when_server_reverts_to_flat_grants():
+    # Version-skew drift: a coordinator answering a REQN with the legacy
+    # flat grant list (no GRANTN group header) must be caught.
+    skewed = dict(GRANTN_SOURCES)
+    skewed[PROTO_SERVER] = GRANTN_SERVER_SRC.replace(
+        "        writer.write(proto.LEASE_GRANTN.pack(1, count))\n", "")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "lease_reqn" in found[0].message
+    assert "client awaits [LEASE_GRANTN, U32]" in found[0].message
+    assert "server writes [U32]" in found[0].message
+
+
+def test_proto_grantn_exchange_fires_when_client_sends_wrong_struct():
+    # A client still speaking the legacy flat lease want (a bare u32
+    # struct, 4 bytes vs REQN's 8) at the batched endpoint.
+    skewed = dict(GRANTN_SOURCES)
+    skewed[PROTO_CLIENT] = GRANTN_CLIENT_SRC.replace(
+        "proto.LEASE_REQN.pack(max_count, width)",
+        "proto.GRANT_WANT.pack(max_count)")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "lease_reqn" in found[0].message
+    assert "client sends [GRANT_WANT]" in found[0].message
+    assert "server reads [LEASE_REQN]" in found[0].message
+
+
+def test_proto_grantn_exchange_skipped_when_one_side_absent():
+    one_sided = {PROTO_MOD: GRANTN_PROTO_SRC,
+                 PROTO_CLIENT: GRANTN_CLIENT_SRC}
+    assert findings_for(one_sided, "proto-frames") == []
+
+
 # -- res -------------------------------------------------------------------
 
 def test_res_thread_join_fires_on_unjoined_handleless_thread():

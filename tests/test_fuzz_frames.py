@@ -15,11 +15,19 @@ from __future__ import annotations
 
 import socket
 import struct
+import threading
 import time
 
+import numpy as np
+import pytest
+
 from distributedmandelbrot_tpu.core import LevelSetting
+from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.net import protocol as proto
 from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.utils.metrics import Counters
+from distributedmandelbrot_tpu.worker.client import DistributerSession
 
 from harness import CoordinatorHarness
 
@@ -279,6 +287,157 @@ def test_session_rle_bomb_releases_claim_and_stays_alive(tmp_path):
                 status = sock.recv(1)
                 regranted = status[0] == proto.WORKLOAD_AVAILABLE
         assert regranted, "bombed tile never returned to the frontier"
+
+
+def test_session_rejects_malformed_batched_lease_frames(tmp_path):
+    """The GRANTN fuzz corpus: every malformed REQN drops the session,
+    bumps COORD_FRAMES_REJECTED, and leaves the loop serving."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        rejected = 0
+        want = proto.SESSION_FLAG_RLE | proto.SESSION_FLAG_GRANTN
+
+        # Zero-count REQN: a worker with no room must not ask.
+        with _dial(farm.distributer_port) as sock:
+            flags = _session_hello(sock, want)
+            assert flags & proto.SESSION_FLAG_GRANTN
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_LEASE_REQN, 0, proto.LEASE_REQN_WIRE_SIZE)
+                + proto.LEASE_REQN.pack(0, 1))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Oversized count: a u32 far past MAX_BATCH, rejected before any
+        # scheduler work or allocation sized by it.
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock, want)
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_LEASE_REQN, 0, proto.LEASE_REQN_WIRE_SIZE)
+                + proto.LEASE_REQN.pack(0xFFFF_FFFE, 1))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Group width past the requested count.
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock, want)
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_LEASE_REQN, 0, proto.LEASE_REQN_WIRE_SIZE)
+                + proto.LEASE_REQN.pack(2, 3))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Wrong declared frame length for a REQN.
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock, want)
+            sock.sendall(proto.SESSION_FRAME.pack(proto.FRAME_LEASE_REQN,
+                                                  0, 4) + U32.pack(1))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Truncated REQN tail: 4 of 8 payload bytes, then close.
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock, want)
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_LEASE_REQN, 0, proto.LEASE_REQN_WIRE_SIZE)
+                + b"\x00" * 4)
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # REQN on a session that never negotiated the capability.
+        with _dial(farm.distributer_port) as sock:
+            flags = _session_hello(sock)  # RLE only
+            assert not flags & proto.SESSION_FLAG_GRANTN
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_LEASE_REQN, 0, proto.LEASE_REQN_WIRE_SIZE)
+                + proto.LEASE_REQN.pack(1, 1))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+
+def test_session_duplicate_upload_in_one_batch_rejected_not_fatal(tmp_path):
+    """The same lease submitted twice in one pipelined batch: the first
+    copy lands, the duplicate draws an in-band REJECT ack (its claim was
+    already consumed and released), and nothing leaks."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        sess = DistributerSession("127.0.0.1", farm.distributer_port,
+                                  counters=Counters())
+        assert sess.connect()
+        grants = sess.request_batchn(1)
+        assert len(grants) == 1
+        tile = np.full(CHUNK_PIXELS, 9, dtype=np.uint8)
+        accepted, _ = sess.submit_pipelined([(grants[0], tile),
+                                             (grants[0], tile)])
+        assert accepted == [True, False]
+        sess.close()
+        _wait_counter(farm, obs_names.COORD_RESULTS_REJECTED, 1)
+        farm.wait_saves_settled(expected_accepted=1)
+        assert farm.scheduler.is_complete()
+        _assert_distributer_alive(farm)
+
+
+def test_client_rejects_truncated_batched_grant_tail():
+    """A coordinator that dies mid-GRANTN must surface as a clean
+    ConnectionError on the client — never a hang, never an allocation
+    sized by the promised-but-undelivered tile count."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve() -> None:
+        conn, _ = srv.accept()
+        with conn:
+            hello = b""
+            while len(hello) < 1 + proto.SESSION_HELLO_WIRE_SIZE:
+                more = conn.recv(1 + proto.SESSION_HELLO_WIRE_SIZE
+                                 - len(hello))
+                if not more:
+                    return
+                hello += more
+            conn.sendall(bytes([proto.SESSION_ACCEPT])
+                         + proto.SESSION_HELLO.pack(
+                             proto.SESSION_FLAG_GRANTN))
+            want = (proto.SESSION_FRAME_WIRE_SIZE
+                    + proto.LEASE_REQN_WIRE_SIZE)
+            req = b""
+            while len(req) < want:
+                more = conn.recv(want - len(req))
+                if not more:
+                    return
+                req += more
+            # Promise one group of 4 tiles, deliver only the first, then
+            # hang up mid-tail.
+            conn.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_LEASE_GRANTN, 0,
+                proto.LEASE_GRANTN_WIRE_SIZE + 4 + 4 * 16))
+            conn.sendall(proto.LEASE_GRANTN.pack(1, 4) + U32.pack(4)
+                         + Workload(64, 50, 0, 0).to_wire())
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        sess = DistributerSession("127.0.0.1", port, compress=False,
+                                  timeout=10, counters=Counters())
+        assert sess.connect()
+        assert sess.flags & proto.SESSION_FLAG_GRANTN
+        with pytest.raises(ConnectionError):
+            sess.request_batchn(4)
+        sess.close()
+    finally:
+        srv.close()
+        t.join(timeout=10)
 
 
 def test_dataserver_rejects_malformed_queries_and_stays_alive(tmp_path):

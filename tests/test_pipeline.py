@@ -306,6 +306,25 @@ def test_lease_prefetch_never_exceeds_window():
         f"exceeds window {window}")
 
 
+def test_upload_lanes_fed_round_robin_no_starvation(vclock):
+    """Regression for batched-grant lane starvation: with a single
+    shared upload queue, one lane could win every dequeue race while a
+    batch of grants drained, leaving its siblings idle.  The materialize
+    stage now routes tiles round-robin across per-lane queues, so the
+    split is exact whatever the upload timing."""
+    n = 8
+    client = FakeClient(n, clock=vclock, upload_s=0.05)
+    disp = FakeDispatcher(clock=vclock)
+    pipe = PipelineExecutor(client, disp, window=4, batch_size=1,
+                            upload_lanes=2, clock=vclock.now)
+    pipe.run()
+    assert len(client.submitted) == n
+    lanes = pipe.stage_stats()["lanes"]
+    assert len(lanes) == 2
+    assert [ls["items"] for ls in lanes] == [n // 2, n // 2]
+    assert all(ls["busy_s"] > 0 for ls in lanes)
+
+
 def test_round_robin_covers_all_devices():
     client = FakeClient(12)
     disp = FakeDispatcher(n_devices=3)

@@ -1,0 +1,220 @@
+"""Batched lease grants (FRAME_LEASE_REQN/GRANTN, SESSION_FLAG_GRANTN):
+one round trip carries up to N leases grouped to the fusion width, the
+accept path group-commits them through the persist queue, and the farm
+output stays bit-identical to the unbatched legacy path."""
+
+import time
+
+import numpy as np
+
+from distributedmandelbrot_tpu.core import LevelSetting
+from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.utils.metrics import Counters
+from distributedmandelbrot_tpu.viewer import DataClient, FetchStatus
+from distributedmandelbrot_tpu.worker import (DistributerClient,
+                                              NativeBackend, NumpyBackend,
+                                              Worker)
+from distributedmandelbrot_tpu.worker.client import DistributerSession
+
+from harness import CoordinatorHarness
+
+MAX_ITER = 24
+
+
+def _fast_exact_backend():
+    """Native C++ backend when this host can build it (bit-identical to
+    the golden NumpyBackend, ~50x faster on full chunks); the golden
+    numpy path otherwise, so the farm tests run — just slower —
+    everywhere."""
+    try:
+        return NativeBackend()
+    except Exception:
+        return NumpyBackend()
+
+
+def _checker(value_a=0, value_b=200, period=4096):
+    """A compressible-but-nontrivial tile: long runs of two values."""
+    tile = np.full(CHUNK_PIXELS, value_a, dtype=np.uint8)
+    tile.reshape(-1, period)[::2] = value_b
+    return tile
+
+
+# -- direct batched exchange -------------------------------------------------
+
+def test_session_grantn_single_round_trip_and_group_commit(tmp_path):
+    """One REQN round trip leases a whole level; the uploads land through
+    the group-commit writer as a handful of multi-tile flushes."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, MAX_ITER)]) \
+            as farm:
+        counters = Counters()
+        sess = DistributerSession("127.0.0.1", farm.distributer_port,
+                                  counters=counters)
+        assert sess.connect()
+        assert sess.flags & proto.SESSION_FLAG_GRANTN
+        rtts_before = counters.get(obs_names.WORKER_WIRE_RTTS)
+        grants = sess.request_batchn(4, batch_width=2)
+        # All four tiles of the 2x2 level arrived in ONE round trip,
+        # grouped into fusion-width batches server-side.
+        assert len(grants) == 4
+        assert len({w.key for w in grants}) == 4
+        assert counters.get(obs_names.WORKER_WIRE_RTTS) == rtts_before + 1
+        assert farm.counters.get(obs_names.COORD_GRANT_BATCHES) == 1
+
+        tile = _checker()
+        accepted, piggyback = sess.submit_pipelined(
+            [(w, tile) for w in grants])
+        assert accepted == [True] * 4
+        assert piggyback == []  # frontier drained by the batched grant
+        sess.close()
+        farm.wait_saves_settled(expected_accepted=4)
+        assert farm.scheduler.is_complete()
+
+        # Group commit: every accepted tile went through put_many, and
+        # flush sizes sum to the tile count (fewer commits than tiles
+        # when the queue coalesces; never more).
+        commits = farm.counters.get(obs_names.STORE_GROUP_COMMITS)
+        flushed = farm.counters.get(obs_names.STORE_FLUSH_TILES)
+        assert commits >= 1
+        assert flushed == 4
+        assert commits <= flushed
+
+        fetch = DataClient("127.0.0.1", farm.dataserver_port).fetch
+        for w in grants:
+            pixels, status = fetch(w.level, w.index_real, w.index_imag)
+            assert status is FetchStatus.OK
+            np.testing.assert_array_equal(pixels, tile)
+
+
+def test_session_grantn_empty_frontier_returns_no_grants(tmp_path):
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)]) \
+            as farm:
+        sess = DistributerSession("127.0.0.1", farm.distributer_port,
+                                  counters=Counters())
+        assert sess.connect()
+        first = sess.request_batchn(8)
+        assert len(first) == 1  # the only tile
+        # Frontier empty now: a well-formed REQN draws an empty GRANTN,
+        # not an error, and the session stays usable.  Empty probes do
+        # not count as grant batches.
+        assert sess.request_batchn(8) == []
+        assert farm.counters.get(obs_names.COORD_GRANT_BATCHES) == 1
+        accepted, _ = sess.submit_pipelined([(first[0], _checker())])
+        assert accepted == [True]
+        sess.close()
+        farm.wait_saves_settled(expected_accepted=1)
+
+
+def test_session_grantn_opt_out_negotiates_down(tmp_path):
+    """A client built with grantn=False never offers the capability;
+    request_batchn transparently degrades to the per-batch legacy
+    exchange and the coordinator mints zero batched grants."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, MAX_ITER)]) \
+            as farm:
+        sess = DistributerSession("127.0.0.1", farm.distributer_port,
+                                  grantn=False, counters=Counters())
+        assert sess.connect()
+        assert not sess.flags & proto.SESSION_FLAG_GRANTN
+        grants = sess.request_batchn(3)
+        assert len(grants) == 3  # served by the plain LEASE_REQ path
+        sess.close()
+        assert farm.counters.get(obs_names.COORD_GRANT_BATCHES) == 0
+
+
+# -- pipelined farm over batched grants --------------------------------------
+
+def test_pipelined_farm_batched_grants_cut_round_trips(tmp_path):
+    """A 3x3 level through the pipelined numpy worker: batched grants
+    keep blocking round trips below one per tile (the perf contract the
+    bench's grants-per-RTT figure reports)."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(3, MAX_ITER)]) \
+            as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            _fast_exact_backend(), batch_size=3, window=6, upload_lanes=2,
+            grant_batch=6)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=9)
+        assert farm.scheduler.is_complete()
+        assert worker.counters.get(obs_names.WORKER_SESSION_FALLBACKS) == 0
+        # The level's 9 tiles were minted in a handful of batched grants
+        # (the rest piggyback on upload acks), never one-per-exchange.
+        batches = farm.counters.get(obs_names.COORD_GRANT_BATCHES)
+        assert 1 <= batches <= 4
+        # Blocking round trips stay bounded near one per tile even when
+        # uploads fragment (the legacy path pays ~3 per tile).
+        rtts = worker.counters.get(obs_names.WORKER_WIRE_RTTS)
+        assert 0 < rtts <= 2 * 9
+        # Round-robin lane feed: neither lane starved.
+        lanes = worker.pipeline.stage_stats()["lanes"]
+        assert len(lanes) == 2
+        assert all(ls["items"] > 0 for ls in lanes)
+
+
+class _SlowBackend:
+    """NumpyBackend that out-waits the coordinator's idle deadline
+    between batches (a stand-in for any backend whose tiles take longer
+    than the read timeout)."""
+
+    def __init__(self, inner, delay_s):
+        self._inner = inner
+        self._delay_s = delay_s
+
+    def compute_batch(self, workloads):
+        time.sleep(self._delay_s)
+        return self._inner.compute_batch(workloads)
+
+
+def test_pipelined_farm_redials_idle_closed_session(tmp_path):
+    """The coordinator drops sessions idle past its read deadline by
+    design; a worker whose backend out-waits it between batches must
+    re-dial and finish the level instead of dying on the broken pipe."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(2, 8)],
+                            read_timeout=0.2) as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            _SlowBackend(_fast_exact_backend(), 0.5), batch_size=1,
+            window=1)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=4)
+        assert farm.scheduler.is_complete()
+        assert worker.counters.get(obs_names.WORKER_SESSION_REDIALS) >= 1
+        # A re-dial is a recovery, not a downgrade: the lanes stayed on
+        # the session tier throughout.
+        assert worker.counters.get(obs_names.WORKER_SESSION_FALLBACKS) == 0
+
+
+def test_farm_batched_output_bit_identical_to_legacy(tmp_path):
+    """Golden parity through real sockets: the batched-grant session
+    farm and the legacy connection-per-exchange farm must land byte-
+    identical tiles for the whole level."""
+    (tmp_path / "legacy").mkdir()
+    with CoordinatorHarness(str(tmp_path / "legacy"),
+                            [LevelSetting(2, MAX_ITER)]) as farm:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm.distributer_port),
+            _fast_exact_backend(), batch_size=2, window=4,
+            use_session=False)
+        worker.run_until_drained()
+        farm.wait_saves_settled(expected_accepted=4)
+        assert farm.counters.get(obs_names.COORD_GRANT_BATCHES) == 0
+        fetch = DataClient("127.0.0.1", farm.dataserver_port).fetch
+        golden = {(ir, ii): fetch(2, ir, ii)[0]
+                  for ir in range(2) for ii in range(2)}
+
+    (tmp_path / "batched").mkdir()
+    with CoordinatorHarness(str(tmp_path / "batched"),
+                            [LevelSetting(2, MAX_ITER)]) as farm2:
+        worker = Worker(
+            DistributerClient("127.0.0.1", farm2.distributer_port),
+            _fast_exact_backend(), batch_size=2, window=4, upload_lanes=2,
+            grant_batch=4)
+        worker.run_until_drained()
+        farm2.wait_saves_settled(expected_accepted=4)
+        assert farm2.counters.get(obs_names.COORD_GRANT_BATCHES) >= 1
+        fetch = DataClient("127.0.0.1", farm2.dataserver_port).fetch
+        for (ir, ii), golden_pixels in golden.items():
+            pixels, status = fetch(2, ir, ii)
+            assert status is FetchStatus.OK
+            np.testing.assert_array_equal(pixels, golden_pixels)
