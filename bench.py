@@ -1740,6 +1740,183 @@ def bench_storm(repeats: int, *, level: int = 8,
     return out
 
 
+def bench_shards(repeats: int, *, levels: str = "64:100",
+                 shard_counts: tuple = (1, 2, 4), clients: int = 4,
+                 duration: float = 4.0, batch: int = 32) -> dict:
+    """Sharded control-plane scaling (no accelerator): aggregate lease-
+    grant throughput as the coordinator fleet grows 1 -> 2 -> 4 shards,
+    plus restart-to-first-grant under live load.
+
+    Each leg spawns N ``ShardedCoordinator`` subprocesses (one event
+    loop per shard — subprocesses, not threads, so the GIL never
+    serializes the fleet) over a shared data dir with near-zero lease
+    timeouts, so the owned frontier recycles continuously; ``clients``
+    grant-storm subprocesses (chaos/driver.py ``drain`` role) then
+    hammer multi-homed REQN exchanges for ``duration`` seconds without
+    ever uploading.  Aggregate grants/s is total grants over the
+    slowest client's window — a pure grant-path number, uncontaminated
+    by compute or persistence.  ``cpu_count`` rides along because the
+    curve is only meaningful with at least one core per shard: on a
+    1-core box every process time-slices and the ratio pins near 1x.
+
+    The restart leg re-runs the widest storm, SIGKILLs shard 0
+    mid-storm, respawns it on fresh ephemeral ports (ring.json
+    rewritten in place — ownership never moves), and reports the time
+    from respawn to that shard's first post-restart grant (polled from
+    its /varz), while the storm clients re-dial around the hole.
+    """
+    import os
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    driver = "distributedmandelbrot_tpu.chaos.driver"
+
+    def spawn_shard(tmp: str, leg: str, k: int, n: int
+                    ) -> tuple[subprocess.Popen, str]:
+        port_file = os.path.join(tmp, f"{leg}-ports-{k}.json")
+        if os.path.exists(port_file):
+            os.unlink(port_file)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", driver, "shard",
+             os.path.join(tmp, f"farm-{leg}"), port_file, levels,
+             str(k), str(n),
+             "--lease-timeout", "0.05", "--sweep-period", "0.02",
+             "--checkpoint-period", "0"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return proc, port_file
+
+    def read_ports(proc: subprocess.Popen, port_file: str) -> dict:
+        deadline = time.monotonic() + 30.0
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"shard died during startup (exit {proc.returncode})")
+            if time.monotonic() > deadline:
+                raise RuntimeError("shard never wrote its port file")
+            time.sleep(0.05)
+        with open(port_file, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def write_ring(tmp: str, leg: str, infos: list[dict]) -> str:
+        from distributedmandelbrot_tpu.control.ring import (HashRing,
+                                                            ShardInfo)
+        path = os.path.join(tmp, f"ring-{leg}.json")
+        HashRing([ShardInfo("127.0.0.1",
+                            distributer_port=i["distributer"],
+                            dataserver_port=i["dataserver"])
+                  for i in infos], version=1).save(path)
+        return path
+
+    def storm(tmp: str, leg: str, ring_path: str, secs: float
+              ) -> tuple[int, float]:
+        """clients x drain subprocesses; (total grants, slowest window)."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        outs, procs = [], []
+        for c in range(clients):
+            out = os.path.join(tmp, f"{leg}-drain-{c}.json")
+            outs.append(out)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", driver, "drain", ring_path,
+                 "--duration", str(secs), "--batch", str(batch),
+                 "--out", out],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        grants, slowest = 0, 0.0
+        for proc, out in zip(procs, outs):
+            proc.wait(timeout=secs + 60.0)
+            with open(out, "r", encoding="utf-8") as f:
+                rep = json.load(f)
+            grants += rep["grants"]
+            slowest = max(slowest, rep["seconds"])
+        return grants, slowest
+
+    out: dict = {"config": "shards", "levels": levels, "clients": clients,
+                 "duration_s": duration, "batch": batch,
+                 "cpu_count": os.cpu_count(),
+                 "grants_per_s": {}, "grants": {}}
+    with tempfile.TemporaryDirectory(prefix="dmtpu-shardbench-") as tmp:
+        for n in shard_counts:
+            leg = f"n{n}"
+            shards = [spawn_shard(tmp, leg, k, n) for k in range(n)]
+            try:
+                infos = [read_ports(p, f) for p, f in shards]
+                ring_path = write_ring(tmp, leg, infos)
+                grants, slowest = storm(tmp, leg, ring_path, duration)
+            finally:
+                for proc, _ in shards:
+                    proc.kill()
+                    proc.wait()
+            out["grants"][str(n)] = grants
+            out["grants_per_s"][str(n)] = \
+                round(grants / slowest, 1) if slowest else 0.0
+        first = str(shard_counts[0])
+        last = str(shard_counts[-1])
+        base = out["grants_per_s"][first]
+        out[f"scaling_{last}v{first}"] = \
+            round(out["grants_per_s"][last] / base, 2) if base else 0.0
+
+        # Restart-to-first-grant under live load: widest fleet, kill
+        # shard 0 two seconds into a longer storm, bring it back on
+        # fresh ports, poll its /varz for the first post-restart grant.
+        n = shard_counts[-1]
+        leg = "restart"
+        shards = [spawn_shard(tmp, leg, k, n) for k in range(n)]
+        try:
+            infos = [read_ports(p, f) for p, f in shards]
+            ring_path = write_ring(tmp, leg, infos)
+            env = dict(os.environ)
+            env["PYTHONPATH"] = repo_root + os.pathsep \
+                + env.get("PYTHONPATH", "")
+            storm_secs = duration + 8.0
+            drains = [subprocess.Popen(
+                [sys.executable, "-m", driver, "drain", ring_path,
+                 "--duration", str(storm_secs), "--batch", str(batch),
+                 "--out", os.path.join(tmp, f"restart-drain-{c}.json")],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL) for c in range(clients)]
+            time.sleep(2.0)
+            victim, _ = shards[0]
+            victim.kill()
+            victim.wait()
+            t_respawn = time.monotonic()
+            shards[0] = spawn_shard(tmp, leg, 0, n)
+            infos[0] = read_ports(*shards[0])
+            write_ring(tmp, leg, infos)  # same version: only ports moved
+            blip = None
+            poll_deadline = time.monotonic() + 60.0
+            while time.monotonic() < poll_deadline:
+                try:
+                    with urllib.request.urlopen(
+                            "http://127.0.0.1:%d/varz"
+                            % infos[0]["exporter"], timeout=0.5) as resp:
+                        varz = json.loads(resp.read().decode("utf-8"))
+                    granted = sum(
+                        v for label, v in varz.get("counters", {}).items()
+                        if label.split("{")[0] == "workloads_granted")
+                    if granted > 0:
+                        blip = round(time.monotonic() - t_respawn, 3)
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.05)
+            out["restart_to_first_grant_s"] = blip
+            for proc in drains:
+                proc.wait(timeout=storm_secs + 60.0)
+        finally:
+            for proc, _ in shards:
+                proc.kill()
+                proc.wait()
+    return out
+
+
 def _ensure_live_backend(probe_timeout: float = 120.0) -> bool:
     """Guard against a dead accelerator tunnel: on this rig the TPU is
     reached through a network tunnel whose failure mode is jax backend
@@ -1835,7 +2012,17 @@ def main() -> int:
                              "p50/p99/p999, goodput vs offered, shed "
                              "fraction, 1-vs-2-replica goodput scaling; "
                              "no accelerator needed)")
+    parser.add_argument("--shards", action="store_true",
+                        help="run only the sharded control-plane config "
+                             "(aggregate grant throughput at 1/2/4 "
+                             "coordinator shards, restart-to-first-grant "
+                             "under live load; no accelerator needed)")
     args = parser.parse_args()
+    if args.shards:
+        # Grant-path only — shard subprocesses + drain clients, no
+        # compute, no accelerator probe.
+        print(json.dumps(bench_shards(args.repeats)), flush=True)
+        return 0
     if args.recovery:
         # Pure coordinator/storage path — skip the accelerator probe
         # entirely so this leg runs anywhere (CI, laptops, dead tunnels).

@@ -111,6 +111,14 @@ SESSION_EXCHANGES = (
      f"{PACKAGE}/coordinator/distributer.py::"
      f"Distributer._session_lease_reqn",
      "SESSION_FRAME"),
+    # The sharded control plane's ring exchange (FRAME_RING_REQ ->
+    # FRAME_RING_INFO): the client's skew probe against the shard's
+    # authoritative slice identity.
+    ("ring_req",
+     f"{PACKAGE}/worker/client.py::DistributerSession.ring_info",
+     f"{PACKAGE}/coordinator/distributer.py::"
+     f"Distributer._session_ring_req",
+     "SESSION_FRAME"),
 )
 
 # Frame-sequence wildcard: a payload whose length is data-dependent.

@@ -747,6 +747,12 @@ def cmd_worker(argv: Sequence[str]) -> int:
                              "exchange on connection failure (capped "
                              "exponential backoff + jitter; 0 = fail fast). "
                              "Lets a farm ride out a coordinator restart.")
+    parser.add_argument("--ring", metavar="RING_JSON", default=None,
+                        help="multi-home against a sharded control plane: "
+                             "one session per shard from this ring config, "
+                             "leases round-robined across shards, uploads "
+                             "routed by key (overrides --host/--port; "
+                             "implies the pipelined executor)")
     parser.add_argument("--kernel", choices=["auto", "xla", "pallas"],
                         default="auto",
                         help="compute kernel for the mesh backend")
@@ -822,6 +828,22 @@ def cmd_worker(argv: Sequence[str]) -> int:
             batch_size = jax.local_device_count()
         else:
             batch_size = 1
+    ring = None
+    host, port = args.host, args.port
+    if args.ring is not None:
+        from distributedmandelbrot_tpu.control.ring import (HashRing,
+                                                            RingConfigError)
+        try:
+            ring = HashRing.load(args.ring)
+        except RingConfigError as e:
+            raise SystemExit(f"dmtpu worker: {e}")
+        if args.no_session:
+            raise SystemExit("dmtpu worker: --ring needs sessions "
+                             "(drop --no-session)")
+        # The classic client doubles as the declined-hello fallback;
+        # point it at shard 0 so single-shard rings still degrade sanely.
+        host = ring.shards[0].host
+        port = ring.shards[0].distributer_port
     window = args.window
     if window < 0:
         # Auto: pipeline backends with per-tile dispatch handles (they
@@ -831,14 +853,19 @@ def cmd_worker(argv: Sequence[str]) -> int:
             window = 2 * args.depth * max(1, len(backend.devices()))
         else:
             window = 0
-    worker = Worker(DistributerClient(args.host, args.port,
+    if ring is not None and window == 0:
+        # Multi-homing lives in the pipelined session path; give ring
+        # mode a minimal window rather than silently ignoring the ring.
+        window = max(2, 2 * args.depth)
+    worker = Worker(DistributerClient(host, port,
                                       reconnect_attempts=args.reconnect),
                     backend,
                     batch_size=batch_size, window=window, depth=args.depth,
                     upload_lanes=args.upload_lanes,
                     batch_tiles=args.batch_tiles,
                     grant_batch=args.grant_batch,
-                    use_session=not args.no_session)
+                    use_session=not args.no_session,
+                    ring=ring)
     profiling = False
     if args.profile:
         import jax
@@ -1739,6 +1766,173 @@ def _loadgen_storm(args, phases, schedule) -> int:
     return 0
 
 
+def cmd_coord(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu coord",
+        description="Run ONE shard of the sharded control plane: the "
+                    "full Distributer/DataServer stack restricted to the "
+                    "consistent-hash slice --shard K/N owns, over a data "
+                    "dir shared with the other N-1 shards.")
+    parser.add_argument("--shard", required=True, metavar="K/N",
+                        help="this shard's slice: index K of N shards "
+                             "(e.g. 0/4)")
+    parser.add_argument("--ring", default=None, metavar="RING_JSON",
+                        help="ring config naming all N shard endpoints; "
+                             "optional — ownership needs only K/N, so "
+                             "ephemeral-port launches may start ringless "
+                             "and publish bound ports afterwards")
+    parser.add_argument("--ring-version", type=int, default=1,
+                        help="ring version to advertise when launching "
+                             "without --ring (skew detector on the wire)")
+    parser.add_argument("-l", "--levels", required=True,
+                        help="level:max_iter[,level:max_iter...] — must "
+                             "be identical across the fleet")
+    parser.add_argument("-o", "--data-dir", default="",
+                        help="parent directory for the SHARED Data/ "
+                             "(default: cwd)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--distributer-port", type=int, default=0,
+                        help="0 = ephemeral (default: shards usually "
+                             "co-locate, so fixed ports would collide)")
+    parser.add_argument("--dataserver-port", type=int, default=0)
+    parser.add_argument("--lease-timeout", type=float,
+                        default=proto.DEFAULT_LEASE_TIMEOUT)
+    parser.add_argument("--sweep-period", type=float,
+                        default=proto.DEFAULT_SWEEP_PERIOD)
+    parser.add_argument("--fsync-index", action="store_true")
+    parser.add_argument("--checkpoint-period", type=float, default=0.0,
+                        help="durability checkpoint every N seconds "
+                             "(0 disables)")
+    parser.add_argument("--stats-period", type=float, default=60.0)
+    parser.add_argument("--exporter-port", type=int, default=0,
+                        help="HTTP metrics port; 0 = ephemeral, "
+                             "-1 disables")
+    _add_common(parser)
+    args = parser.parse_args(argv)
+    _configure_logging(args)
+
+    from distributedmandelbrot_tpu.control import ShardedCoordinator
+    from distributedmandelbrot_tpu.control.ring import (RingConfigError,
+                                                        parse_shard_spec)
+    from distributedmandelbrot_tpu.storage.ownership import LevelOwnedError
+    from distributedmandelbrot_tpu.storage.store import DataDirError
+
+    settings = parse_level_settings(args.levels)
+    try:
+        shard, n_shards = parse_shard_spec(args.shard)
+        coordinator = ShardedCoordinator(
+            settings, shard, n_shards,
+            ring_path=args.ring, ring_version=args.ring_version,
+            data_dir_parent=args.data_dir, host=args.host,
+            distributer_port=args.distributer_port,
+            dataserver_port=args.dataserver_port,
+            lease_timeout=args.lease_timeout,
+            sweep_period=args.sweep_period,
+            fsync_index=args.fsync_index,
+            checkpoint_period=args.checkpoint_period,
+            stats_period=args.stats_period,
+            exporter_port=(None if args.exporter_port < 0
+                           else args.exporter_port))
+    except (RingConfigError, DataDirError, LevelOwnedError) as e:
+        raise SystemExit(f"dmtpu coord: {e}")
+    sched = coordinator.scheduler
+    print(f"coord shard {shard}/{n_shards}: owns {sched.owned_tiles} of "
+          f"{sched.total_tiles} tiles across {len(settings)} level(s) "
+          f"({sched.completed_count} already complete on disk)",
+          flush=True)
+    try:
+        asyncio.run(coordinator.run_forever())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_chaos(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu chaos",
+        description="Run one chaos scenario against a live sharded farm "
+                    "(real subprocesses, real sockets, real numpy "
+                    "compute): kill coordinators and workers on a "
+                    "schedule, then audit exactly-once completion, ring "
+                    "ownership, numpy-golden parity, and the "
+                    "restart-to-first-grant blip.")
+    parser.add_argument("scenario", nargs="?", default="coord-kill",
+                        help="catalogue entry (see --list); default: "
+                             "coord-kill")
+    parser.add_argument("--list", action="store_true",
+                        help="list the scenario catalogue and exit")
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI-sized run: shallower tiles, one worker, "
+                             "one parity sample")
+    parser.add_argument("--levels", default=None,
+                        help="override the scenario's level spec")
+    parser.add_argument("--workers", type=int, default=None,
+                        help="override the scenario's worker count")
+    parser.add_argument("--shards", type=int, default=None,
+                        help="override the scenario's shard count")
+    parser.add_argument("--deadline", type=float, default=None,
+                        help="override the completion deadline (seconds)")
+    parser.add_argument("--workdir", default=None,
+                        help="keep farm state + per-process logs here "
+                             "(default: throwaway temp dir)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON")
+    _add_common(parser)
+    args = parser.parse_args(argv)
+    _configure_logging(args)
+
+    import dataclasses as dc
+
+    # Lazy: the chaos package must import in the lint-only CI
+    # environment (numpy + pytest, no jax) — workers are numpy-only.
+    from distributedmandelbrot_tpu.chaos import SCENARIOS, ChaosRunner
+
+    if args.list:
+        for name in sorted(SCENARIOS):
+            sc = SCENARIOS[name]
+            print(f"{name:18} {sc.description}")
+        return 0
+    if args.scenario not in SCENARIOS:
+        print(f"dmtpu chaos: unknown scenario {args.scenario!r}; have "
+              f"{sorted(SCENARIOS)}", file=sys.stderr)
+        return 2
+    scenario = SCENARIOS[args.scenario]
+    if args.smoke:
+        scenario = dc.replace(scenario, levels="3:2", n_workers=1,
+                              parity_samples=1, deadline=180.0)
+    overrides = {}
+    if args.levels is not None:
+        overrides["levels"] = args.levels
+    if args.workers is not None:
+        overrides["n_workers"] = args.workers
+    if args.shards is not None:
+        overrides["n_shards"] = args.shards
+    if args.deadline is not None:
+        overrides["deadline"] = args.deadline
+    if overrides:
+        scenario = dc.replace(scenario, **overrides)
+
+    runner = ChaosRunner(scenario, workdir=args.workdir,
+                         log=None if args.quiet else print)
+    report = runner.run()
+    if args.json:
+        print(report.to_json())
+    else:
+        print(f"chaos {report.scenario}: "
+              f"{'OK' if report.ok else 'FAILED'} — "
+              f"{report.tiles_on_disk}/{report.expected_tiles} tiles, "
+              f"{report.duplicate_entries} duplicates, "
+              f"{report.misowned_entries} misowned, "
+              f"parity {report.parity_checked - report.parity_failures}/"
+              f"{report.parity_checked}, {report.kills} kills, "
+              f"{report.restarts} restarts, "
+              f"first-grant blips {report.restart_to_first_grant_s} "
+              f"in {report.duration_s:.1f}s")
+        for failure in report.failures:
+            print(f"  FAIL: {failure}")
+    return 0 if report.ok else 1
+
+
 class _NoFile:
     """Stand-in for findings on unparseable files (no suppressions)."""
 
@@ -1754,7 +1948,8 @@ COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
             "serve": cmd_serve, "viewer": cmd_viewer, "render": cmd_render,
             "animate": cmd_animate, "compact": cmd_compact,
             "stats": cmd_stats, "trace": cmd_trace, "admin": cmd_admin,
-            "check": cmd_check, "loadgen": cmd_loadgen}
+            "check": cmd_check, "loadgen": cmd_loadgen,
+            "coord": cmd_coord, "chaos": cmd_chaos}
 
 
 def _enable_compile_cache() -> None:
@@ -1811,8 +2006,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
-              "{coordinator|worker|serve|viewer|render|animate|compact|"
-              "stats|trace|admin|check|loadgen} [options]\n"
+              "{coordinator|coord|worker|serve|viewer|render|animate|"
+              "compact|stats|trace|admin|check|loadgen|chaos} [options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

@@ -61,7 +61,8 @@ class Coordinator:
                  exporter_port: Optional[int] = None,
                  accept_spans: bool = True,
                  accept_session: bool = True,
-                 checkpoint_period: float = 0.0) \
+                 checkpoint_period: float = 0.0,
+                 ring_slice=None) \
             -> None:
         # One registry + one trace ring + one span store feed every layer
         # of this process; the exporter (opt-in like the gateway:
@@ -70,32 +71,45 @@ class Coordinator:
         self.registry = Registry()
         self.trace = TraceLog()
         self.spans = SpanStore()
+        # ``ring_slice`` (control/ring.py RingSlice, duck-typed to keep
+        # the import DAG acyclic) turns this process into one shard of a
+        # sharded control plane: the scheduler's frontier is restricted
+        # to the slice, the store's index log / checkpoint blob / level
+        # claims are namespaced per shard inside the SHARED data dir,
+        # and the distributer answers misrouted uploads with redirects.
+        self.ring_slice = ring_slice
+        namespace = "" if ring_slice is None else ring_slice.namespace
         self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index,
-                                registry=self.registry)
+                                registry=self.registry,
+                                namespace=namespace)
         # Fail loudly if another live coordinator owns any of our levels
         # on this data dir (reference: the static claimed-levels set,
         # Distributer.cs:14,109-115 — file-based here because our
         # coordinators are separate processes).  Released in stop().
+        # Shards claim under their namespace: peers legitimately share
+        # every level, each owning a disjoint keyspace slice.
         self._level_claims = LevelClaims(
-            self.store.data_dir, [s.level for s in level_settings])
+            self.store.data_dir, [s.level for s in level_settings],
+            namespace=namespace)
         try:
             # Checkpoint-aware resume: the completed set comes from the
             # last checkpoint plus a replay of only the index entries past
             # its recorded offset; with no (usable) checkpoint this is the
             # classic full index replay.
             restore = load_restore_state(self.store, level_settings,
-                                         registry=self.registry)
+                                         registry=self.registry,
+                                         namespace=namespace)
             if restore.completed:
                 logger.info("resume: %d tiles already completed on disk",
                             len(restore.completed))
             self.counters = Counters(registry=self.registry)
             kwargs = {} if clock is None else {"clock": clock}
-            self.scheduler = TileScheduler(level_settings,
-                                           completed=restore.completed,
-                                           lease_timeout=lease_timeout,
-                                           registry=self.registry,
-                                           trace=self.trace,
-                                           **kwargs)
+            self.scheduler = TileScheduler(
+                level_settings, completed=restore.completed,
+                lease_timeout=lease_timeout, registry=self.registry,
+                trace=self.trace,
+                owns=None if ring_slice is None else ring_slice.owns,
+                **kwargs)
             # Adopt the checkpointed frontier cursor, retry queue, and
             # leases (with remaining TTLs) so in-flight workers from
             # before a restart can land their results against live leases.
@@ -119,11 +133,13 @@ class Coordinator:
                                            trace=self.trace,
                                            spans=self.spans,
                                            accept_spans=accept_spans,
-                                           accept_session=accept_session)
+                                           accept_session=accept_session,
+                                           ring_slice=ring_slice)
             self.dataserver = DataServer(self.store, host=host,
                                          port=dataserver_port,
                                          read_timeout=read_timeout,
-                                         counters=self.counters)
+                                         counters=self.counters,
+                                         ring_slice=ring_slice)
             # The serving gateway is opt-in (gateway_port=None disables);
             # when enabled it shares the store, scheduler, and counters,
             # and hooks the distributer's save path for compute-on-read
@@ -143,14 +159,16 @@ class Coordinator:
                     max_queue_depth=gateway_max_queue_depth,
                     rate=gateway_rate, burst=gateway_burst,
                     render_cache_tiles=gateway_render_tiles,
-                    counters=self.counters, trace=self.trace)
+                    counters=self.counters, trace=self.trace,
+                    ring_slice=ring_slice)
             # Durability checkpoints: periodic when checkpoint_period > 0,
             # on-demand always (POST /checkpoint, final write on stop).
             self.recovery = RecoveryManager(
                 self.store, self.scheduler,
                 generation=restore.generation,
                 period=checkpoint_period, registry=self.registry,
-                pending_keys_fn=self.distributer.pending_save_keys)
+                pending_keys_fn=self.distributer.pending_save_keys,
+                namespace=namespace)
             self.exporter: Optional[MetricsExporter] = None
             if exporter_port is not None:
                 self.exporter = MetricsExporter(
@@ -277,7 +295,7 @@ class Coordinator:
 
     def _varz_extra(self) -> dict:
         """Scheduler frontier state for /varz (beyond the gauge family)."""
-        return {
+        extra = {
             "scheduler": {
                 "frontier_depth": self.scheduler.frontier_depth,
                 "outstanding_leases": self.scheduler.outstanding_leases,
@@ -289,3 +307,11 @@ class Coordinator:
                 "checkpoint_period": self.recovery.period,
             },
         }
+        if self.ring_slice is not None:
+            extra["shard"] = {
+                "shard": self.ring_slice.shard,
+                "n_shards": self.ring_slice.n_shards,
+                "ring_version": self.ring_slice.version,
+                "owned_tiles": self.scheduler.owned_tiles,
+            }
+        return extra

@@ -30,12 +30,19 @@ class DataServer:
     def __init__(self, store: ChunkStore, *, host: str = "0.0.0.0",
                  port: int = proto.DEFAULT_DATASERVER_PORT,
                  read_timeout: Optional[float] = proto.DEFAULT_READ_TIMEOUT,
-                 counters: Optional[Counters] = None) -> None:
+                 counters: Optional[Counters] = None,
+                 ring_slice=None) -> None:
         self.store = store
         self.host = host
         self.port = port
         self.read_timeout = read_timeout
         self.counters = counters if counters is not None else Counters()
+        # Duck-typed control.ring.RingSlice (owns/owner_of/version).  A
+        # sharded coordinator's store holds only its own slice, so a
+        # query for a foreign key is answered with QUERY_REDIRECT + the
+        # authoritative shard instead of a not-available that would
+        # never resolve here.
+        self.ring_slice = ring_slice
         self._server: Optional[asyncio.Server] = None
 
     async def start(self) -> None:
@@ -92,6 +99,13 @@ class DataServer:
             self.counters.inc("queries_rejected")
             logger.info("rejected invalid query (%d,%d,%d)",
                         level, index_real, index_imag)
+            return
+        key = (level, index_real, index_imag)
+        if self.ring_slice is not None and not self.ring_slice.owns(key):
+            framing.write_byte(writer, proto.QUERY_REDIRECT)
+            writer.write(proto.REDIRECT.pack(self.ring_slice.owner_of(key),
+                                             self.ring_slice.version))
+            self.counters.inc(obs_names.DATASERVER_REDIRECTS)
             return
         payload = await asyncio.to_thread(
             self.store.load_payload, level, index_real, index_imag)

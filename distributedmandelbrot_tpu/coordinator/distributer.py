@@ -82,9 +82,15 @@ class Distributer:
                  spans: Optional[SpanStore] = None,
                  accept_spans: bool = True,
                  accept_session: bool = True,
-                 on_chunk_saved=None) -> None:
+                 on_chunk_saved=None,
+                 ring_slice=None) -> None:
         self.scheduler = scheduler
         self.store = store
+        # One shard's view of the consistent-hash ring (control/ring.py
+        # RingSlice, duck-typed to avoid an import cycle through the
+        # control package).  None is the unsharded coordinator: the
+        # SHARD capability is never offered and every key is ours.
+        self.ring_slice = ring_slice
         self.host = host
         self.port = port
         self.sweep_period = sweep_period
@@ -351,8 +357,13 @@ class Distributer:
         hello = await self._read(
             framing.read_exact(reader, proto.SESSION_HELLO_WIRE_SIZE))
         (offered,) = proto.SESSION_HELLO.unpack(hello)
-        negotiated = offered & (proto.SESSION_FLAG_RLE
-                                | proto.SESSION_FLAG_GRANTN)
+        # SHARD is only echoed by a ring-configured coordinator, so a
+        # sharded worker dialing an unsharded one negotiates down to
+        # treating it as the sole owner of the keyspace.
+        acceptable = proto.SESSION_FLAG_RLE | proto.SESSION_FLAG_GRANTN
+        if self.ring_slice is not None:
+            acceptable |= proto.SESSION_FLAG_SHARD
+        negotiated = offered & acceptable
         framing.write_byte(writer, proto.SESSION_ACCEPT)
         writer.write(proto.SESSION_HELLO.pack(negotiated))
         await writer.drain()
@@ -380,6 +391,9 @@ class Distributer:
                                            negotiated, peer)
             elif frame_type == proto.FRAME_SPANS:
                 await self._session_spans(reader, length)
+            elif frame_type == proto.FRAME_RING_REQ:
+                await self._session_ring_req(reader, writer, seq, length,
+                                             negotiated)
             else:
                 raise framing.ProtocolError(
                     f"unknown session frame type {frame_type:#x}")
@@ -462,6 +476,42 @@ class Distributer:
         if grants:
             self.counters.inc("workloads_granted", len(grants))
 
+    async def _session_ring_req(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter,
+                                seq: int, length: int,
+                                negotiated: int) -> None:
+        """Answer a worker's ring query with this shard's slice identity.
+
+        A stale client ring version is counted but still answered — the
+        reply IS the correction; only a session that never negotiated
+        sharding asking is a protocol violation."""
+        if not negotiated & proto.SESSION_FLAG_SHARD:
+            raise framing.ProtocolError(
+                "ring request on a session that did not negotiate sharding")
+        if length != proto.RING_REQ_WIRE_SIZE:
+            raise framing.ProtocolError(
+                f"ring request frame length {length}, expected "
+                f"{proto.RING_REQ_WIRE_SIZE}")
+        (client_version,) = proto.RING_REQ.unpack(await self._read(
+            framing.read_exact(reader, proto.RING_REQ_WIRE_SIZE)))
+        rs = self.ring_slice
+        self.counters.inc(obs_names.COORD_SHARD_RING_REQS)
+        if client_version != rs.version:
+            self.counters.inc(obs_names.COORD_SHARD_RING_SKEW)
+        writer.write(proto.SESSION_FRAME.pack(
+            proto.FRAME_RING_INFO, seq, proto.RING_INFO_WIRE_SIZE))
+        writer.write(proto.RING_INFO.pack(rs.version, rs.shard,
+                                          rs.n_shards))
+
+    def _write_redirect(self, writer: asyncio.StreamWriter, seq: int,
+                        owner: int) -> None:
+        """Redirect answer for a misrouted upload: the ack slot carries
+        the authoritative shard instead of accept/reject."""
+        writer.write(proto.SESSION_FRAME.pack(
+            proto.FRAME_REDIRECT, seq, proto.REDIRECT_WIRE_SIZE))
+        writer.write(proto.REDIRECT.pack(owner, self.ring_slice.version))
+        self.counters.inc(obs_names.COORD_SHARD_REDIRECTS)
+
     def _write_upload_ack(self, writer: asyncio.StreamWriter, seq: int,
                           flag: int, want: int, peer: Optional[str]) -> None:
         """Accept/reject ack for one upload, piggybacking up to ``want``
@@ -505,6 +555,27 @@ class Distributer:
                     "RLE upload on a session that did not negotiate it")
         else:
             raise framing.ProtocolError(f"unknown wire codec {codec:#x}")
+        if self.ring_slice is not None and not self.ring_slice.owns(w.key):
+            # Another shard's key (a worker holding a stale ring, or a
+            # ring version rolled mid-flight): drain the body to keep
+            # the frame stream in sync, then point at the owner.  Only
+            # SHARD-negotiated sessions can legally carry foreign keys'
+            # redirects, but a misroute on a down-negotiated session
+            # still must not be accepted — reject it there instead.
+            await self._read(framing.read_exact(reader, body_len))
+            self.counters.inc(obs_names.COORD_SHARD_MISROUTES)
+            if negotiated & proto.SESSION_FLAG_SHARD:
+                owner = self.ring_slice.owner_of(w.key)
+                logger.info("redirecting result for %s to shard %d", w,
+                            owner)
+                self._write_redirect(writer, seq, owner)
+            else:
+                self.counters.inc(obs_names.COORD_RESULTS_REJECTED)
+                logger.info("rejected result for %s (not this shard's "
+                            "key)", w)
+                self._write_upload_ack(writer, seq, proto.RESPONSE_REJECT,
+                                       want, peer)
+            return
         token = self.scheduler.claim(w)
         if token is None:
             # Stale or unknown lease: the body still has to be drained to

@@ -69,13 +69,16 @@ class StaleGenerationError(RuntimeError):
     """A newer coordinator generation owns this checkpoint (fencing)."""
 
 
-def checkpoint_blob_name(level_settings: Sequence[LevelSetting]) -> str:
+def checkpoint_blob_name(level_settings: Sequence[LevelSetting],
+                         namespace: str = "") -> str:
     """Per-levels-group blob name, so coordinators sharing a data dir
     with disjoint level sets (which the flock claims permit) keep
-    independent checkpoints instead of clobbering one blob."""
+    independent checkpoints instead of clobbering one blob.
+    ``namespace`` extends the same isolation to ring shards sharing
+    every level (``_checkpoint-3-s0of4.dat``)."""
     levels = "_".join(str(s.level) for s in
                       sorted(level_settings, key=lambda s: s.level))
-    return f"_checkpoint-{levels}.dat"
+    return f"_checkpoint-{levels}{namespace}.dat"
 
 
 @dataclass
@@ -158,11 +161,12 @@ def decode_checkpoint(data: bytes) -> Checkpoint:
 
 
 def peek_generation(store: ChunkStore,
-                    level_settings: Sequence[LevelSetting]) -> Optional[int]:
+                    level_settings: Sequence[LevelSetting],
+                    namespace: str = "") -> Optional[int]:
     """Generation of the stored checkpoint from its header alone (the
     fencing read before a write), or None when absent/unreadable."""
-    head = store.backend.peek_blob(checkpoint_blob_name(level_settings),
-                                   _HEADER.size)
+    head = store.backend.peek_blob(
+        checkpoint_blob_name(level_settings, namespace), _HEADER.size)
     if head is None or len(head) < _HEADER.size:
         return None
     magic, version, generation = _HEADER.unpack_from(head, 0)[:3]
@@ -172,12 +176,14 @@ def peek_generation(store: ChunkStore,
 
 
 def load_checkpoint(store: ChunkStore,
-                    level_settings: Sequence[LevelSetting]
+                    level_settings: Sequence[LevelSetting],
+                    namespace: str = ""
                     ) -> Optional[Checkpoint]:
     """The stored checkpoint, or None when absent or unreadable (a
     corrupt checkpoint degrades to a full index replay, never an error:
     the index remains the source of truth)."""
-    data = store.backend.get_blob(checkpoint_blob_name(level_settings))
+    data = store.backend.get_blob(
+        checkpoint_blob_name(level_settings, namespace))
     if data is None:
         return None
     try:
@@ -214,7 +220,8 @@ class RestoreResult:
 
 def load_restore_state(store: ChunkStore,
                        level_settings: Sequence[LevelSetting], *,
-                       registry: Optional["Registry"] = None
+                       registry: Optional["Registry"] = None,
+                       namespace: str = ""
                        ) -> RestoreResult:
     """Startup recovery: checkpoint + index-suffix replay, or full replay.
 
@@ -226,7 +233,7 @@ def load_restore_state(store: ChunkStore,
     """
     levels = {s.level for s in level_settings}
     expected = tuple((s.level, s.max_iter) for s in level_settings)
-    ckpt = load_checkpoint(store, level_settings)
+    ckpt = load_checkpoint(store, level_settings, namespace)
     generation = 1 if ckpt is None else ckpt.generation + 1
     if ckpt is not None and (ckpt.settings != expected
                              or ckpt.index_offset > store.index_offset()):
@@ -273,7 +280,8 @@ class RecoveryManager:
     def __init__(self, store: ChunkStore, scheduler: TileScheduler, *,
                  generation: int = 1, period: float = 0.0,
                  registry: Optional["Registry"] = None,
-                 pending_keys_fn: Optional[Callable[[], set[Key]]] = None
+                 pending_keys_fn: Optional[Callable[[], set[Key]]] = None,
+                 namespace: str = ""
                  ) -> None:
         self.store = store
         self.scheduler = scheduler
@@ -281,7 +289,9 @@ class RecoveryManager:
         self.period = period
         self._registry = registry
         self._pending_keys_fn = pending_keys_fn
-        self._blob_name = checkpoint_blob_name(scheduler.level_settings)
+        self.namespace = namespace
+        self._blob_name = checkpoint_blob_name(scheduler.level_settings,
+                                               namespace)
         self._task: Optional[asyncio.Task] = None
         self._fenced = False
 
@@ -364,7 +374,8 @@ class RecoveryManager:
     def write(self, ckpt: Checkpoint) -> dict:
         """Encode + fence-check + atomic PUT; returns write stats."""
         t0 = time.monotonic()
-        stored = peek_generation(self.store, self.scheduler.level_settings)
+        stored = peek_generation(self.store, self.scheduler.level_settings,
+                                 self.namespace)
         if stored is not None and stored > ckpt.generation:
             raise StaleGenerationError(
                 f"stored checkpoint generation {stored} > ours "

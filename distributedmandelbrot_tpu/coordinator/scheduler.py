@@ -27,7 +27,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass
-from typing import TYPE_CHECKING, Optional, Sequence
+from typing import TYPE_CHECKING, Callable, Optional, Sequence
 
 from distributedmandelbrot_tpu.coordinator.clock import Clock, MonotonicClock
 from distributedmandelbrot_tpu.core.workload import LevelSetting, Workload
@@ -58,7 +58,8 @@ class TileScheduler:
                  lease_timeout: float = DEFAULT_LEASE_TIMEOUT,
                  clock: Optional[Clock] = None,
                  registry: Optional["Registry"] = None,
-                 trace: Optional["TraceLog"] = None) -> None:
+                 trace: Optional["TraceLog"] = None,
+                 owns: Optional[Callable[[Key], bool]] = None) -> None:
         if not level_settings:
             raise ValueError("at least one level setting required")
         seen_levels: set[int] = set()
@@ -80,8 +81,22 @@ class TileScheduler:
         # tick, and a full-grid rescan is O(sum level^2) at level-1000
         # scale (the rescan cost this scheduler was built to avoid,
         # Distributer.cs:335-353).
-        self._remaining = self.total_tiles - sum(
-            1 for k in self._completed if self._in_grid(k))
+        # Keyspace filter for a sharded control plane: a ring slice's
+        # ``owns`` restricts the frontier to this coordinator's keys.
+        # None (the default) is the unsharded whole-grid scheduler.
+        # The owned total is enumerated once up front so is_complete()
+        # and the frontier gauge stay O(1) — the same trade the
+        # _remaining counter already makes for resume sets.
+        self._owns = owns
+        if owns is None:
+            self._owned_tiles = self.total_tiles
+        else:
+            self._owned_tiles = sum(
+                1 for s in self.level_settings
+                for i in range(s.level) for j in range(s.level)
+                if owns((s.level, i, j)))
+        self._remaining = self._owned_tiles - sum(
+            1 for k in self._completed if self._counts(k))
         self._leases: dict[Key, Lease] = {}
         self._claims: dict[Key, tuple[int, Lease]] = {}
         self._claim_seq = 0  # claim identity; see claim()
@@ -118,11 +133,18 @@ class TileScheduler:
         return sum(s.tile_count for s in self.level_settings)
 
     @property
+    def owned_tiles(self) -> int:
+        """Tiles of the configured grid this scheduler may grant (the
+        whole grid unless a ring slice's ``owns`` filter restricts it)."""
+        return self._owned_tiles
+
+    @property
     def completed_count(self) -> int:
-        """Completed tiles of the CONFIGURED grid (resume sets may carry
-        keys from other levels; those are excluded so stats can never
-        report more tiles complete than the run has)."""
-        return self.total_tiles - self._remaining
+        """Completed tiles of the CONFIGURED (and owned) grid (resume
+        sets may carry keys from other levels or other shards' slices;
+        those are excluded so stats can never report more tiles
+        complete than this scheduler grants)."""
+        return self._owned_tiles - self._remaining
 
     @property
     def outstanding_leases(self) -> int:
@@ -147,6 +169,13 @@ class TileScheduler:
         level, i, j = key
         return level in self._levels and 0 <= i < level and 0 <= j < level
 
+    def _counts(self, key: Key) -> bool:
+        """Does ``key`` count toward _remaining?  In the configured grid
+        AND in this scheduler's owned slice — a foreign shard's key must
+        never move the completion counter it was not counted into."""
+        return self._in_grid(key) and (self._owns is None
+                                       or self._owns(key))
+
     # -- grant path -------------------------------------------------------
 
     def _workload_at(self, pos: int) -> Optional[Workload]:
@@ -161,6 +190,8 @@ class TileScheduler:
         return None
 
     def _grantable(self, w: Workload, now: float) -> bool:
+        if self._owns is not None and not self._owns(w.key):
+            return False  # another shard's key (cursor walks the grid)
         if w.key in self._completed:
             return False
         claim = self._claims.get(w.key)
@@ -260,10 +291,11 @@ class TileScheduler:
             return False
         if w.key not in self._completed:
             self._completed.add(w.key)
-            if self._in_grid(w.key):
-                # Only configured-grid tiles count toward is_complete();
-                # a foreign key slipping through the claim path must not
-                # drive _remaining negative and end the run early.
+            if self._counts(w.key):
+                # Only owned configured-grid tiles count toward
+                # is_complete(); a foreign key slipping through the claim
+                # path must not drive _remaining negative and end the run
+                # early.
                 self._remaining -= 1
         return True
 
@@ -298,7 +330,7 @@ class TileScheduler:
         A duplicate in the retry queue is harmless: grants re-check
         ``_grantable`` at pop time, so stale entries are skipped.
         """
-        if not self._in_grid(w.key):
+        if not self._counts(w.key):
             return False
         if w.key in self._completed:
             return False
@@ -313,11 +345,11 @@ class TileScheduler:
         the save errors, the result's bytes are gone and the tile must go
         back in the frontier or the run would finish with a silent hole.
         """
-        if w.key in self._completed and self._in_grid(w.key):
-            # Out-of-grid keys (foreign levels in a resume set) stay in
-            # _completed and never enter the frontier: requeueing one
-            # would let it be granted and re-completed, corrupting the
-            # _remaining counter for tiles this run doesn't render.
+        if w.key in self._completed and self._counts(w.key):
+            # Out-of-grid (and out-of-slice) keys stay in _completed and
+            # never enter the frontier: requeueing one would let it be
+            # granted and re-completed, corrupting the _remaining
+            # counter for tiles this scheduler doesn't grant.
             self._completed.discard(w.key)
             self._remaining += 1
             self._retry.append(w)
@@ -354,7 +386,7 @@ class TileScheduler:
             # save DID land (suffix replay finds it) the entry is dropped.
             max_iters = {s.level: s.max_iter for s in self.level_settings}
             for key in sorted(exclude):
-                if key in self._completed and self._in_grid(key):
+                if key in self._completed and self._counts(key):
                     level, i, j = key
                     retry.append(Workload(level, max_iters[level], i, j))
         leases: list[tuple[Workload, float]] = []

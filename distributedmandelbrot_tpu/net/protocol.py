@@ -110,6 +110,12 @@ SESSION_FLAG_RLE = 0x1  # uploads may carry WIRE_CODEC_RLE bodies
 # bit, so a batched-grant worker negotiates down to the one-list
 # FRAME_LEASE_REQ exchange with no wire change it can't parse.
 SESSION_FLAG_GRANTN = 0x2
+# Sharded control plane: the session may carry FRAME_RING_REQ /
+# FRAME_RING_INFO, and a misrouted upload may be answered with
+# FRAME_REDIRECT instead of an accept/reject ack.  A legacy (unsharded)
+# coordinator never echoes this bit, so a ring-aware worker negotiates
+# down to treating that coordinator as the sole owner of the keyspace.
+SESSION_FLAG_SHARD = 0x4
 
 # Session frame types (SESSION_FRAME.type).  Deliberately NOT named
 # ``PURPOSE_*``: frames live inside an established session, purposes
@@ -125,6 +131,17 @@ FRAME_SPANS = 0x05  # client->server: span report body; no ack
 # reply can pre-group grants into dispatch-sized batches.
 FRAME_LEASE_REQN = 0x06  # client->server: LEASE_REQN (count, batch_width)
 FRAME_LEASE_GRANTN = 0x07  # server->client: LEASE_GRANTN + grant batches
+# Sharded control plane (SESSION_FLAG_SHARD only).  A worker asks the
+# coordinator which ring slice it owns; the answer carries the ring
+# version so a worker holding a stale ring config finds out on its
+# first exchange instead of on its first misrouted upload.
+FRAME_RING_REQ = 0x08  # client->server: RING_REQ (client's ring version)
+FRAME_RING_INFO = 0x09  # server->client: RING_INFO (version, shard, n)
+# Misrouted upload answer (replaces FRAME_UPLOAD_ACK for that seq): the
+# server does not own the echoed key; the payload names the
+# authoritative shard and the server's ring version.  The worker
+# re-routes the result there — bounded by MAX_REDIRECT_HOPS.
+FRAME_REDIRECT = 0x0A  # server->client: REDIRECT (shard, ring version)
 
 # Upload result codecs (UPLOAD_HEADER.codec).  RLE reuses the storage
 # codec's body format (codecs/rle.py, code 0x01) so wire and disk agree.
@@ -139,6 +156,13 @@ QUERY_NOT_AVAILABLE = 0x02
 # or serve queue saturated).  Clients should back off and retry; the legacy
 # DataServer never emits this, so reference-protocol clients are unaffected.
 QUERY_OVERLOADED = 0x03
+# Sharded-gateway extension: this endpoint does not own the queried key.
+# The status byte is followed by a REDIRECT payload naming the
+# authoritative shard and the server's ring version — no length prefix,
+# the redirect IS fixed-size.  Legacy servers never emit this; a legacy
+# client reading it sees an unknown status byte and drops the
+# connection, the same degradation story as QUERY_OVERLOADED.
+QUERY_REDIRECT = 0x04
 
 # Gateway batched multi-tile request: a query whose first u32 is this magic
 # is a batch header (u32 count + count x 12-byte queries), not a legacy
@@ -233,9 +257,29 @@ LEASE_REQN_WIRE_SIZE = 8
 # n_tiles == 0) is the drained-coordinator reply.
 LEASE_GRANTN = struct.Struct("<II")
 LEASE_GRANTN_WIRE_SIZE = 8
+# Ring query payload (FRAME_RING_REQ): the client's ring config version
+# (0 when it has none).  The whole payload IS this struct.
+RING_REQ = struct.Struct("<I")
+RING_REQ_WIRE_SIZE = 4
+# Ring answer payload (FRAME_RING_INFO): (ring version u32, this
+# coordinator's shard index u32, shard count u32).  An unsharded
+# coordinator never sends this frame (it never echoes
+# SESSION_FLAG_SHARD); shard < n_shards always holds.
+RING_INFO = struct.Struct("<III")
+RING_INFO_WIRE_SIZE = 12
+# Redirect payload, shared by the session FRAME_REDIRECT frame and the
+# read-path QUERY_REDIRECT status tail: (authoritative shard index u32,
+# server's ring version u32).
+REDIRECT = struct.Struct("<II")
+REDIRECT_WIRE_SIZE = 8
 
 # Client frame seqs wrap at the u16 the header carries.
 MAX_SESSION_SEQ = 0xFFFF
+
+# How many redirect hops a client follows for one key before giving up.
+# Two coordinators disagreeing about ownership (a ring-version skew
+# window) could otherwise bounce a result forever.
+MAX_REDIRECT_HOPS = 4
 
 # Wire codes for span stages (names live in obs/names.py; the wire uses
 # one byte).  Order matches the worker pipeline.
@@ -301,6 +345,18 @@ def validate_session_seq(seq: int, expected: int) -> int:
         raise ProtocolError(
             f"session frame seq {seq}, expected {expected}")
     return seq
+
+
+def validate_shard(shard: int, n_shards: int) -> int:
+    """Check a wire shard index against the reader's ring size.
+
+    A redirect or ring answer naming a shard the reader's ring config
+    does not know is version skew or corruption; following it would
+    dial a socket chosen by the peer, so the exchange dies here.
+    """
+    if not 0 <= shard < n_shards:
+        raise ProtocolError(f"shard index {shard} outside [0, {n_shards})")
+    return shard
 
 
 def query_in_range(level: int, index_real: int, index_imag: int) -> bool:

@@ -157,6 +157,38 @@ COORD_GRANT_BATCHES = "coord_grant_batches"
 HIST_COORD_GRANTS_PER_BATCH = "coord_grants_per_batch"
 GAUGE_PERSIST_QUEUE_DEPTH = "coord_persist_queue_depth"
 
+# -- sharded control plane (control/ring + shard session frames) ----------
+
+# Coordinator side: ring-info exchanges served (FRAME_RING_REQ), ring
+# version skew observed (client offered a different ring version than
+# the shard is running — expected transiently during a ring rollout),
+# uploads that arrived at the wrong shard (misroutes), and the subset of
+# those answered with a FRAME_REDIRECT carrying the authoritative shard
+# (SHARD-negotiated sessions; legacy sessions get a plain REJECT ack).
+COORD_SHARD_RING_REQS = "coord_shard_ring_reqs"
+COORD_SHARD_RING_SKEW = "coord_shard_ring_skew"
+COORD_SHARD_MISROUTES = "coord_shard_misroutes"
+COORD_SHARD_REDIRECTS = "coord_shard_redirects"
+# Worker side: redirects followed (result re-submitted to the
+# authoritative shard) and submissions abandoned because the redirect
+# chain exceeded MAX_REDIRECT_HOPS (a ring split-brain signature).
+WORKER_REDIRECTS = "worker_redirects"
+WORKER_REDIRECT_LOOPS = "worker_redirect_loops"
+# Gateway side: read queries for keys this shard does not own, answered
+# with QUERY_REDIRECT + the authoritative shard.  The dataserver answers
+# misrouted raw chunk queries the same way, under its own counter.
+GATEWAY_REDIRECTS = "gateway_redirects"
+DATASERVER_REDIRECTS = "dataserver_redirects"
+
+# -- chaos suite (control-plane fault schedules) ---------------------------
+
+# Scenario runner accounting: processes killed on schedule, processes
+# restarted, grants observed across restarts, and invariant violations
+# (exactly-once/golden-parity/grant-fencing breaks — must stay 0).
+CHAOS_KILLS = "chaos_kills"
+CHAOS_RESTARTS = "chaos_restarts"
+CHAOS_INVARIANT_FAILURES = "chaos_invariant_failures"
+
 # -- store ----------------------------------------------------------------
 
 HIST_STORE_READ_SECONDS = "store_read_seconds"
@@ -255,6 +287,9 @@ OUTCOME_OVERLOADED = "overloaded"
 # rendered on this request (pixels from tier-1/store/compute).
 OUTCOME_RENDER_CACHE = "render_hit"
 OUTCOME_RENDERED = "rendered"
+# Sharded serving: the key belongs to another shard; the client was
+# pointed at the authoritative one.
+OUTCOME_REDIRECTED = "redirected"
 
 # -- loadgen (open-loop storm harness) --------------------------------------
 

@@ -101,9 +101,16 @@ class TileGateway:
                  burst: float = 256.0,
                  render_cache_tiles: int = 64,
                  counters: Optional[Counters] = None,
-                 trace: Optional[TraceLog] = None) -> None:
+                 trace: Optional[TraceLog] = None,
+                 ring_slice=None) -> None:
         self.cache = cache
         self.ondemand = ondemand
+        # Duck-typed control.ring.RingSlice (owns/owner_of/version) — the
+        # serve layer must not import the control package (cycle).  When
+        # set, queries for keys outside this shard's slice are answered
+        # with QUERY_REDIRECT + the authoritative shard instead of a read
+        # that could only miss.
+        self.ring_slice = ring_slice
         self.host = host
         self.port = port
         self.read_timeout = read_timeout
@@ -243,18 +250,36 @@ class TileGateway:
         self._write_response(writer, status, payload)
 
     def _write_response(self, writer: asyncio.StreamWriter, status: int,
-                        payload: Optional[bytes]) -> None:
+                        payload: Optional[bytes | tuple[int, int]]) -> None:
         framing.write_byte(writer, status)
-        if status == proto.QUERY_ACCEPT:
-            assert payload is not None
+        if status == proto.QUERY_REDIRECT:
+            # Fixed-size REDIRECT tail, no length prefix (net/protocol).
+            # Packed here, after the status byte, so source order mirrors
+            # wire order for the proto-frames parity check.
+            assert isinstance(payload, tuple)
+            writer.write(proto.REDIRECT.pack(*payload))
+        elif status == proto.QUERY_ACCEPT:
+            assert isinstance(payload, bytes)
             framing.write_u32(writer, len(payload))
             writer.write(payload)
+
+    def _redirect_for(self, level: int, index_real: int,
+                      index_imag: int) -> Optional[tuple[int, int]]:
+        """``(authoritative shard, ring version)`` for a key another
+        shard owns, else ``None``."""
+        if self.ring_slice is None:
+            return None
+        key = (level, index_real, index_imag)
+        if self.ring_slice.owns(key):
+            return None
+        self.counters.inc(obs_names.GATEWAY_REDIRECTS)
+        return (self.ring_slice.owner_of(key), self.ring_slice.version)
 
     # -- the serve path ---------------------------------------------------
 
     async def _resolve_admitted(
             self, level: int, index_real: int,
-            index_imag: int) -> tuple[int, Optional[bytes]]:
+            index_imag: int) -> tuple[int, Optional[bytes | tuple[int, int]]]:
         """Admission control, then resolve; returns (status, payload).
 
         One latency histogram (``gateway_request_seconds``) split by an
@@ -275,11 +300,14 @@ class TileGateway:
 
     async def _resolve_outcome(
             self, level: int, index_real: int,
-            index_imag: int) -> tuple[int, Optional[bytes], str]:
+            index_imag: int) -> tuple[int, Optional[bytes | tuple[int, int]], str]:
         self.counters.inc("gateway_queries")
         if not proto.query_in_range(level, index_real, index_imag):
             self.counters.inc("gateway_rejected")
             return proto.QUERY_REJECT, None, obs_names.OUTCOME_REJECTED
+        redirect = self._redirect_for(level, index_real, index_imag)
+        if redirect is not None:
+            return proto.QUERY_REDIRECT, redirect, obs_names.OUTCOME_REDIRECTED
         # Tier-1 hits are answered before admission: they cost no I/O and
         # no compute, so shedding them would only push load onto retries.
         entry = self.cache.get_cached((level, index_real, index_imag))
@@ -308,7 +336,7 @@ class TileGateway:
 
     async def _resolve_render(
             self, level: int, index_real: int, index_imag: int,
-            colormap_id: int) -> tuple[int, Optional[bytes]]:
+            colormap_id: int) -> tuple[int, Optional[bytes | tuple[int, int]]]:
         """Render-path twin of :meth:`_resolve_admitted`: same admission
         gates, same latency histogram (new ``outcome`` values), payload is
         a palette PNG instead of the codec body."""
@@ -326,11 +354,14 @@ class TileGateway:
 
     async def _render_outcome(
             self, level: int, index_real: int, index_imag: int,
-            colormap_id: int) -> tuple[int, Optional[bytes], str]:
+            colormap_id: int) -> tuple[int, Optional[bytes | tuple[int, int]], str]:
         self.counters.inc(obs_names.GATEWAY_RENDER_QUERIES)
         if not proto.query_in_range(level, index_real, index_imag):
             self.counters.inc("gateway_rejected")
             return proto.QUERY_REJECT, None, obs_names.OUTCOME_REJECTED
+        redirect = self._redirect_for(level, index_real, index_imag)
+        if redirect is not None:
+            return proto.QUERY_REDIRECT, redirect, obs_names.OUTCOME_REDIRECTED
         # Like tier-1 raw hits, rendered-cache hits are answered before
         # admission: a hot body is a memcpy, and the render cache is the
         # whole point under flash-crowd load.

@@ -116,9 +116,16 @@ class LocalFileBackend(StoreBackend):
     chunk and a crash leaves at worst a ``.tmp`` orphan.
     """
 
-    def __init__(self, parent_dir: str = "") -> None:
+    def __init__(self, parent_dir: str = "", *, namespace: str = "") -> None:
+        # ``namespace`` isolates the append log of one coordinator shard
+        # sharing the directory with others (``_index-s0of4.dat``); the
+        # blob namespace stays shared — ring ownership is disjoint, so
+        # shards never write the same chunk name.
         self.data_dir = os.path.join(parent_dir, DATA_DIR_NAME)
-        self.index_path = os.path.join(self.data_dir, INDEX_FILENAME)
+        self.namespace = namespace
+        index_name = INDEX_FILENAME if not namespace else \
+            f"_index{namespace}.dat"
+        self.index_path = os.path.join(self.data_dir, index_name)
 
     def describe(self) -> str:
         return self.data_dir
@@ -203,9 +210,11 @@ class LocalFileBackend(StoreBackend):
         return os.path.exists(self._path(name))
 
     def list_blobs(self) -> list[str]:
+        # Every per-shard index log is backend-internal, like
+        # INDEX_FILENAME itself — never a blob.
         return sorted(
             name for name in os.listdir(self.data_dir)
-            if name != INDEX_FILENAME and not name.endswith(".tmp"))
+            if not name.startswith("_index") and not name.endswith(".tmp"))
 
 
 # -- object-store kv fakes ------------------------------------------------
@@ -362,12 +371,19 @@ class ObjectStoreBackend(StoreBackend):
     step.  Logical offsets are cumulative bytes in that read order.
     """
 
-    def __init__(self, kv: ObjectStore, *, rotate_threshold: int = 256
-                 ) -> None:
+    def __init__(self, kv: ObjectStore, *, rotate_threshold: int = 256,
+                 namespace: str = "") -> None:
         if rotate_threshold < 1:
             raise ValueError("rotate_threshold must be >= 1")
         self.kv = kv
         self.rotate_threshold = rotate_threshold
+        # ``namespace`` isolates one shard's index log in a shared
+        # bucket (``index-s0of4/...``); blobs stay shared — ring
+        # ownership is disjoint, so shards never write the same name.
+        self.namespace = namespace
+        self._tail_prefix = f"index{namespace}/tail-"
+        self._seg_prefix = f"index{namespace}/seg-"
+        self._manifest_key = f"index{namespace}/manifest"
         # Re-entrant: append_index rotates and setup loads under the
         # lock, and both helpers take it again for their own mutations.
         self._lock = threading.RLock()
@@ -380,9 +396,8 @@ class ObjectStoreBackend(StoreBackend):
     def describe(self) -> str:
         return f"object-store:{self.kv.describe()}"
 
-    @staticmethod
-    def _tail_key(seq: int) -> str:
-        return f"{_TAIL_PREFIX}{seq:012d}"
+    def _tail_key(self, seq: int) -> str:
+        return f"{self._tail_prefix}{seq:012d}"
 
     def setup(self) -> None:
         probe_key = f"meta/_writable_probe_{os.getpid()}"
@@ -401,7 +416,7 @@ class ObjectStoreBackend(StoreBackend):
             self._sealed = []
             self._sealed_bytes = 0
             self._tail_floor = 0
-            raw = self.kv.get(_MANIFEST_KEY)
+            raw = self.kv.get(self._manifest_key)
             if raw is not None:
                 manifest = json.loads(raw.decode("utf-8"))
                 if manifest.get("format") != _MANIFEST_FORMAT:
@@ -414,8 +429,8 @@ class ObjectStoreBackend(StoreBackend):
                 self._sealed_bytes = sum(size for _, size in self._sealed)
                 self._tail_floor = int(manifest["tail_floor"])
             self._tails = []
-            for key in self.kv.list(_TAIL_PREFIX):
-                seq = int(key[len(_TAIL_PREFIX):])
+            for key in self.kv.list(self._tail_prefix):
+                seq = int(key[len(self._tail_prefix):])
                 if seq <= self._tail_floor:
                     continue  # merged into segment; deletion never finished
                 size = self.kv.size(key)
@@ -444,7 +459,7 @@ class ObjectStoreBackend(StoreBackend):
             merged = b"".join(
                 self.kv.get(self._tail_key(seq)) or b""
                 for seq, _ in self._tails)
-            seg_key = f"{_SEG_PREFIX}{len(self._sealed):08d}"
+            seg_key = f"{self._seg_prefix}{len(self._sealed):08d}"
             self.kv.put(seg_key, merged, fsync=fsync)
             sealed = self._sealed + [(seg_key, len(merged))]
             floor = self._tails[-1][0]
@@ -455,7 +470,7 @@ class ObjectStoreBackend(StoreBackend):
             # the old manifest + live tails; after it, the new segment.
             # Tail deletion is garbage collection — a crash here just
             # leaves objects the floor tells every reader to skip.
-            self.kv.put(_MANIFEST_KEY,
+            self.kv.put(self._manifest_key,
                         json.dumps(manifest, sort_keys=True).encode("utf-8"),
                         fsync=fsync)
             old_tails = self._tails

@@ -34,15 +34,24 @@ class LevelOwnedError(RuntimeError):
     """Another live coordinator already owns one of the requested levels."""
 
 
-def _lock_path(data_dir: str, level: int) -> str:
-    return os.path.join(data_dir, f"_level_{level}.lock")
+def _lock_path(data_dir: str, level: int, namespace: str = "") -> str:
+    return os.path.join(data_dir, f"_level_{level}{namespace}.lock")
 
 
 class LevelClaims:
-    """Holds flocks on the coordinator's level files; release() on stop."""
+    """Holds flocks on the coordinator's level files; release() on stop.
 
-    def __init__(self, data_dir: str, levels: list[int]) -> None:
+    ``namespace`` scopes the claim to one ring shard: N sharded
+    coordinators legitimately share every level of one data directory
+    (each owning a disjoint keyspace slice), so each claims
+    ``_level_<n>-sKofN.lock`` — exclusive against a restarted self,
+    not against its peers or against differently-sharded launches.
+    """
+
+    def __init__(self, data_dir: str, levels: list[int], *,
+                 namespace: str = "") -> None:
         self.data_dir = data_dir
+        self.namespace = namespace
         self._fds: dict[int, int] = {}
         try:
             for level in levels:
@@ -52,7 +61,7 @@ class LevelClaims:
             raise
 
     def _claim_one(self, level: int) -> None:
-        path = _lock_path(self.data_dir, level)
+        path = _lock_path(self.data_dir, level, self.namespace)
         fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
         try:
             fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)

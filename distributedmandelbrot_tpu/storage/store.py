@@ -81,12 +81,16 @@ class ChunkStore:
     def __init__(self, parent_dir: str = "", *, fsync_index: bool = False,
                  payload_cache_size: int = 64,
                  registry: Optional["Registry"] = None,
-                 backend: Optional[StoreBackend] = None) -> None:
+                 backend: Optional[StoreBackend] = None,
+                 namespace: str = "") -> None:
         # Optional latency telemetry (store_read/write_seconds); None
         # keeps the store dependency-free for scripts and tests.
         self._registry = registry
+        # ``namespace`` gives one coordinator shard a private index log
+        # inside a data dir shared with its peers; a caller supplying
+        # its own backend namespaces it there instead.
         self.backend = backend if backend is not None \
-            else LocalFileBackend(parent_dir)
+            else LocalFileBackend(parent_dir, namespace=namespace)
         # Path attributes exist only for the local layout (ownership
         # flocks, offline compaction); object-store layouts have neither.
         self.data_dir = getattr(self.backend, "data_dir", None)
@@ -188,7 +192,9 @@ class ChunkStore:
         with self._index_lock:
             self.backend.append_index(entry.to_bytes(),
                                       fsync=self._fsync_index)
-            faults.hit("store.after_index_append")
+        # Outside the lock: the entry is already durable, and a slowpoint
+        # here must not stall every other writer's append.
+        faults.hit("store.after_index_append")
         if self._registry is not None:
             self._registry.observe(obs_names.HIST_STORE_WRITE_SECONDS,
                                    time.monotonic() - t0)
@@ -228,7 +234,7 @@ class ChunkStore:
             self.backend.append_index(
                 b"".join(e.to_bytes() for e in entries),
                 fsync=self._fsync_index)
-            faults.hit("store.after_index_append")
+        faults.hit("store.after_index_append")  # see save(): post-commit
         if self._registry is not None:
             self._registry.observe(obs_names.HIST_STORE_WRITE_SECONDS,
                                    time.monotonic() - t0)

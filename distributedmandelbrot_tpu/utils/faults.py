@@ -25,15 +25,23 @@ Known points (grep for ``faults.hit`` to enumerate):
 Environment syntax: ``DMTPU_CRASHPOINTS=point[:after][,point[:after]...]``
 where ``after`` (default 1) is the 1-based hit count that fires.  Env-armed
 points always hard-exit with :data:`CRASH_EXIT_CODE`.
+
+**Slow points** reuse the same site names but inject latency instead of
+death: :func:`arm_slow` (or ``DMTPU_SLOWPOINTS=point:seconds,...``) makes
+every subsequent :func:`hit` on that point sleep — how the chaos suite
+models a persist path degraded by a slow disk without killing anything.
+Slow points are not one-shot; they stay armed until :func:`disarm_slow`.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional
 
 ENV_VAR = "DMTPU_CRASHPOINTS"
+ENV_SLOW_VAR = "DMTPU_SLOWPOINTS"
 CRASH_EXIT_CODE = 86  # distinctive; tests assert the kill was ours
 
 
@@ -44,6 +52,8 @@ class CrashPointError(RuntimeError):
 _lock = threading.Lock()
 # point -> [remaining_hits, hard_exit]
 _armed: dict[str, list] = {}
+# point -> sleep seconds (every hit, until disarmed)
+_slow: dict[str, float] = {}
 
 
 def arm(point: str, *, after: int = 1, exit: bool = False) -> None:
@@ -69,6 +79,23 @@ def armed() -> dict[str, int]:
         return {name: spec[0] for name, spec in _armed.items()}
 
 
+def arm_slow(point: str, delay: float) -> None:
+    """Make every hit on ``point`` sleep ``delay`` seconds (0 disarms)."""
+    with _lock:
+        if delay > 0:
+            _slow[point] = float(delay)
+        else:
+            _slow.pop(point, None)
+
+
+def disarm_slow(point: Optional[str] = None) -> None:
+    with _lock:
+        if point is None:
+            _slow.clear()
+        else:
+            _slow.pop(point, None)
+
+
 def hit(point: str) -> None:
     """Production-side hook: crash here iff a test armed this point.
 
@@ -76,6 +103,11 @@ def hit(point: str) -> None:
     happens strictly before the workload that should crash, never
     concurrently with it.
     """
+    if _slow:
+        with _lock:
+            delay = _slow.get(point, 0.0)
+        if delay > 0:
+            time.sleep(delay)
     if not _armed:
         return
     with _lock:
@@ -103,4 +135,16 @@ def arm_from_env(environ=os.environ) -> None:
         arm(name, after=int(count) if count else 1, exit=True)
 
 
+def arm_slow_from_env(environ=os.environ) -> None:
+    """Arm latency points from :data:`ENV_SLOW_VAR` (chaos harness)."""
+    spec = environ.get(ENV_SLOW_VAR, "")
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, secs = part.partition(":")
+        arm_slow(name, float(secs) if secs else 0.05)
+
+
 arm_from_env()
+arm_slow_from_env()

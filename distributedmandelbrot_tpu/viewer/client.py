@@ -31,6 +31,9 @@ class FetchStatus(enum.Enum):
     REJECTED = "rejected"
     # Gateway only: admission control shed the request — back off and retry.
     OVERLOADED = "overloaded"
+    # Sharded gateway only: this shard does not own the key; the
+    # authoritative shard is in :attr:`DataClient.last_redirect`.
+    REDIRECTED = "redirected"
 
 
 _STATUS_BY_BYTE = {
@@ -46,6 +49,8 @@ class DataClient:
         self.host = host
         self.port = port
         self.timeout = timeout
+        # (shard, ring_version) from the most recent REDIRECTED reply.
+        self.last_redirect: Optional[tuple[int, int]] = None
         self._sock: Optional[socket.socket] = None
 
     def _connected(self) -> socket.socket:
@@ -85,12 +90,22 @@ class DataClient:
         framing.send_all(sock, proto.QUERY.pack(level, index_real, index_imag))
         return self._read_response(sock)
 
+    def _read_redirect(self, sock: socket.socket) -> None:
+        """Consume a QUERY_REDIRECT's fixed-size tail (no length prefix)
+        and latch it in :attr:`last_redirect`."""
+        shard, ring_version = proto.REDIRECT.unpack(
+            framing.recv_exact(sock, proto.REDIRECT_WIRE_SIZE))
+        self.last_redirect = (shard, ring_version)
+
     def _read_response(self, sock: socket.socket
                        ) -> tuple[Optional[np.ndarray], FetchStatus]:
         status = framing.recv_byte(sock)
         miss = _STATUS_BY_BYTE.get(status)
         if miss is not None:
             return None, miss
+        if status == proto.QUERY_REDIRECT:
+            self._read_redirect(sock)
+            return None, FetchStatus.REDIRECTED
         if status != proto.QUERY_ACCEPT:
             raise framing.ProtocolError(f"unknown query status {status:#x}")
         # The length word sizes an allocation: bound it before trusting it
@@ -138,6 +153,9 @@ class DataClient:
         miss = _STATUS_BY_BYTE.get(status)
         if miss is not None:
             return None, miss
+        if status == proto.QUERY_REDIRECT:
+            self._read_redirect(sock)
+            return None, FetchStatus.REDIRECTED
         if status != proto.QUERY_ACCEPT:
             raise framing.ProtocolError(f"unknown query status {status:#x}")
         length = proto.validate_payload_length(framing.recv_u32(sock))
@@ -170,3 +188,67 @@ class DataClient:
             request += proto.QUERY.pack(level, index_real, index_imag)
         framing.send_all(sock, bytes(request))
         return [self._read_response(sock) for _ in queries]
+
+
+class ShardedDataClient:
+    """Ring-aware read fan-out: one :class:`DataClient` per shard.
+
+    Consults the ring before dispatch, so the common case is a direct
+    hit on the authoritative shard; a ``REDIRECTED`` reply (version
+    skew: the serving fleet runs a different ring) is chased up to
+    :data:`~distributedmandelbrot_tpu.net.protocol.MAX_REDIRECT_HOPS`
+    times before surfacing as ``REDIRECTED`` to the caller.
+
+    ``ring`` is duck-typed (``shards``, ``owner_of(key)``) — hand it a
+    ``control.ring.HashRing``.  ``use_gateway`` picks each shard's
+    gateway port when the ring names one (falling back per shard to the
+    legacy dataserver port, which never redirects — ring routing alone
+    lands those queries on the right index).
+    """
+
+    def __init__(self, ring, *, timeout: Optional[float] = 30.0,
+                 use_gateway: bool = True) -> None:
+        self.ring = ring
+        self.clients = []
+        for s in ring.shards:
+            port = s.gateway_port if use_gateway and s.gateway_port \
+                else s.dataserver_port
+            self.clients.append(DataClient(s.host, port, timeout=timeout))
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()
+
+    def __enter__(self) -> "ShardedDataClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def fetch(self, level: int, index_real: int, index_imag: int
+              ) -> tuple[Optional[np.ndarray], FetchStatus]:
+        return self._route(level, index_real, index_imag,
+                           lambda c: c.fetch(level, index_real, index_imag))
+
+    def fetch_render(self, level: int, index_real: int, index_imag: int,
+                     colormap_id: int = proto.COLORMAP_JET
+                     ) -> tuple[Optional[bytes], FetchStatus]:
+        return self._route(
+            level, index_real, index_imag,
+            lambda c: c.fetch_render(level, index_real, index_imag,
+                                     colormap_id))
+
+    def _route(self, level: int, index_real: int, index_imag: int, op):
+        shard = self.ring.owner_of((level, index_real, index_imag))
+        result = None
+        for _ in range(proto.MAX_REDIRECT_HOPS + 1):
+            client = self.clients[shard]
+            result = op(client)
+            if result[1] is not FetchStatus.REDIRECTED:
+                return result
+            assert client.last_redirect is not None
+            nxt = client.last_redirect[0]
+            if not 0 <= nxt < len(self.clients) or nxt == shard:
+                break  # split-brain ring: don't chase a self-redirect
+            shard = nxt
+        return result

@@ -257,7 +257,7 @@ class DistributerSession:
     def __init__(self, host: str, port: int, *,
                  timeout: Optional[float] = 30.0,
                  compress: bool = True, grantn: bool = True,
-                 counters=None) -> None:
+                 shard: bool = False, counters=None) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
@@ -266,8 +266,15 @@ class DistributerSession:
         # a legacy one-grant coordinator negotiates the bit away and
         # request_batchn transparently degrades to request_batch.
         self.grantn_wanted = grantn
+        # Sharded control plane (FRAME_RING_REQ / FRAME_REDIRECT):
+        # against a pre-shard coordinator the bit negotiates away and
+        # misrouted uploads come back as plain REJECT acks.
+        self.shard_wanted = shard
         self.counters = counters
         self.flags = 0  # negotiated capability bits after connect()
+        # result index -> authoritative shard, from the REDIRECT acks of
+        # the last submit_pipelined (SESSION_FLAG_SHARD sessions only).
+        self.last_redirects: dict[int, int] = {}
         self._sock: Optional[socket.socket] = None
         self._seq = 0
         self._codec = RleCodec()
@@ -301,7 +308,8 @@ class DistributerSession:
 
     def _hello(self, sock: socket.socket) -> bool:
         want = (proto.SESSION_FLAG_RLE if self.compress_wanted else 0) \
-            | (proto.SESSION_FLAG_GRANTN if self.grantn_wanted else 0)
+            | (proto.SESSION_FLAG_GRANTN if self.grantn_wanted else 0) \
+            | (proto.SESSION_FLAG_SHARD if self.shard_wanted else 0)
         framing.send_byte(sock, proto.PURPOSE_SESSION)
         framing.send_all(sock, proto.SESSION_HELLO.pack(want))
         try:
@@ -343,14 +351,21 @@ class DistributerSession:
 
     def _recv_frame_header(self, want_type: int, want_seq: int) -> int:
         """Validated payload length of the expected reply frame."""
+        _, length = self._recv_frame_header_any((want_type,), want_seq)
+        return length
+
+    def _recv_frame_header_any(self, want_types: Sequence[int],
+                               want_seq: int) -> tuple[int, int]:
+        """(frame_type, payload length) when the reply may legally be
+        one of several frames (an upload ack or its REDIRECT stand-in)."""
         frame_type, seq, length = proto.SESSION_FRAME.unpack(
             framing.recv_exact(self._sock, proto.SESSION_FRAME_WIRE_SIZE))
-        if frame_type != want_type:
+        if frame_type not in want_types:
             raise framing.ProtocolError(
                 f"unexpected session frame type {frame_type:#x} "
-                f"(wanted {want_type:#x})")
+                f"(wanted one of {[f'{t:#x}' for t in want_types]})")
         proto.validate_session_seq(seq, want_seq)
-        return proto.validate_payload_length(length)
+        return frame_type, proto.validate_payload_length(length)
 
     def _recv_grants(self, length: int, bound: int) -> list[Workload]:
         """Grant list payload: u32 n + n workloads, cross-checked
@@ -430,6 +445,28 @@ class DistributerSession:
         self._inc(obs_names.WORKER_WIRE_RTTS)
         return grants
 
+    def ring_info(self, client_version: int = 0) -> tuple[int, int, int]:
+        """One ring exchange: ``(ring_version, shard, n_shards)`` of the
+        peer.  ``client_version`` is the version of the config this
+        worker loaded — the coordinator counts a mismatch as skew, the
+        worker's cue to reload ``ring.json``.  Requires a session that
+        negotiated ``SESSION_FLAG_SHARD``."""
+        if not self.flags & proto.SESSION_FLAG_SHARD:
+            raise framing.ProtocolError(
+                "ring exchange on a session without SESSION_FLAG_SHARD")
+        seq = self._send_frame(proto.FRAME_RING_REQ, [
+            proto.RING_REQ.pack(client_version)])
+        length = self._recv_frame_header(proto.FRAME_RING_INFO, seq)
+        if length != proto.RING_INFO_WIRE_SIZE:
+            raise framing.ProtocolError(
+                f"ring info frame length {length} != "
+                f"{proto.RING_INFO_WIRE_SIZE}")
+        version, shard, n_shards = proto.RING_INFO.unpack(
+            framing.recv_exact(self._sock, proto.RING_INFO_WIRE_SIZE))
+        proto.validate_shard(shard, n_shards)
+        self._inc(obs_names.WORKER_WIRE_RTTS)
+        return version, shard, n_shards
+
     def submit_pipelined(self, results: Sequence[tuple[Workload, np.ndarray]],
                          want_lease: int = 0
                          ) -> tuple[list[bool], list[Workload]]:
@@ -439,9 +476,15 @@ class DistributerSession:
         costs one round trip; the last upload asks its ack to piggyback
         up to ``want_lease`` fresh grants, which replaces the separate
         lease round trip in steady state.
+
+        On a ``SESSION_FLAG_SHARD`` session a misrouted result's ack is
+        a ``FRAME_REDIRECT`` naming the authoritative shard: the item
+        reads as not-accepted and lands in :attr:`last_redirects` for
+        the caller (the multi-homed session group) to re-route.
         """
         if not results:
             return [], []
+        self.last_redirects = {}
         seqs = []
         for i, (w, pixels) in enumerate(results):
             body, codec = self._encode_body(pixels)
@@ -450,8 +493,23 @@ class DistributerSession:
                 w.to_wire(), proto.UPLOAD_HEADER.pack(codec, want), body]))
         accepted: list[bool] = []
         grants: list[Workload] = []
-        for seq in seqs:
-            length = self._recv_frame_header(proto.FRAME_UPLOAD_ACK, seq)
+        ack_types = (proto.FRAME_UPLOAD_ACK, proto.FRAME_REDIRECT) \
+            if self.flags & proto.SESSION_FLAG_SHARD \
+            else (proto.FRAME_UPLOAD_ACK,)
+        for i, seq in enumerate(seqs):
+            frame_type, length = self._recv_frame_header_any(ack_types, seq)
+            if frame_type == proto.FRAME_REDIRECT:
+                if length != proto.REDIRECT_WIRE_SIZE:
+                    raise framing.ProtocolError(
+                        f"redirect frame length {length} != "
+                        f"{proto.REDIRECT_WIRE_SIZE}")
+                owner, _ring_version = proto.REDIRECT.unpack(
+                    framing.recv_exact(self._sock,
+                                       proto.REDIRECT_WIRE_SIZE))
+                self.last_redirects[i] = owner
+                self._inc(obs_names.WORKER_REDIRECTS)
+                accepted.append(False)
+                continue
             flag = framing.recv_byte(self._sock)
             if flag not in (proto.RESPONSE_ACCEPT, proto.RESPONSE_REJECT):
                 raise framing.ProtocolError(
@@ -490,3 +548,170 @@ class DistributerSession:
                     return body, proto.WIRE_CODEC_RLE
         self._inc(obs_names.WIRE_RAW_BYTES, len(data))
         return data, proto.WIRE_CODEC_RAW
+
+
+class ShardedSessionGroup:
+    """Multi-homed session: one :class:`DistributerSession` per shard.
+
+    Satisfies the pipeline's duck-typed session contract (connect /
+    close / connected / flags / request_batch / request_batchn /
+    submit_pipelined / push_spans), so a ``session_factory`` returning
+    one of these multi-homes every lane with zero pipeline changes.
+
+    Routing policy: lease prefetch round-robins REQN across shards (the
+    first shard with grants answers; a run is dry only when every shard
+    is), uploads are grouped by the ring owner of each key, and a
+    ``FRAME_REDIRECT`` ack re-routes its result to the authoritative
+    shard with a :data:`~distributedmandelbrot_tpu.net.protocol
+    .MAX_REDIRECT_HOPS` budget — an exceeded budget (or a shard
+    redirecting to itself) is a ring split-brain signature, counted in
+    ``worker_redirect_loops`` and surfaced as a rejected result rather
+    than an infinite loop.
+
+    ``ring`` is duck-typed (``shards`` with host/distributer_port,
+    ``owner_of(key)``, ``version``) so this module never imports the
+    control package; callers hand it a ``control.ring.HashRing``.
+    """
+
+    def __init__(self, ring, *, timeout: Optional[float] = 30.0,
+                 compress: bool = True, grantn: bool = True,
+                 counters=None) -> None:
+        self.ring = ring
+        self.counters = counters
+        self.sessions = [
+            DistributerSession(s.host, s.distributer_port, timeout=timeout,
+                               compress=compress, grantn=grantn, shard=True,
+                               counters=counters)
+            for s in ring.shards]
+        self._rr = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def connected(self) -> bool:
+        return bool(self.sessions) \
+            and all(s.connected for s in self.sessions)
+
+    @property
+    def flags(self) -> int:
+        """Intersection of the per-shard negotiated bits: a capability
+        is usable group-wide only when every shard speaks it."""
+        flags = self.sessions[0].flags if self.sessions else 0
+        for s in self.sessions[1:]:
+            flags &= s.flags
+        return flags
+
+    def connect(self) -> bool:
+        """Dial every shard; all-or-nothing (one legacy shard that
+        declines the session hello fails the group — the caller falls
+        back to its connection-per-exchange client)."""
+        for s in self.sessions:
+            if not s.connect():
+                self.close()
+                return False
+        # Skew probe: one ring exchange per SHARD-negotiated session.
+        # A shard still speaking the pre-shard protocol negotiated the
+        # bit away; key-routing still lands its uploads correctly.
+        for s in self.sessions:
+            if s.flags & proto.SESSION_FLAG_SHARD:
+                s.ring_info(getattr(self.ring, "version", 0))
+        return True
+
+    def close(self) -> None:
+        for s in self.sessions:
+            s.close()
+
+    def _inc(self, name: str, n: int = 1) -> None:
+        if self.counters is not None:
+            self.counters.inc(name, n)
+
+    # -- lease prefetch ----------------------------------------------------
+
+    def _rotate(self, op: Callable[["DistributerSession"], list[Workload]]
+                ) -> list[Workload]:
+        n = len(self.sessions)
+        for k in range(n):
+            s = self.sessions[(self._rr + k) % n]
+            grants = op(s)
+            if grants:
+                self._rr = (self._rr + k + 1) % n
+                return grants
+        self._rr = (self._rr + 1) % n
+        return []
+
+    def request_batch(self, max_count: int) -> list[Workload]:
+        return self._rotate(lambda s: s.request_batch(max_count))
+
+    def request_batchn(self, max_count: int,
+                       batch_width: int = 0) -> list[Workload]:
+        return self._rotate(
+            lambda s: s.request_batchn(max_count, batch_width))
+
+    def request(self) -> Optional[Workload]:
+        grants = self.request_batch(1)
+        return grants[0] if grants else None
+
+    # -- uploads -----------------------------------------------------------
+
+    def submit_pipelined(self, results: Sequence[tuple[Workload, np.ndarray]],
+                         want_lease: int = 0
+                         ) -> tuple[list[bool], list[Workload]]:
+        """Route each result to the shard the ring says owns its key;
+        accept flags come back in request order, and the piggybacked
+        lease ask rides the last group (grants from any shard feed the
+        same pipeline window)."""
+        if not results:
+            return [], []
+        groups: dict[int, list[int]] = {}
+        for i, (w, _) in enumerate(results):
+            groups.setdefault(self.ring.owner_of(w.key), []).append(i)
+        accepted = [False] * len(results)
+        grants: list[Workload] = []
+        items = list(groups.items())
+        for gi, (shard, idxs) in enumerate(items):
+            want = want_lease if gi == len(items) - 1 else 0
+            acc, g = self._submit_to(shard, [results[i] for i in idxs],
+                                     want, proto.MAX_REDIRECT_HOPS)
+            grants.extend(g)
+            for i, ok in zip(idxs, acc):
+                accepted[i] = ok
+        return accepted, grants
+
+    def _submit_to(self, shard: int, items, want_lease: int,
+                   hops: int) -> tuple[list[bool], list[Workload]]:
+        if not 0 <= shard < len(self.sessions):
+            raise framing.ProtocolError(
+                f"redirect names shard {shard} outside the "
+                f"{len(self.sessions)}-shard ring")
+        session = self.sessions[shard]
+        accepted, grants = session.submit_pipelined(items,
+                                                    want_lease=want_lease)
+        redirects = dict(session.last_redirects)
+        if not redirects:
+            return accepted, grants
+        if hops <= 0:
+            self._inc(obs_names.WORKER_REDIRECT_LOOPS, len(redirects))
+            return accepted, grants
+        by_owner: dict[int, list[int]] = {}
+        for i, owner in redirects.items():
+            if owner == shard:
+                # Redirected back at the shard that just refused it:
+                # a split-brain ring, not a routing error to chase.
+                self._inc(obs_names.WORKER_REDIRECT_LOOPS)
+                continue
+            by_owner.setdefault(owner, []).append(i)
+        for owner, idxs in by_owner.items():
+            sub_acc, sub_g = self._submit_to(
+                owner, [items[i] for i in idxs], 0, hops - 1)
+            grants.extend(sub_g)
+            for i, ok in zip(idxs, sub_acc):
+                accepted[i] = ok
+        return accepted, grants
+
+    # -- spans -------------------------------------------------------------
+
+    def push_spans(self, worker_id: int, syncs, spans) -> bool:
+        """Fire-and-forget on the cursor shard's socket — span reports
+        are advisory, any shard's SpanStore is an acceptable sink."""
+        return self.sessions[self._rr % len(self.sessions)].push_spans(
+            worker_id, syncs, spans)

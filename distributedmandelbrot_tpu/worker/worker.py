@@ -47,7 +47,8 @@ class Worker:
                  window: int = 0, depth: int = 2,
                  upload_lanes: int = 0, batch_tiles: int = 0,
                  grant_batch: int = 0,
-                 use_session: bool = True) -> None:
+                 use_session: bool = True,
+                 ring=None) -> None:
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
         if window < 0:
@@ -78,6 +79,12 @@ class Worker:
         # the fusion width × device count); pipelined path only.
         self.grant_batch = grant_batch
         self.use_session = use_session
+        # Sharded control plane: a duck-typed control.ring.HashRing
+        # multi-homes every pipeline lane via ShardedSessionGroup (one
+        # session per shard, leases round-robined, uploads routed by
+        # key).  Ring mode is a pipelined-session feature: the classic
+        # run_once path keeps talking to ``client`` alone.
+        self.ring = ring
         self.counters = counters if counters is not None else Counters()
         self.registry = self.counters.registry
         # A client constructed without its own counters adopts the
@@ -214,13 +221,22 @@ class Worker:
         test double without an address."""
         if not self.use_session:
             return None
+        timeout = getattr(self.client, "timeout", 30.0)
+        if self.ring is not None:
+            from distributedmandelbrot_tpu.worker.client import \
+                ShardedSessionGroup
+            ring = self.ring
+
+            def make_group() -> ShardedSessionGroup:
+                return ShardedSessionGroup(ring, timeout=timeout,
+                                           counters=self.counters)
+            return make_group
         host = getattr(self.client, "host", None)
         port = getattr(self.client, "port", None)
         if host is None or port is None:
             return None
         from distributedmandelbrot_tpu.worker.client import \
             DistributerSession
-        timeout = getattr(self.client, "timeout", 30.0)
 
         def make() -> DistributerSession:
             return DistributerSession(host, port, timeout=timeout,

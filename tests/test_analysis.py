@@ -1007,6 +1007,95 @@ def test_proto_grantn_exchange_skipped_when_one_side_absent():
     assert findings_for(one_sided, "proto-frames") == []
 
 
+# The ring exchange (SESSION_EXCHANGES entry "ring_req"): the sharded
+# control plane's skew probe, another exchange inside the session
+# stream — RING_REQ (the client's ring version) out, RING_INFO (the
+# authoritative version + slice identity) back.
+RING_PROTO_SRC = '''
+import struct
+
+SESSION_FRAME = struct.Struct("<BHI")
+SESSION_FRAME_WIRE_SIZE = SESSION_FRAME.size
+RING_REQ = struct.Struct("<I")
+RING_REQ_WIRE_SIZE = RING_REQ.size
+RING_INFO = struct.Struct("<III")
+RING_INFO_WIRE_SIZE = RING_INFO.size
+REDIRECT = struct.Struct("<II")
+REDIRECT_WIRE_SIZE = REDIRECT.size
+'''
+
+RING_CLIENT_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import recv_exact, send_all
+
+class DistributerSession:
+    def ring_info(self, sock, client_version):
+        send_all(sock, proto.SESSION_FRAME.pack(0x08, 0,
+                                                proto.RING_REQ_WIRE_SIZE))
+        send_all(sock, proto.RING_REQ.pack(client_version))
+        hdr = recv_exact(sock, proto.SESSION_FRAME_WIRE_SIZE)
+        raw = recv_exact(sock, proto.RING_INFO_WIRE_SIZE)
+        return proto.RING_INFO.unpack(raw)
+'''
+
+RING_SERVER_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import read_exact
+
+class Distributer:
+    async def _session_ring_req(self, reader, writer, seq):
+        raw = await read_exact(reader, proto.RING_REQ_WIRE_SIZE)
+        (client_version,) = proto.RING_REQ.unpack(raw)
+        writer.write(proto.SESSION_FRAME.pack(0x09, seq,
+                                              proto.RING_INFO_WIRE_SIZE))
+        writer.write(proto.RING_INFO.pack(1, 0, 1))
+'''
+
+RING_SOURCES = {PROTO_MOD: RING_PROTO_SRC,
+                PROTO_CLIENT: RING_CLIENT_SRC,
+                PROTO_SERVER: RING_SERVER_SRC}
+
+
+def test_proto_ring_exchange_clean_when_sequences_match():
+    for rule in ("proto-frames", "proto-exact-read"):
+        assert findings_for(RING_SOURCES, rule) == []
+
+
+def test_proto_ring_exchange_fires_when_server_answers_redirect():
+    # Version-skew drift: a coordinator answering the skew probe with a
+    # REDIRECT payload (8 bytes) where the client awaits RING_INFO (12)
+    # must be caught as a sequence mismatch.
+    skewed = dict(RING_SOURCES)
+    skewed[PROTO_SERVER] = RING_SERVER_SRC.replace(
+        "proto.RING_INFO.pack(1, 0, 1)", "proto.REDIRECT.pack(0, 1)")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "ring_req" in found[0].message
+    assert "client awaits [RING_INFO]" in found[0].message
+    assert "server writes [REDIRECT]" in found[0].message
+
+
+def test_proto_ring_exchange_fires_when_client_sends_wrong_struct():
+    # A client pushing a REDIRECT body (8 bytes) into the 4-byte
+    # RING_REQ slot — the misroute-chasing code path leaking into the
+    # skew probe.
+    skewed = dict(RING_SOURCES)
+    skewed[PROTO_CLIENT] = RING_CLIENT_SRC.replace(
+        "proto.RING_REQ.pack(client_version)",
+        "proto.REDIRECT.pack(client_version, 0)")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "ring_req" in found[0].message
+    assert "client sends [REDIRECT]" in found[0].message
+    assert "server reads [RING_REQ]" in found[0].message
+
+
+def test_proto_ring_exchange_skipped_when_one_side_absent():
+    one_sided = {PROTO_MOD: RING_PROTO_SRC,
+                 PROTO_SERVER: RING_SERVER_SRC}
+    assert findings_for(one_sided, "proto-frames") == []
+
+
 # -- res -------------------------------------------------------------------
 
 def test_res_thread_join_fires_on_unjoined_handleless_thread():

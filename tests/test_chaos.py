@@ -1,0 +1,90 @@
+"""Unit tests for the chaos scenario plumbing (chaos/runner.py).
+
+These cover the pure, jax-free surface: the scenario catalogue, target
+validation, the runner's expected-grid / per-shard ownership
+precompute, and the report serialisation.  The live kill-schedule runs
+(`dmtpu chaos`) are exercised by the CI smoke and the slow suite, not
+here.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from distributedmandelbrot_tpu.chaos.runner import (ChaosReport,
+                                                    ChaosRunner, KillEvent,
+                                                    SCENARIOS, Scenario,
+                                                    run_scenario)
+
+
+def test_catalogue_is_sane():
+    assert {"coord-kill", "coord-crashpoint", "worker-churn",
+            "slow-persist", "storm"} <= set(SCENARIOS)
+    for name, sc in SCENARIOS.items():
+        assert sc.name == name
+        assert sc.description
+        assert sc.n_shards >= 1 and sc.n_workers >= 1
+        assert sc.deadline > 0
+        # Every scheduled kill and crashpoint must name a slot the farm
+        # actually has — ChaosRunner validates this at construction, so
+        # a bad catalogue entry fails here instead of mid-run.
+        ChaosRunner(sc)
+
+
+def test_scenario_replace_plumbing():
+    sc = dataclasses.replace(SCENARIOS["coord-kill"], n_workers=1,
+                             levels="3:2", parity_samples=1)
+    assert sc.n_workers == 1
+    assert SCENARIOS["coord-kill"].n_workers == 2  # catalogue untouched
+    runner = ChaosRunner(sc)
+    assert len(runner.workers) == 1
+    assert len(runner.expected) == 9
+
+
+def test_runner_precomputes_owned_partition():
+    runner = ChaosRunner(Scenario(name="t", levels="4:2", n_shards=3))
+    assert runner.expected == {(4, i, j)
+                               for i in range(4) for j in range(4)}
+    # owned_expected is a partition of the grid by ring owner.
+    assert set().union(*runner.owned_expected) == runner.expected
+    total = sum(len(s) for s in runner.owned_expected)
+    assert total == len(runner.expected)
+    for shard, keys in enumerate(runner.owned_expected):
+        assert all(runner.ring.owner_of(k) == shard for k in keys)
+
+
+def test_runner_rejects_bad_targets():
+    with pytest.raises(ValueError):
+        ChaosRunner(Scenario(name="t", n_shards=2,
+                             kills=(KillEvent(1.0, "coord:2"),)))
+    with pytest.raises(ValueError):
+        ChaosRunner(Scenario(name="t",
+                             kills=(KillEvent(1.0, "gateway:0"),)))
+    with pytest.raises(ValueError):
+        ChaosRunner(Scenario(name="t",
+                             kills=(KillEvent(1.0, "coord:x"),)))
+    with pytest.raises(ValueError):
+        # Crashpoints ride DMTPU_CRASHPOINTS in the coordinator env;
+        # a worker target would silently never fire.
+        ChaosRunner(Scenario(name="t",
+                             crashpoints={"worker:0": "x:1"}))
+
+
+def test_report_to_json_round_trips():
+    report = ChaosReport(
+        scenario="coord-kill", ok=False, duration_s=12.3,
+        expected_tiles=9, tiles_on_disk=8, duplicate_entries=0,
+        misowned_entries=0, parity_checked=2, parity_failures=0,
+        kills=1, restarts=1, restart_to_first_grant_s=[0.42],
+        failures=["1 tiles never completed (first: (3, 0, 0))"])
+    doc = json.loads(report.to_json())
+    assert doc["scenario"] == "coord-kill"
+    assert doc["ok"] is False
+    assert doc["restart_to_first_grant_s"] == [0.42]
+    assert doc["failures"]
+
+
+def test_run_scenario_rejects_unknown_name():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        run_scenario("does-not-exist")

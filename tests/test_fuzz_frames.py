@@ -440,6 +440,294 @@ def test_client_rejects_truncated_batched_grant_tail():
         t.join(timeout=10)
 
 
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    data = b""
+    while len(data) < n:
+        more = sock.recv(n - len(data))
+        if not more:
+            raise ConnectionError(f"peer closed after {len(data)}/{n} bytes")
+        data += more
+    return data
+
+
+def _shard_farm(tmp_path, level: int = 4):
+    """A 2-shard ring with a live coordinator serving shard 0's slice."""
+    from distributedmandelbrot_tpu.control.ring import HashRing
+
+    ring = HashRing.local(2)
+    farm = CoordinatorHarness(str(tmp_path), [LevelSetting(level, MAX_ITER)],
+                              exporter=False, ring_slice=ring.slice(0))
+    return ring, farm
+
+
+def test_session_ring_exchange_counts_skew_and_rejects_malformed(tmp_path):
+    """The ring-exchange fuzz corpus: a stale client version is counted
+    as skew but still answered (the reply IS the correction); every
+    protocol violation drops the session, bumps COORD_FRAMES_REJECTED,
+    and leaves the loop serving."""
+    ring, farm = _shard_farm(tmp_path)
+    with farm:
+        want = proto.SESSION_FLAG_RLE | proto.SESSION_FLAG_SHARD
+
+        # Well-formed exchange, matching version: RING_INFO, no skew.
+        with _dial(farm.distributer_port) as sock:
+            flags = _session_hello(sock, want)
+            assert flags & proto.SESSION_FLAG_SHARD
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_RING_REQ, 0, proto.RING_REQ_WIRE_SIZE)
+                + proto.RING_REQ.pack(ring.version))
+            frame_type, seq, length = proto.SESSION_FRAME.unpack(
+                _recv_exact(sock, proto.SESSION_FRAME_WIRE_SIZE))
+            assert (frame_type, seq, length) == (
+                proto.FRAME_RING_INFO, 0, proto.RING_INFO_WIRE_SIZE)
+            assert proto.RING_INFO.unpack(
+                _recv_exact(sock, proto.RING_INFO_WIRE_SIZE)) \
+                == (ring.version, 0, 2)
+
+            # Wrong ring version on the same session: answered (with the
+            # authoritative version), but counted as skew.
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_RING_REQ, 1, proto.RING_REQ_WIRE_SIZE)
+                + proto.RING_REQ.pack(99))
+            frame_type, seq, length = proto.SESSION_FRAME.unpack(
+                _recv_exact(sock, proto.SESSION_FRAME_WIRE_SIZE))
+            assert frame_type == proto.FRAME_RING_INFO and seq == 1
+            version, shard, n_shards = proto.RING_INFO.unpack(
+                _recv_exact(sock, proto.RING_INFO_WIRE_SIZE))
+            assert version == ring.version  # the correction, not an echo
+        assert farm.counters.get(obs_names.COORD_SHARD_RING_REQS) == 2
+        assert farm.counters.get(obs_names.COORD_SHARD_RING_SKEW) == 1
+        rejected = 0
+
+        # Ring request on a session that never negotiated sharding.
+        with _dial(farm.distributer_port) as sock:
+            flags = _session_hello(sock)  # RLE only
+            assert not flags & proto.SESSION_FLAG_SHARD
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_RING_REQ, 0, proto.RING_REQ_WIRE_SIZE)
+                + proto.RING_REQ.pack(ring.version))
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Wrong declared frame length for a ring request.
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock, want)
+            sock.sendall(proto.SESSION_FRAME.pack(proto.FRAME_RING_REQ,
+                                                  0, 2) + b"\x00\x00")
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+        # Truncated ring request: 2 of 4 payload bytes, then close.
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock, want)
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_RING_REQ, 0, proto.RING_REQ_WIRE_SIZE)
+                + b"\x00\x00")
+        rejected = _wait_counter(farm, obs_names.COORD_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_distributer_alive(farm)
+
+
+def test_session_misrouted_upload_draws_redirect_not_accept(tmp_path):
+    """A key outside this shard's slice: a SHARD session's upload is
+    answered with FRAME_REDIRECT naming the authoritative shard (and the
+    session survives); a down-negotiated session gets a plain REJECT
+    ack.  Either way the misroute is counted and nothing is stored."""
+    ring, farm = _shard_farm(tmp_path)
+    with farm:
+        foreign = next(Workload(4, MAX_ITER, i, j)
+                       for i in range(4) for j in range(4)
+                       if ring.owner_of((4, i, j)) == 1)
+
+        with _dial(farm.distributer_port) as sock:
+            _session_hello(sock,
+                           proto.SESSION_FLAG_RLE | proto.SESSION_FLAG_SHARD)
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_UPLOAD, 0,
+                16 + proto.UPLOAD_HEADER_WIRE_SIZE + CHUNK_PIXELS))
+            sock.sendall(foreign.to_wire()
+                         + proto.UPLOAD_HEADER.pack(proto.WIRE_CODEC_RAW, 0))
+            sock.sendall(b"\x00" * CHUNK_PIXELS)
+            frame_type, seq, length = proto.SESSION_FRAME.unpack(
+                _recv_exact(sock, proto.SESSION_FRAME_WIRE_SIZE))
+            assert (frame_type, seq, length) == (
+                proto.FRAME_REDIRECT, 0, proto.REDIRECT_WIRE_SIZE)
+            owner, version = proto.REDIRECT.unpack(
+                _recv_exact(sock, proto.REDIRECT_WIRE_SIZE))
+            assert owner == 1 and version == ring.version
+            # The redirect is an ack, not a drop: the same session still
+            # serves a lease request afterwards.
+            sock.sendall(proto.SESSION_FRAME.pack(proto.FRAME_LEASE_REQ,
+                                                  1, 4) + U32.pack(1))
+            frame_type, seq, _ = proto.SESSION_FRAME.unpack(
+                _recv_exact(sock, proto.SESSION_FRAME_WIRE_SIZE))
+            assert frame_type == proto.FRAME_LEASE_GRANT and seq == 1
+        assert farm.counters.get(obs_names.COORD_SHARD_MISROUTES) == 1
+        assert farm.counters.get(obs_names.COORD_SHARD_REDIRECTS) == 1
+
+        # A legacy (down-negotiated) session can't be redirected — the
+        # misroute draws an in-band REJECT ack instead of an accept.
+        with _dial(farm.distributer_port) as sock:
+            flags = _session_hello(sock)  # RLE only, no SHARD
+            assert not flags & proto.SESSION_FLAG_SHARD
+            sock.sendall(proto.SESSION_FRAME.pack(
+                proto.FRAME_UPLOAD, 0,
+                16 + proto.UPLOAD_HEADER_WIRE_SIZE + CHUNK_PIXELS))
+            sock.sendall(foreign.to_wire()
+                         + proto.UPLOAD_HEADER.pack(proto.WIRE_CODEC_RAW, 0))
+            sock.sendall(b"\x00" * CHUNK_PIXELS)
+            frame_type, seq, _ = proto.SESSION_FRAME.unpack(
+                _recv_exact(sock, proto.SESSION_FRAME_WIRE_SIZE))
+            assert frame_type == proto.FRAME_UPLOAD_ACK and seq == 0
+            assert _recv_exact(sock, 1)[0] == proto.RESPONSE_REJECT
+        assert farm.counters.get(obs_names.COORD_SHARD_MISROUTES) == 2
+        _wait_counter(farm, obs_names.COORD_RESULTS_REJECTED, 1)
+        assert farm.scheduler.completed_count == 0
+        _assert_distributer_alive(farm)
+
+
+class _StubRing:
+    """Duck-typed ring for client-side redirect fuzzing: every key is
+    owned by shard ``owner``, endpoints are the fake servers'."""
+
+    version = 1
+
+    def __init__(self, ports, owner: int = 0) -> None:
+        class _S:
+            def __init__(self, port: int) -> None:
+                self.host = "127.0.0.1"
+                self.distributer_port = port
+        self.shards = [_S(p) for p in ports]
+        self._owner = owner
+
+    def owner_of(self, key) -> int:
+        return self._owner
+
+
+def _fake_shard_server(shard: int, n_shards: int, redirect_to: int,
+                       truncate: bool = False):
+    """One-connection fake coordinator: negotiates SHARD, answers ring
+    requests honestly, and answers EVERY upload with a REDIRECT to
+    ``redirect_to`` (truncated mid-payload when ``truncate``)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def serve() -> None:
+        conn, _ = srv.accept()
+        with conn:
+            try:
+                hello = _recv_exact(conn, 1 + proto.SESSION_HELLO_WIRE_SIZE)
+                (offered,) = proto.SESSION_HELLO.unpack(hello[1:])
+                conn.sendall(bytes([proto.SESSION_ACCEPT])
+                             + proto.SESSION_HELLO.pack(
+                                 offered & proto.SESSION_FLAG_SHARD))
+                while True:
+                    hdr = _recv_exact(conn,
+                                      proto.SESSION_FRAME_WIRE_SIZE)
+                    frame_type, seq, length = proto.SESSION_FRAME.unpack(
+                        hdr)
+                    _recv_exact(conn, length)
+                    if frame_type == proto.FRAME_RING_REQ:
+                        conn.sendall(proto.SESSION_FRAME.pack(
+                            proto.FRAME_RING_INFO, seq,
+                            proto.RING_INFO_WIRE_SIZE)
+                            + proto.RING_INFO.pack(1, shard, n_shards))
+                    elif frame_type == proto.FRAME_UPLOAD:
+                        redirect = proto.REDIRECT.pack(redirect_to, 1)
+                        if truncate:
+                            conn.sendall(proto.SESSION_FRAME.pack(
+                                proto.FRAME_REDIRECT, seq,
+                                proto.REDIRECT_WIRE_SIZE) + redirect[:4])
+                            return  # hang up mid-redirect
+                        conn.sendall(proto.SESSION_FRAME.pack(
+                            proto.FRAME_REDIRECT, seq,
+                            proto.REDIRECT_WIRE_SIZE) + redirect)
+            except (ConnectionError, OSError):
+                return
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return srv, t, srv.getsockname()[1]
+
+
+def test_client_caps_self_redirect_as_loop():
+    """A shard redirecting a result back at itself is a split-brain
+    ring, not a routing error: the client must count it in
+    worker_redirect_loops and report the result rejected — never chase."""
+    from distributedmandelbrot_tpu.worker.client import ShardedSessionGroup
+
+    srv, t, port = _fake_shard_server(0, 1, redirect_to=0)
+    counters = Counters()
+    try:
+        group = ShardedSessionGroup(_StubRing([port]), timeout=10,
+                                    counters=counters)
+        assert group.connect()
+        tile = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+        accepted, grants = group.submit_pipelined(
+            [(Workload(4, MAX_ITER, 0, 0), tile)])
+        assert accepted == [False] and grants == []
+        assert counters.get(obs_names.WORKER_REDIRECTS) == 1
+        assert counters.get(obs_names.WORKER_REDIRECT_LOOPS) == 1
+        group.close()
+    finally:
+        srv.close()
+        t.join(timeout=10)
+
+
+def test_client_caps_redirect_pingpong_at_hop_budget():
+    """Two shards bouncing a result between each other: the chase stops
+    at MAX_REDIRECT_HOPS, counts a loop, and reports the result
+    rejected — bounded work under a fully adversarial ring."""
+    from distributedmandelbrot_tpu.worker.client import ShardedSessionGroup
+
+    srv_a, t_a, port_a = _fake_shard_server(0, 2, redirect_to=1)
+    srv_b, t_b, port_b = _fake_shard_server(1, 2, redirect_to=0)
+    counters = Counters()
+    try:
+        group = ShardedSessionGroup(_StubRing([port_a, port_b]), timeout=10,
+                                    counters=counters)
+        assert group.connect()
+        tile = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+        accepted, grants = group.submit_pipelined(
+            [(Workload(4, MAX_ITER, 0, 0), tile)])
+        assert accepted == [False] and grants == []
+        # One redirect per hop plus the budget-exhausting last upload.
+        assert counters.get(obs_names.WORKER_REDIRECTS) \
+            == proto.MAX_REDIRECT_HOPS + 1
+        assert counters.get(obs_names.WORKER_REDIRECT_LOOPS) == 1
+        group.close()
+    finally:
+        srv_a.close()
+        srv_b.close()
+        t_a.join(timeout=10)
+        t_b.join(timeout=10)
+
+
+def test_client_rejects_truncated_redirect():
+    """A coordinator that dies mid-REDIRECT must surface as a clean
+    ConnectionError on the client — never a hang, never a partial
+    redirect treated as routable."""
+    from distributedmandelbrot_tpu.worker.client import ShardedSessionGroup
+
+    srv, t, port = _fake_shard_server(0, 1, redirect_to=0, truncate=True)
+    try:
+        group = ShardedSessionGroup(_StubRing([port]), timeout=10,
+                                    counters=Counters())
+        assert group.connect()
+        tile = np.zeros(CHUNK_PIXELS, dtype=np.uint8)
+        with pytest.raises(ConnectionError):
+            group.submit_pipelined([(Workload(4, MAX_ITER, 0, 0), tile)])
+        group.close()
+    finally:
+        srv.close()
+        t.join(timeout=10)
+
+
 def test_dataserver_rejects_malformed_queries_and_stays_alive(tmp_path):
     with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
                             exporter=False) as farm:
