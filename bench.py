@@ -1740,6 +1740,275 @@ def bench_storm(repeats: int, *, level: int = 8,
     return out
 
 
+def _depth_sensitive_tiles(level: int, full_depth: int, paint_depth: int,
+                           count: int) -> list:
+    """Pick ``count`` tiles of the ``level`` grid where full depth costs
+    measurably more than a ``paint_depth`` first paint, without costing
+    minutes.
+
+    With per-pixel early exit (the native worker) a tile's compute cost
+    is proportional to its mean ``min(escape_iter, depth)``, so the
+    paint-vs-refine gap lives in tiles with a fat escape-time tail:
+    mostly fast-escaping pixels plus a slow halo near the set boundary.
+    Mostly-exterior tiles flatten by ~iter 20 (full depth costs the same
+    as the paint) and interior-heavy ones never finish.  A low-res
+    escape-time map (32x32 samples per tile, one vectorized pass over
+    the whole domain) estimates both depths' mean cost per tile; tiles
+    are ranked by the cost ratio within an affordability cap.
+    """
+    from distributedmandelbrot_tpu.core import geometry
+
+    res = 32
+    n = level * res
+    step = (geometry.MAX_AXIS - geometry.MIN_AXIS) / n
+    xs = geometry.MIN_AXIS + step * (np.arange(n) + 0.5)
+    c = xs[None, :] + 1j * xs[:, None]  # row = imag, col = real
+    z = np.zeros_like(c)
+    alive = np.ones(c.shape, dtype=bool)
+    # ~300 iterations separates the slow halo from true interior well
+    # past the escape-time knee; deeper adds scan cost without moving
+    # the ranking.
+    cap = min(full_depth, 300)
+    esc = np.full(c.shape, cap, dtype=np.int32)
+    with np.errstate(over="ignore", invalid="ignore"):
+        for it in range(cap):
+            z = np.where(alive, z * z + c, z)
+            out = alive & ((z.real * z.real + z.imag * z.imag) > 4.0)
+            esc[out] = it + 1
+            alive &= ~out
+
+    def mean_iters(depth: int) -> np.ndarray:
+        return np.minimum(esc, depth).astype(np.float64).reshape(
+            level, res, level, res).mean(axis=(1, 3))
+
+    m_paint = mean_iters(min(paint_depth, cap))
+    m_full = mean_iters(cap)
+    # m_full <= 80 mean iterations keeps one full-depth compute in the
+    # low seconds on the native backend; the >= 4x ratio floor keeps the
+    # paint-vs-depth gap above serve-path overheads (grid gen, save,
+    # render, transfer).
+    rows = sorted(
+        (-(float(m_full[j, i]) / max(float(m_paint[j, i]), 1.0)), i, j)
+        for i in range(level) for j in range(level)
+        if m_full[j, i] <= 80.0
+        and m_full[j, i] >= 4.0 * max(float(m_paint[j, i]), 1.0))
+    if len(rows) < count:  # coarse grids: best available ratios
+        rows = sorted(
+            (-(float(m_full[j, i]) / max(float(m_paint[j, i]), 1.0)), i, j)
+            for i in range(level) for j in range(level)
+            if m_full[j, i] <= 80.0)
+    return [(level, i, j) for _, i, j in rows[:count]]
+
+
+def bench_sessions(repeats: int, *, level: int = 8, sessions: int = 8,
+                   crowd_phases: str = "steady:120x2,spike:700x3,"
+                                       "steady:120x2",
+                   hot_share: float = 0.6, session_rate: float = 30.0,
+                   session_burst: float = 30.0,
+                   paint_levels: str = "32:300",
+                   first_paint_iter: int = 24,
+                   paint_tiles: int = 5) -> dict:
+    """Interactive-session shape (no accelerator): the three numbers the
+    sessions subsystem exists to move.  Two legs:
+
+    - trajectory storm vs a session-enabled 2-replica fleet over a
+      fully-seeded grid: panning sessions with a flash-crowd spike
+      skewed ``hot_share`` onto one session.  Reports the prefetch hit
+      ratio (predictor quality on real pans) and the per-session OK
+      spread — with per-session token budgets the hot session is
+      throttled instead of starving the rest, so the spread stays
+      bounded;
+    - first paint vs full depth on cold tiles: an embedded coordinator
+      with a numpy worker farm, progressive refinement on.  A session
+      query on a cold tile is served at ``first_paint_iter`` and
+      refined to full depth behind the reply; a legacy render on an
+      equally cold tile pays full depth up front.  The headline is the
+      median latency ratio between the two.
+    """
+    import asyncio
+    import tempfile
+    import threading
+
+    from distributedmandelbrot_tpu import loadgen
+    from distributedmandelbrot_tpu.cli import parse_level_settings
+    from distributedmandelbrot_tpu.coordinator import EmbeddedCoordinator
+    from distributedmandelbrot_tpu.core.chunk import Chunk
+    from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+    from distributedmandelbrot_tpu.loadgen.replicas import GatewayFleet
+    from distributedmandelbrot_tpu.obs import names as obs_names
+    from distributedmandelbrot_tpu.storage.backends import (
+        MemoryObjectStore, ObjectStoreBackend)
+    from distributedmandelbrot_tpu.storage.store import ChunkStore
+    from distributedmandelbrot_tpu.viewer import DataClient, FetchStatus
+    from distributedmandelbrot_tpu.worker import (DistributerClient,
+                                                  NativeBackend,
+                                                  NumpyBackend, Worker)
+
+    out: dict = {"config": "sessions", "sessions_level": level,
+                 "sessions_count": sessions,
+                 "sessions_crowd_phases": crowd_phases,
+                 "sessions_hot_share": hot_share,
+                 "sessions_rate": session_rate}
+
+    # -- leg 1: trajectory storm, prefetch + fairness -----------------
+    pixels = np.repeat(np.arange(64, dtype=np.uint8) + 1,
+                       CHUNK_PIXELS // 64)
+    kv = MemoryObjectStore()
+    seeder = ChunkStore(backend=ObjectStoreBackend(kv))
+    for i in range(level):
+        for j in range(level):
+            seeder.save(Chunk(level, i, j, pixels))
+    phases = loadgen.parse_phases(crowd_phases)
+    schedule = loadgen.build_session_schedule(
+        phases, level=level, sessions=sessions, seed=0,
+        hot_share=hot_share)
+    with GatewayFleet(kv, replicas=2, sessions=True,
+                      session_rate=session_rate,
+                      session_burst=session_burst) as fleet:
+        driver = loadgen.SessionDriver(fleet.addresses, timeout=60.0)
+        recorder = loadgen.StormRecorder()
+        runner = loadgen.SessionRunner(schedule, driver, recorder)
+        duration = asyncio.run(runner.run())
+        report = recorder.report(
+            duration=duration,
+            offered=loadgen.schedule.offered_rate(schedule),
+            phases=[p.name for p in phases])
+        hits = fleet.counter(obs_names.PREFETCH_HITS)
+        misses = fleet.counter(obs_names.PREFETCH_MISSES)
+        out["sessions_opened"] = fleet.counter(obs_names.SESSION_OPENS)
+        out["sessions_throttled"] = fleet.counter(
+            obs_names.SESSION_THROTTLED)
+        out["prefetch_planned"] = fleet.counter(
+            obs_names.PREFETCH_PLANNED)
+        out["prefetch_warmed"] = fleet.counter(obs_names.PREFETCH_WARMED)
+    ok_min, ok_max = loadgen.ok_spread(driver.ok_by_session, sessions)
+    out.update({
+        "sessions_requests": report["requests"],
+        "sessions_completed": report["completed"],
+        "sessions_shed": report["shed"],
+        "sessions_errors": report["errors"],
+        "sessions_goodput": report["goodput"],
+        "sessions_p50_s": report["p50"], "sessions_p99_s": report["p99"],
+        "prefetch_hits": hits, "prefetch_misses": misses,
+        "prefetch_hit_ratio":
+            round(hits / (hits + misses), 4) if hits + misses else 0.0,
+        "sessions_ok_min": ok_min, "sessions_ok_max": ok_max,
+        # Bounded-spread flag: the hot session may only beat the
+        # quietest by what its token budget allows, not by its offered
+        # share of the storm.
+        "sessions_spread": round(ok_max / max(ok_min, 1), 2),
+        "sessions_fair_bounded": ok_max <= 5 * max(ok_min, 1),
+    })
+
+    # -- leg 2: first paint vs full depth on cold tiles ---------------
+    settings = parse_level_settings(paint_levels)
+    paint_level = settings[0].level
+    full_iter = settings[0].max_iter
+    # Only boundary-straddling tiles make the comparison meaningful:
+    # mostly-exterior tiles flatten by ~iter 20 (full depth costs the
+    # same as the paint) and mostly-interior ones cost minutes per
+    # compute.  Interleave the picks so neither measure gets
+    # systematically cheaper tiles than the other.
+    picks = _depth_sensitive_tiles(paint_level, full_iter,
+                                   first_paint_iter, 2 * paint_tiles)
+    session_tiles = picks[0::2][:paint_tiles]
+    legacy_tiles = picks[1::2][:paint_tiles]
+    with tempfile.TemporaryDirectory() as tmp, \
+            EmbeddedCoordinator(tmp, settings, exporter=False,
+                                first_paint_max_iter=first_paint_iter,
+                                ondemand_deadline=120.0,
+                                ondemand_poll_interval=0.1) as co:
+        # Pre-complete the whole grid with no bytes behind it.  The
+        # background frontier farm would otherwise wedge the single
+        # worker on a near-interior tile for minutes; instead the farm
+        # idles and every measured fetch rides the on-demand heal path
+        # (completed-but-missing -> un-complete + re-grant), so both
+        # measures pay the identical path against an idle worker.
+        while (w := co.scheduler.acquire()) is not None:
+            co.scheduler.complete(w)
+        stop = threading.Event()
+        # Per-pixel early exit makes tile cost track mean escape work —
+        # the model the tile picker ranks by; the numpy golden pays per
+        # iteration regardless of how many pixels are still active, so
+        # it is the fallback, not the default.
+        try:
+            backend = NativeBackend()
+        except RuntimeError:
+            backend = NumpyBackend()
+        worker = Worker(
+            DistributerClient("127.0.0.1", co.distributer_port),
+            backend, overlap_io=False)
+        wt = threading.Thread(target=worker.run_forever,
+                              kwargs=dict(poll_interval=0.02, stop=stop),
+                              daemon=True)
+        wt.start()
+        try:
+            client = DataClient("127.0.0.1", co.gateway_port,
+                                timeout=600)
+            first_paint_lat = []
+            for key in session_tiles:
+                t0 = time.perf_counter()
+                _, status = client.fetch_session(*key)
+                first_paint_lat.append(time.perf_counter() - t0)
+                assert status is FetchStatus.OK, status
+                # Drain the refine before the next paint: on one worker
+                # the deep recompute sits at the frontier head and would
+                # otherwise queue ahead of the next first paint,
+                # contaminating its latency with full-depth compute.
+                target = co.counters.get(
+                    obs_names.SESSION_REFINES_SCHEDULED)
+                deadline = time.monotonic() + 120.0
+                while time.monotonic() < deadline and \
+                        co.counters.get(
+                            obs_names.SESSION_REFINES_COMPLETED) < target:
+                    time.sleep(0.05)
+            full_depth_lat = []
+            for key in legacy_tiles:
+                t0 = time.perf_counter()
+                _, status = client.fetch_render(*key)
+                full_depth_lat.append(time.perf_counter() - t0)
+                assert status is FetchStatus.OK, status
+            client.close()
+            first_paints = co.counters.get(obs_names.SESSION_FIRST_PAINTS)
+            # Refinement closes the loop in the background: wait for the
+            # deep variants of the painted tiles to land and invalidate
+            # the shallow cache entries.
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if co.counters.get(obs_names.SESSION_REFINES_COMPLETED) \
+                        >= first_paints:
+                    break
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            wt.join(timeout=60)
+        cc = co.counters.snapshot()
+    first_paint_lat.sort()
+    full_depth_lat.sort()
+    fp_p50 = first_paint_lat[len(first_paint_lat) // 2]
+    fd_p50 = full_depth_lat[len(full_depth_lat) // 2]
+    out.update({
+        "paint_levels": paint_levels,
+        "first_paint_iter": first_paint_iter,
+        "full_depth_iter": full_iter,
+        "first_paint_p50_s": round(fp_p50, 4),
+        "full_depth_p50_s": round(fd_p50, 4),
+        "session_first_paints": first_paints,
+        "session_refines_scheduled":
+            cc.get(obs_names.SESSION_REFINES_SCHEDULED, 0),
+        "session_refines_completed":
+            cc.get(obs_names.SESSION_REFINES_COMPLETED, 0),
+        "tile_cache_invalidations":
+            cc.get(obs_names.TILE_CACHE_INVALIDATIONS, 0),
+        "metric": f"interactive sessions: cold-tile first paint "
+                  f"(iter {first_paint_iter}) vs full depth "
+                  f"(iter {full_iter}) median latency",
+        "value": round(fd_p50 / fp_p50, 2) if fp_p50 else 0.0,
+        "unit": "x",
+    })
+    return out
+
+
 def bench_shards(repeats: int, *, levels: str = "64:100",
                  shard_counts: tuple = (1, 2, 4), clients: int = 4,
                  duration: float = 4.0, batch: int = 32) -> dict:
@@ -2017,7 +2286,17 @@ def main() -> int:
                              "(aggregate grant throughput at 1/2/4 "
                              "coordinator shards, restart-to-first-grant "
                              "under live load; no accelerator needed)")
+    parser.add_argument("--sessions", action="store_true",
+                        help="run only the interactive-sessions config "
+                             "(trajectory storm: prefetch hit ratio + "
+                             "per-session fairness spread; cold-tile "
+                             "first-paint vs full-depth latency with a "
+                             "numpy farm; no accelerator needed)")
     args = parser.parse_args()
+    if args.sessions:
+        # Session wire + numpy farm only — no accelerator probe.
+        print(json.dumps(bench_sessions(args.repeats)), flush=True)
+        return 0
     if args.shards:
         # Grant-path only — shard subprocesses + drain clients, no
         # compute, no accelerator probe.

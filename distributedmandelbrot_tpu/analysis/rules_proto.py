@@ -87,6 +87,12 @@ QUERY_EXCHANGES = (
     ("render_query",
      f"{PACKAGE}/viewer/client.py::DataClient._render_exchange",
      f"{PACKAGE}/serve/gateway.py::TileGateway._serve_render"),
+    # The session framing: same magic-sent-by-caller convention as the
+    # rendered exchange; the reply header (SESSION_REPLY) precedes the
+    # standard status byte on both sides.
+    ("session_query",
+     f"{PACKAGE}/viewer/client.py::DataClient._session_exchange",
+     f"{PACKAGE}/serve/gateway.py::TileGateway._serve_session"),
 )
 
 # Purpose bytes that upgrade the connection to a multiplexed frame

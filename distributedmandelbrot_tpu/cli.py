@@ -1615,6 +1615,20 @@ def cmd_loadgen(argv: Sequence[str]) -> int:
                              "shared store before the storm")
     parser.add_argument("--timeout", type=float, default=30.0,
                         help="per-request client timeout (seconds)")
+    parser.add_argument("--sessions", type=int, default=0, metavar="N",
+                        help="interactive-session mode: deal arrivals "
+                             "onto N panning sessions speaking the "
+                             "session wire (trajectory tracking, "
+                             "prefetch, per-session fairness)")
+    parser.add_argument("--hot-share", type=float, default=0.0,
+                        help="with --sessions: extra fraction of "
+                             "arrivals routed to session 0 (the "
+                             "flash-crowd fairness scenario)")
+    parser.add_argument("--session-rate", type=float, default=None,
+                        help="with --sessions: per-session admission "
+                             "token rate (default: unlimited)")
+    parser.add_argument("--session-burst", type=float, default=32.0,
+                        help="with --sessions: per-session token burst")
     parser.add_argument("--json", action="store_true",
                         help="emit the report as JSON")
     args = parser.parse_args(argv)
@@ -1629,11 +1643,20 @@ def cmd_loadgen(argv: Sequence[str]) -> int:
     except ValueError as e:
         print(f"dmtpu loadgen: {e}", file=sys.stderr)
         return 2
-    sampler = loadgen.ZipfTiles(args.level, s=args.zipf, seed=args.seed)
-    schedule = loadgen.build_schedule(phases, sampler, seed=args.seed)
+    if args.sessions:
+        schedule = loadgen.build_session_schedule(
+            phases, level=args.level, sessions=args.sessions,
+            seed=args.seed, zipf_s=args.zipf, hot_share=args.hot_share)
+    else:
+        sampler = loadgen.ZipfTiles(args.level, s=args.zipf,
+                                    seed=args.seed)
+        schedule = loadgen.build_schedule(phases, sampler, seed=args.seed)
     if not schedule:
         print("dmtpu loadgen: schedule is empty (rate 0?)", file=sys.stderr)
         return 2
+    if args.sessions:
+        return _loadgen_session_storm(args, phases, schedule,
+                                      smoke=args.smoke)
     if args.smoke:
         return _loadgen_smoke(phases, schedule)
     return _loadgen_storm(args, phases, schedule)
@@ -1759,6 +1782,146 @@ def _loadgen_storm(args, phases, schedule) -> int:
                     "errors", "offered_rate", "goodput", "shed_fraction",
                     "p50", "p99", "p999", "bytes", "replicas",
                     "gateway_overloaded", "gateway_served"):
+            print(f"{key:20} {report[key]}")
+        for phase, stats in (report.get("phases") or {}).items():
+            print(f"  {phase:18} p50={stats['p50']} p99={stats['p99']} "
+                  f"p999={stats['p999']}")
+    return 0
+
+
+def _loadgen_session_storm(args, phases, schedule, *,
+                           smoke: bool = False) -> int:
+    """Trajectory storm against a session-enabled fleet.
+
+    The store is pre-seeded with the *whole* level grid — sessions pan
+    everywhere, and a fully-warm store keeps the measurement about the
+    session machinery (prediction, prefetch marks, fair admission)
+    rather than store misses.  ``smoke`` runs the same storm on a
+    virtual clock and turns the report into pass/fail checks: ids
+    issued, predictions planned, prefetch marks consumed, every arrival
+    settled — jax-free, so it runs in the lint-only CI job.
+    """
+    import asyncio
+    import json as json_mod
+
+    import numpy as np
+
+    from distributedmandelbrot_tpu import loadgen
+    from distributedmandelbrot_tpu.core.chunk import Chunk
+    from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+    from distributedmandelbrot_tpu.loadgen.replicas import GatewayFleet
+    from distributedmandelbrot_tpu.obs import names as obs_names
+    from distributedmandelbrot_tpu.storage.backends import (
+        MemoryObjectStore, ObjectStoreBackend)
+    from distributedmandelbrot_tpu.storage.store import ChunkStore
+
+    class _IoVirtualTimebase(loadgen.VirtualTimebase):
+        # Real sockets under the virtual clock: after each quiesce
+        # burst, yield to the selector for a moment so cross-thread
+        # socket IO can land.  The deadlock guard gets minutes of
+        # grace (>= 1 ms per idle round) because the in-flight tail
+        # completes on wall time, not the virtual clock — the driver's
+        # per-request timeout still bounds a genuine hang.
+        def __init__(self) -> None:
+            super().__init__(max_idle_rounds=120_000)
+
+        async def _quiesce(self) -> None:
+            await super()._quiesce()
+            await asyncio.sleep(0.001)
+
+    kv = MemoryObjectStore()
+    seeder = ChunkStore(backend=ObjectStoreBackend(kv))
+    pixels = np.repeat(np.arange(64, dtype=np.uint8) + 1,
+                       CHUNK_PIXELS // 64)
+    for i in range(args.level):
+        for j in range(args.level):
+            seeder.save(Chunk(args.level, i, j, pixels))
+
+    fleet = GatewayFleet(kv, replicas=args.replicas, rate=args.rate,
+                         burst=args.burst,
+                         max_queue_depth=args.queue_depth,
+                         sessions=True, session_rate=args.session_rate,
+                         session_burst=args.session_burst)
+    with fleet:
+        driver = loadgen.SessionDriver(fleet.addresses,
+                                       timeout=args.timeout)
+        recorder = loadgen.StormRecorder()
+        if smoke:
+            timebase = _IoVirtualTimebase()
+            runner = loadgen.SessionRunner(schedule, driver, recorder,
+                                           timebase=timebase)
+
+            async def drive() -> float:
+                task = asyncio.ensure_future(runner.run())
+                await timebase.drain(until=task)
+                return task.result()
+
+            duration = asyncio.run(drive())
+        else:
+            runner = loadgen.SessionRunner(schedule, driver, recorder)
+            duration = asyncio.run(runner.run())
+        report = recorder.report(
+            duration=duration,
+            offered=loadgen.schedule.offered_rate(schedule),
+            phases=[p.name for p in phases])
+        report["replicas"] = args.replicas
+        report["sessions"] = args.sessions
+        report["session_opens"] = fleet.counter(obs_names.SESSION_OPENS)
+        report["session_throttled"] = fleet.counter(
+            obs_names.SESSION_THROTTLED)
+        report["prefetch_planned"] = fleet.counter(
+            obs_names.PREFETCH_PLANNED)
+        hits = fleet.counter(obs_names.PREFETCH_HITS)
+        misses = fleet.counter(obs_names.PREFETCH_MISSES)
+        report["prefetch_hits"] = hits
+        report["prefetch_misses"] = misses
+        report["prefetch_hit_ratio"] = (
+            round(hits / (hits + misses), 4) if hits + misses else None)
+        ok_min, ok_max = loadgen.ok_spread(driver.ok_by_session,
+                                           args.sessions)
+        report["ok_min_session"] = ok_min
+        report["ok_max_session"] = ok_max
+
+    if smoke:
+        issued = report["requests"]
+        settled = (report["completed"] + report["shed"]
+                   + report["unavailable"] + report["errors"])
+        problems = []
+        if issued != len(schedule):
+            problems.append(f"issued {issued} != scheduled "
+                            f"{len(schedule)}")
+        if settled != issued:
+            problems.append(f"settled {settled} != issued {issued}")
+        if report["completed"] == 0:
+            problems.append("no completed requests")
+        if report["session_opens"] < 1:
+            problems.append("no sessions opened on the wire")
+        if report["prefetch_planned"] < 1:
+            problems.append("predictor planned no prefetches")
+        if report["prefetch_hits"] < 1:
+            problems.append("no query consumed a prefetch mark")
+        if problems:
+            print("dmtpu loadgen --smoke FAILED: "
+                  + "; ".join(problems), file=sys.stderr)
+            return 1
+        print(f"loadgen session smoke ok: {issued} arrivals, "
+              f"{report['sessions']} sessions, "
+              f"{report['session_opens']} opened, "
+              f"prefetch hit ratio {report['prefetch_hit_ratio']}, "
+              f"ok spread {report['ok_min_session']}.."
+              f"{report['ok_max_session']}")
+        return 0
+
+    if args.json:
+        print(json_mod.dumps(report, indent=2, sort_keys=True))
+    else:
+        for key in ("requests", "completed", "shed", "unavailable",
+                    "errors", "offered_rate", "goodput", "shed_fraction",
+                    "p50", "p99", "p999", "bytes", "replicas",
+                    "sessions", "session_opens", "session_throttled",
+                    "prefetch_planned", "prefetch_hits",
+                    "prefetch_misses", "prefetch_hit_ratio",
+                    "ok_min_session", "ok_max_session"):
             print(f"{key:20} {report[key]}")
         for phase, stats in (report.get("phases") or {}).items():
             print(f"  {phase:18} p50={stats['p50']} p99={stats['p99']} "

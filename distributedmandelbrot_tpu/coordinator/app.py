@@ -32,6 +32,8 @@ from distributedmandelbrot_tpu.obs.trace import TraceLog
 from distributedmandelbrot_tpu.serve.cache import DecodedTileCache
 from distributedmandelbrot_tpu.serve.gateway import TileGateway
 from distributedmandelbrot_tpu.serve.ondemand import OnDemandComputer
+from distributedmandelbrot_tpu.sessions import (SessionService,
+                                                build_session_service)
 from distributedmandelbrot_tpu.storage.ownership import LevelClaims
 from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils.metrics import Counters
@@ -57,7 +59,15 @@ class Coordinator:
                  gateway_rate: Optional[float] = None,
                  gateway_burst: float = 256.0,
                  gateway_render_tiles: int = 64,
+                 gateway_sessions: bool = True,
+                 session_rate: Optional[float] = None,
+                 session_burst: float = 32.0,
+                 session_ttl: Optional[float] = 300.0,
+                 session_capacity: int = 1024,
+                 prefetch_horizon: int = 3,
+                 first_paint_max_iter: int = 64,
                  ondemand_deadline: float = proto.DEFAULT_ONDEMAND_DEADLINE,
+                 ondemand_poll_interval: float = 1.0,
                  exporter_port: Optional[int] = None,
                  accept_spans: bool = True,
                  accept_session: bool = True,
@@ -145,14 +155,26 @@ class Coordinator:
             # and hooks the distributer's save path for compute-on-read
             # arrival notification.
             self.gateway: Optional[TileGateway] = None
+            self.sessions: Optional[SessionService] = None
             if gateway_port is not None:
                 cache = DecodedTileCache(self.store,
                                          capacity=gateway_cache_tiles,
                                          counters=self.counters)
-                ondemand = OnDemandComputer(self.scheduler, cache,
-                                            deadline=ondemand_deadline,
-                                            counters=self.counters)
-                self.distributer.on_chunk_saved = ondemand.notify_saved
+                ondemand = OnDemandComputer(
+                    self.scheduler, cache, deadline=ondemand_deadline,
+                    poll_interval=ondemand_poll_interval,
+                    counters=self.counters)
+                if gateway_sessions:
+                    self.sessions = build_session_service(
+                        cache, scheduler=self.scheduler,
+                        counters=self.counters,
+                        clock=self.scheduler.clock.now,
+                        session_capacity=session_capacity,
+                        session_ttl=session_ttl,
+                        session_rate=session_rate,
+                        session_burst=session_burst,
+                        prefetch_horizon=prefetch_horizon,
+                        first_paint_max_iter=first_paint_max_iter)
                 self.gateway = TileGateway(
                     cache, ondemand=ondemand, host=host, port=gateway_port,
                     read_timeout=read_timeout,
@@ -160,7 +182,20 @@ class Coordinator:
                     rate=gateway_rate, burst=gateway_burst,
                     render_cache_tiles=gateway_render_tiles,
                     counters=self.counters, trace=self.trace,
-                    ring_slice=ring_slice)
+                    ring_slice=ring_slice, sessions=self.sessions)
+                gateway = self.gateway
+
+                def _on_chunk_saved(key: tuple[int, int, int]) -> None:
+                    # A save may be a deeper-max_iter variant of a tile
+                    # the cache tiers hold (progressive refinement, or
+                    # simply a re-render at new settings): drop the
+                    # stale entries and settle any pending refinement
+                    # BEFORE waking on-demand waiters, so a woken read
+                    # can only see the fresh bytes.
+                    gateway.invalidate_saved(key)
+                    ondemand.notify_saved(key)
+
+                self.distributer.on_chunk_saved = _on_chunk_saved
             # Durability checkpoints: periodic when checkpoint_period > 0,
             # on-demand always (POST /checkpoint, final write on stop).
             self.recovery = RecoveryManager(
@@ -307,6 +342,8 @@ class Coordinator:
                 "checkpoint_period": self.recovery.period,
             },
         }
+        if self.sessions is not None:
+            extra["sessions"] = self.sessions.varz()
         if self.ring_slice is not None:
             extra["shard"] = {
                 "shard": self.ring_slice.shard,

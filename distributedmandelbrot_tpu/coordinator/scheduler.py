@@ -338,6 +338,27 @@ class TileScheduler:
             self._retry.appendleft(w)
         return True
 
+    def refine(self, w: Workload) -> bool:
+        """Re-grant a tile at a different depth (progressive refinement).
+
+        A session's first paint completes the tile's 3-tuple key with a
+        cheap low-``max_iter`` workload; serving full quality means
+        granting the same key again at full depth.  Completion is keyed
+        on the 3-tuple, so this un-completes the tile (if completed) and
+        queues ``w`` — which carries the target ``max_iter`` — at the
+        frontier head.  Returns False for out-of-grid/out-of-slice keys;
+        True means a grant at ``w``'s depth is queued or already in
+        flight, so the caller may await the deep save.
+        """
+        if not self._counts(w.key):
+            return False
+        if w.key in self._completed:
+            self._completed.discard(w.key)
+            self._remaining += 1
+        if self._grantable(w, self.clock.now()):
+            self._retry.appendleft(w)
+        return True
+
     def reopen(self, w: Workload) -> None:
         """Un-complete a tile whose persistence failed so it is granted again.
 

@@ -24,7 +24,11 @@ Layout:
 - :mod:`.driver` — the asyncio gateway client (connection per request,
   round-robin across replicas, raw or rendered queries);
 - :mod:`.replicas` — :class:`GatewayFleet`: N threaded gateway replicas
-  over one shared object store, for horizontal read-scaling runs.
+  over one shared object store, for horizontal read-scaling runs;
+- :mod:`.trajectory` — the interactive-session workload model: panning
+  trajectories dealt onto a Poisson arrival process, plus the sticky
+  :class:`SessionDriver`/:class:`SessionRunner` pair speaking the
+  session framing (prefetch hit ratio and fairness-spread runs).
 
 Everything above imports without jax or matplotlib (``driver`` speaks
 only the wire protocol; ``replicas`` rides the jax-free serve stack), so
@@ -40,6 +44,9 @@ from distributedmandelbrot_tpu.loadgen.schedule import (Phase, Request,
                                                         build_schedule,
                                                         parse_phases,
                                                         poisson_arrivals)
+from distributedmandelbrot_tpu.loadgen.trajectory import (
+    SessionDriver, SessionRequest, SessionRunner, build_session_schedule,
+    ok_spread)
 
 __all__ = [
     "Phase",
@@ -52,4 +59,9 @@ __all__ = [
     "OpenLoopRunner",
     "RealTimebase",
     "VirtualTimebase",
+    "SessionDriver",
+    "SessionRequest",
+    "SessionRunner",
+    "build_session_schedule",
+    "ok_spread",
 ]

@@ -14,6 +14,12 @@ adds serving capacity without data movement: the bench's >= 1.6x
 No on-demand compute: a fleet is a *read* tier.  Misses answer
 ``QUERY_NOT_AVAILABLE``, which keeps the scaling measurement about the
 read path instead of farm scheduling.
+
+``sessions=True`` attaches a :class:`~distributedmandelbrot_tpu.
+sessions.SessionService` per replica (no scheduler, so capability
+negotiation grants prefetch-by-cache-warming and refuses refinement):
+the jax-free way to storm the session wire, measure prefetch hit
+ratios, and exercise per-session fair admission.
 """
 
 from __future__ import annotations
@@ -24,6 +30,7 @@ from typing import Optional
 
 from distributedmandelbrot_tpu.serve.cache import DecodedTileCache
 from distributedmandelbrot_tpu.serve.gateway import TileGateway
+from distributedmandelbrot_tpu.sessions import build_session_service
 from distributedmandelbrot_tpu.storage.backends import (ObjectStore,
                                                         ObjectStoreBackend)
 from distributedmandelbrot_tpu.storage.store import ChunkStore
@@ -36,7 +43,12 @@ class _Replica:
     def __init__(self, kv: ObjectStore, *, cache_tiles: int,
                  render_cache_tiles: int, max_queue_depth: int,
                  rate: Optional[float], burst: float,
-                 read_timeout: Optional[float]) -> None:
+                 read_timeout: Optional[float],
+                 sessions: bool = False,
+                 session_rate: Optional[float] = None,
+                 session_burst: float = 32.0,
+                 session_ttl: Optional[float] = 300.0,
+                 prefetch_horizon: int = 3) -> None:
         self.counters = Counters()
         self.port: Optional[int] = None
         self._kv = kv
@@ -45,6 +57,10 @@ class _Replica:
             render_cache_tiles=render_cache_tiles,
             read_timeout=read_timeout)
         self._cache_tiles = cache_tiles
+        self._sessions = sessions
+        self._session_kwargs = dict(
+            session_rate=session_rate, session_burst=session_burst,
+            session_ttl=session_ttl, prefetch_horizon=prefetch_horizon)
         self._ready = threading.Event()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stop_event: Optional[asyncio.Event] = None
@@ -78,8 +94,15 @@ class _Replica:
                            registry=self.counters.registry)
         cache = DecodedTileCache(store, capacity=self._cache_tiles,
                                  counters=self.counters)
+        service = None
+        if self._sessions:
+            # No scheduler on a read replica: the service negotiates
+            # refinement away and prefetches by cache warming only.
+            service = build_session_service(cache, scheduler=None,
+                                            counters=self.counters,
+                                            **self._session_kwargs)
         gateway = TileGateway(cache, host="127.0.0.1", port=0,
-                              counters=self.counters,
+                              counters=self.counters, sessions=service,
                               **self._gateway_kwargs)
         await gateway.start()
         self.port = gateway.port
@@ -97,7 +120,12 @@ class GatewayFleet:
                  cache_tiles: int = 64, render_cache_tiles: int = 64,
                  max_queue_depth: int = 1024,
                  rate: Optional[float] = None, burst: float = 256.0,
-                 read_timeout: Optional[float] = 30.0) -> None:
+                 read_timeout: Optional[float] = 30.0,
+                 sessions: bool = False,
+                 session_rate: Optional[float] = None,
+                 session_burst: float = 32.0,
+                 session_ttl: Optional[float] = 300.0,
+                 prefetch_horizon: int = 3) -> None:
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
         self.kv = kv
@@ -105,7 +133,10 @@ class GatewayFleet:
             _Replica(kv, cache_tiles=cache_tiles,
                      render_cache_tiles=render_cache_tiles,
                      max_queue_depth=max_queue_depth, rate=rate,
-                     burst=burst, read_timeout=read_timeout)
+                     burst=burst, read_timeout=read_timeout,
+                     sessions=sessions, session_rate=session_rate,
+                     session_burst=session_burst, session_ttl=session_ttl,
+                     prefetch_horizon=prefetch_horizon)
             for _ in range(replicas)]
 
     def start(self) -> "GatewayFleet":

@@ -60,7 +60,13 @@ class VirtualTimebase:
     # awaits (task -> gather -> request fn) need a few cycles to settle.
     _YIELDS = 50
 
-    def __init__(self) -> None:
+    def __init__(self, *, max_idle_rounds: int = 1000) -> None:
+        # Grace before a pending target task with no sleepers is called
+        # a deadlock.  The default suits pure-virtual tests; raise it
+        # when real IO (sockets, threads) completes work off the virtual
+        # clock and merely needs wall time — pair that with a bounded
+        # per-request timeout so a true hang still terminates.
+        self.max_idle_rounds = max_idle_rounds
         self._now = 0.0
         self._seq = 0
         self._sleepers: list[tuple[float, int, asyncio.Future]] = []
@@ -96,7 +102,7 @@ class VirtualTimebase:
                 # it must be about to finish or about to sleep.  Give it
                 # bounded grace, then call the deadlock.
                 idle_rounds += 1
-                if idle_rounds > 1000:
+                if idle_rounds > self.max_idle_rounds:
                     raise RuntimeError(
                         "virtual clock deadlock: task pending, no sleepers")
                 continue

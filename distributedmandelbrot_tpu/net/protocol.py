@@ -63,6 +63,25 @@ the 16 MiB escape-count payload.  ``colormap`` must be a registered
 drops the connection via the sanctioned validators.  A legacy DataServer
 would read the magic as a (rejected) level, so only gateways understand
 this framing — same degradation story as ``GATEWAY_BATCH_MAGIC``.
+
+Gateway session query (extension, gateway port only): a query whose first
+u32 is ``GATEWAY_SESSION_MAGIC`` is followed by ``SESSION_QUERY_TAIL`` —
+``(session id u64, level, index_real, index_imag, colormap u8, flags
+u8)`` — and answered with ``SESSION_REPLY`` ``(session id u64, granted
+caps u8)`` followed by the standard status byte + rendered-tile body.
+Session id 0 opens a new session: ``flags`` carries the client's
+requested ``SESSION_CAP_*`` capability bits, and the reply's granted
+caps are the intersection with what the gateway enables (capability
+negotiation, same shape as the distributer's ``SESSION_FLAG_*`` hello).
+A nonzero id names an established session; the gateway tracks its
+viewport trajectory for predictive prefetch and charges its per-session
+admission budget.  An unknown or expired id is answered softly —
+``SESSION_REPLY`` ``(0, 0)`` + ``QUERY_REJECT`` on a live connection —
+so the client reopens with id 0 instead of re-dialing.  Flag bits
+outside ``SESSION_CAPS_MASK`` drop the connection via the sanctioned
+validator.  Legacy queries on the same port are unaffected; a legacy
+DataServer would read the magic as a (rejected) level, like the other
+gateway framings.
 """
 
 from __future__ import annotations
@@ -172,6 +191,17 @@ GATEWAY_BATCH_MAGIC = 0xFFFFFFFF
 # Gateway rendered-tile request: the next impossible level down selects
 # the server-side render framing (RENDER_QUERY_TAIL follows the magic).
 GATEWAY_RENDER_MAGIC = 0xFFFFFFFE
+# Gateway session-scoped render request: the next impossible level down
+# selects the session framing (SESSION_QUERY_TAIL follows the magic).
+GATEWAY_SESSION_MAGIC = 0xFFFFFFFD
+
+# Viewer-session capability bits (SESSION_QUERY_TAIL.flags on open /
+# SESSION_REPLY.caps granted).  Deliberately NOT named SESSION_FLAG_*:
+# those are the distributer worker-session hello bits — different wire,
+# different peers.
+SESSION_CAP_PREFETCH = 0x1  # predictive tile prefetch along the trajectory
+SESSION_CAP_REFINE = 0x2  # low-iter first paint + background refinement
+SESSION_CAPS_MASK = SESSION_CAP_PREFETCH | SESSION_CAP_REFINE
 
 # Rendered-tile colormap ids (RENDER_QUERY_TAIL.colormap).  The names are
 # matplotlib colormap names; the table is the wire registry — an id not
@@ -207,6 +237,18 @@ BATCH_HEADER_WIRE_SIZE = 8
 # still has to read after sniffing the magic.
 RENDER_QUERY_TAIL = struct.Struct("<IIIBB")
 RENDER_QUERY_TAIL_WIRE_SIZE = 14
+# Gateway session query minus its leading GATEWAY_SESSION_MAGIC u32:
+# (session id u64 — 0 opens a new session; level, index_real, index_imag;
+# colormap u8 COLORMAP_*; flags u8 — SESSION_CAP_* request bits on open,
+# ignored on established sessions, bits outside SESSION_CAPS_MASK are a
+# protocol violation).
+SESSION_QUERY_TAIL = struct.Struct("<QIIIBB")
+SESSION_QUERY_TAIL_WIRE_SIZE = 22
+# Session reply header, written before the standard status byte:
+# (session id u64 — the issued/echoed id, 0 on unknown-session reject;
+# granted caps u8 — requested ∩ enabled on open, echoed thereafter).
+SESSION_REPLY = struct.Struct("<QB")
+SESSION_REPLY_WIRE_SIZE = 9
 
 # Span-report push (PURPOSE_SPANS).  Header: (worker_id u64 — a random
 # per-process id, stable across the worker's many short connections;
@@ -334,6 +376,19 @@ def validate_colormap(colormap_id: int) -> int:
     return colormap_id
 
 
+def validate_session_flags(flags: int) -> int:
+    """Check a session query's capability bits against the known mask.
+
+    Returns the bits unchanged when every set bit is a registered
+    ``SESSION_CAP_*``; an unknown bit is a hostile or version-skewed
+    frame and kills the connection like every other validator failure
+    (the caller bumps its named counter first).
+    """
+    if flags & ~SESSION_CAPS_MASK:
+        raise ProtocolError(f"unknown session flag bits {flags:#x}")
+    return flags
+
+
 def validate_session_seq(seq: int, expected: int) -> int:
     """Check a session frame's seq against the stream position.
 
@@ -364,12 +419,13 @@ def query_in_range(level: int, index_real: int, index_imag: int) -> bool:
 
     A level-``n`` grid has ``n x n`` tiles, so indices live in
     ``[0, level)``; level 0 does not exist, and ``GATEWAY_BATCH_MAGIC``
-    / ``GATEWAY_RENDER_MAGIC`` are reserved as framing sentinels, never
-    real levels.  Unlike :func:`validate_count` this is a predicate: an
-    out-of-range query gets a ``QUERY_REJECT`` reply, not a dropped
-    connection.
+    / ``GATEWAY_RENDER_MAGIC`` / ``GATEWAY_SESSION_MAGIC`` are reserved
+    as framing sentinels, never real levels.  Unlike
+    :func:`validate_count` this is a predicate: an out-of-range query
+    gets a ``QUERY_REJECT`` reply, not a dropped connection.
     """
-    if level < 1 or level in (GATEWAY_BATCH_MAGIC, GATEWAY_RENDER_MAGIC):
+    if level < 1 or level in (GATEWAY_BATCH_MAGIC, GATEWAY_RENDER_MAGIC,
+                              GATEWAY_SESSION_MAGIC):
         return False
     return 0 <= index_real < level and 0 <= index_imag < level
 

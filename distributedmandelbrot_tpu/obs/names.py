@@ -262,6 +262,52 @@ GATEWAY_RENDER_UNKNOWN_COLORMAP = "gateway_render_unknown_colormap"
 GAUGE_RENDER_HIT_RATIO = "gateway_render_hit_ratio"
 HIST_GATEWAY_RENDER_SECONDS = "gateway_render_seconds"
 
+# Interactive sessions (GATEWAY_SESSION_MAGIC framing): session
+# lifecycle (opens, queries, table expiry/eviction, the live-session
+# gauge), the named reject counters the fuzz suite pins (unknown session
+# id — soft reject; unknown flag bits — dropped connection; session
+# framing hitting a gateway without the subsystem), and per-session fair
+# admission (budget-exhausted sheds, counted apart from the global
+# GATEWAY_OVERLOADED so a starved flash crowd is tellable from a dry
+# global bucket).
+SESSION_OPENS = "session_opens"
+SESSION_QUERIES = "session_queries"
+SESSION_UNKNOWN = "session_unknown"
+SESSION_BAD_FLAGS = "session_bad_flags"
+SESSION_UNSUPPORTED = "session_unsupported"
+SESSION_THROTTLED = "session_throttled"
+SESSION_EXPIRED = "session_expired"
+SESSION_EVICTED = "session_evicted"
+GAUGE_SESSIONS_ACTIVE = "session_active"
+HIST_SESSION_REQUEST_SECONDS = "session_request_seconds"
+
+# Predictive prefetch along the session trajectory: tiles the planner
+# picked (in-range, not already cached, not already marked), tiles warmed
+# into tier 1 from the store, tiles handed to scheduler.prioritize for
+# compute-on-read, and the hit/miss split — a hit is a session query
+# landing on a tile the planner marked for it, the ratio gauge is the
+# live quality signal for the predictor.
+PREFETCH_PLANNED = "prefetch_planned"
+PREFETCH_WARMED = "prefetch_warmed"
+PREFETCH_SCHEDULED = "prefetch_scheduled"
+PREFETCH_HITS = "prefetch_hits"
+PREFETCH_MISSES = "prefetch_misses"
+GAUGE_PREFETCH_HIT_RATIO = "prefetch_hit_ratio"
+
+# Progressive refinement: first paints served from the cheap low-iter
+# variant, full-depth workloads handed back to the scheduler, and deep
+# variants persisted (which invalidate the stale cache tiers below).
+SESSION_FIRST_PAINTS = "session_first_paints"
+SESSION_REFINES_SCHEDULED = "session_refines_scheduled"
+SESSION_REFINES_COMPLETED = "session_refines_completed"
+
+# Cache-tier invalidation when a deeper-max_iter variant of a cached
+# tile persists (the store's payload LRU self-heals on save; the decoded
+# and rendered tiers are dropped explicitly so the next read re-reads
+# the deep variant).
+TILE_CACHE_INVALIDATIONS = "tile_cache_invalidations"
+GATEWAY_RENDER_CACHE_INVALIDATIONS = "gateway_render_cache_invalidations"
+
 # Serve-side RLE recompression of cold raw payloads (legacy raw-only data
 # dirs): payloads re-encoded on promotion, payloads left raw (estimate
 # said RLE cannot win), and wire bytes saved by the re-encode.
@@ -274,6 +320,10 @@ COALESCE_FOLLOWERS = "coalesce_followers"
 ONDEMAND_REQUESTS = "ondemand_requests"
 ONDEMAND_TIMEOUTS = "ondemand_timeouts"
 ONDEMAND_SERVED = "ondemand_served"
+# A tile the scheduler believed completed missed the store for a full
+# poll window: the bytes are gone (wiped data dir, foreign store), so
+# on-demand un-completed it via ``refine`` and re-granted the compute.
+ONDEMAND_HEALED = "ondemand_healed"
 
 # Gateway per-request outcome label values (one histogram, split by how
 # the request resolved).
@@ -290,6 +340,11 @@ OUTCOME_RENDERED = "rendered"
 # Sharded serving: the key belongs to another shard; the client was
 # pointed at the authoritative one.
 OUTCOME_REDIRECTED = "redirected"
+# Interactive sessions: served the cheap low-iter first paint (full
+# depth refines in the background), or shed by the session's own
+# admission budget rather than the global bucket.
+OUTCOME_FIRST_PAINT = "first_paint"
+OUTCOME_SESSION_THROTTLED = "session_throttled"
 
 # -- loadgen (open-loop storm harness) --------------------------------------
 

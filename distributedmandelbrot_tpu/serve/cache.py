@@ -122,6 +122,27 @@ class DecodedTileCache:
             self.counters.inc("tile_cache_hits")
             return entry
 
+    def contains(self, key: Key) -> bool:
+        """Residency peek for planners: no promotion, no LRU bump, and —
+        unlike :meth:`get_cached` — no hit/miss accounting, so probing
+        does not skew the tier-1 ratio gauge."""
+        with self._lock:
+            return key in self._entries
+
+    def invalidate(self, key: Key) -> bool:
+        """Drop a tile so the next read re-reads the store.
+
+        Called when a deeper-``max_iter`` variant of the tile persists:
+        the store's payload LRU is refreshed by the save itself, but an
+        entry here would keep serving the stale shallow pixels.  Not a
+        miss — nothing was looked up.
+        """
+        with self._lock:
+            if self._entries.pop(key, None) is None:
+                return False
+        self.counters.inc(obs_names.TILE_CACHE_INVALIDATIONS)
+        return True
+
     def put(self, key: Key, payload: bytes) -> CachedTile:
         """Insert/refresh a tile, evicting LRU entries past capacity."""
         entry = CachedTile(payload)
@@ -235,3 +256,19 @@ class RenderedTileCache:
                 self._entries.popitem(last=False)
                 self.counters.inc(obs_names.GATEWAY_RENDER_CACHE_EVICTIONS)
         return body
+
+    def invalidate_tile(self, key: Key) -> int:
+        """Drop every colormap variant of one tile (a deeper-``max_iter``
+        variant persisted; cached PNGs render the stale shallow pixels).
+        Returns how many entries went."""
+        level, index_real, index_imag = key
+        with self._lock:
+            stale = [k for k in self._entries
+                     if k[0] == level and k[1] == index_real
+                     and k[2] == index_imag]
+            for k in stale:
+                del self._entries[k]
+        if stale:
+            self.counters.inc(obs_names.GATEWAY_RENDER_CACHE_INVALIDATIONS,
+                              len(stale))
+        return len(stale)

@@ -20,6 +20,19 @@ port:
   codec body.  A viewer that only displays tiles downloads ~50-200 KB
   instead of 16 MiB, which is what makes million-viewer read fan-out a
   bandwidth problem the gateway can actually win.
+- **Session query** — first u32 is
+  :data:`~distributedmandelbrot_tpu.net.protocol.GATEWAY_SESSION_MAGIC`,
+  followed by the 22-byte ``SESSION_QUERY_TAIL`` (session id + viewport
+  + colormap + capability flags); the reply leads with the 9-byte
+  ``SESSION_REPLY`` (issued/echoed id, granted caps) before the standard
+  status byte + rendered body.  Live only when a
+  :class:`~distributedmandelbrot_tpu.sessions.SessionService` is
+  attached (duck-typed — the serve layer must not import the sessions
+  package, which imports this module): the service tracks each session's
+  viewport trajectory for predictive prefetch, serves first paints from
+  a cheap low-``max_iter`` variant while the full depth refines in the
+  background, and charges a per-session token budget *before* the global
+  one so a flash crowd's hot session sheds onto itself, not everyone.
 
 On top of the :class:`DataServer` semantics the gateway adds:
 
@@ -102,9 +115,15 @@ class TileGateway:
                  render_cache_tiles: int = 64,
                  counters: Optional[Counters] = None,
                  trace: Optional[TraceLog] = None,
-                 ring_slice=None) -> None:
+                 ring_slice=None,
+                 sessions=None) -> None:
         self.cache = cache
         self.ondemand = ondemand
+        # Duck-typed sessions.SessionService (open/touch/note_query/
+        # prefetch/first_paint_iter/schedule_refine) — import cycle, see
+        # the module docstring.  None answers the session framing with a
+        # named reject counter and a dropped connection.
+        self.sessions = sessions
         # Duck-typed control.ring.RingSlice (owns/owner_of/version) — the
         # serve layer must not import the control package (cycle).  When
         # set, queries for keys outside this shard's slice are answered
@@ -132,6 +151,9 @@ class TileGateway:
         self._active = 0
         self._server: Optional[asyncio.Server] = None
         self._conn_tasks: set[asyncio.Task] = set()
+        # Detached prefetch-warming tasks (fire-and-forget off the
+        # response path); held so stop() can cancel them.
+        self._bg_tasks: set[asyncio.Task] = set()
 
     # -- lifecycle --------------------------------------------------------
 
@@ -147,10 +169,11 @@ class TileGateway:
         # Connections may be parked in an on-demand wait (minutes); cancel
         # them rather than letting wait_closed() (3.12+: waits for all
         # handlers) stall shutdown for the deadline.
-        for task in list(self._conn_tasks):
+        for task in list(self._conn_tasks | self._bg_tasks):
             task.cancel()
-        if self._conn_tasks:
-            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        if self._conn_tasks or self._bg_tasks:
+            await asyncio.gather(*self._conn_tasks, *self._bg_tasks,
+                                 return_exceptions=True)
         flights = self.singleflight.cancel_inflight()
         if flights:
             await asyncio.gather(*flights, return_exceptions=True)
@@ -180,6 +203,8 @@ class TileGateway:
                     await self._serve_batch(reader, writer)
                 elif first == proto.GATEWAY_RENDER_MAGIC:
                     await self._serve_render(reader, writer)
+                elif first == proto.GATEWAY_SESSION_MAGIC:
+                    await self._serve_session(reader, writer)
                 else:
                     rest = await self._read(framing.read_exact(
                         reader, proto.QUERY_TAIL.size))
@@ -247,6 +272,42 @@ class TileGateway:
         proto.validate_count(flags, 0, "render flags")
         status, payload = await self._resolve_render(
             level, index_real, index_imag, colormap_id)
+        self._write_response(writer, status, payload)
+
+    async def _serve_session(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        """One session-scoped exchange: 22-byte tail in, SESSION_REPLY +
+        status (+ PNG) out.
+
+        The tail's colormap and flag bytes are wire-controlled and go
+        through the sanctioned validators (each behind its own named
+        counter) before anything dereferences them; the session id is
+        never more than a dict-key probe.  A gateway without a session
+        service kills the connection like a validator failure — the
+        client's capability story is "no reply header means no
+        sessions", same as a legacy DataServer dropping the magic.
+        """
+        raw = await self._read(framing.read_exact(
+            reader, proto.SESSION_QUERY_TAIL.size))
+        (session_id, level, index_real, index_imag,
+         colormap_id, flags) = proto.SESSION_QUERY_TAIL.unpack(raw)
+        if self.sessions is None:
+            self.counters.inc(obs_names.SESSION_UNSUPPORTED)
+            raise framing.ProtocolError(
+                "session query on a gateway without a session service")
+        try:
+            proto.validate_colormap(colormap_id)
+        except framing.ProtocolError:
+            self.counters.inc(obs_names.GATEWAY_RENDER_UNKNOWN_COLORMAP)
+            raise
+        try:
+            proto.validate_session_flags(flags)
+        except framing.ProtocolError:
+            self.counters.inc(obs_names.SESSION_BAD_FLAGS)
+            raise
+        sid, caps, status, payload = await self._resolve_session(
+            session_id, level, index_real, index_imag, colormap_id, flags)
+        writer.write(proto.SESSION_REPLY.pack(sid, caps))
         self._write_response(writer, status, payload)
 
     def _write_response(self, writer: asyncio.StreamWriter, status: int,
@@ -420,6 +481,171 @@ class TileGateway:
         pixels = Chunk.deserialize_data(payload)
         return render.render_tile_png(pixels,
                                       proto.COLORMAPS[colormap_id])
+
+    # -- the session path --------------------------------------------------
+
+    async def _resolve_session(
+            self, session_id: int, level: int, index_real: int,
+            index_imag: int, colormap_id: int, flags: int
+    ) -> tuple[int, int, int, Optional[bytes | tuple[int, int]]]:
+        """Session lifecycle + admission + render; returns
+        ``(session id, granted caps, status, payload)``.
+
+        Latency lands in its own histogram (``session_request_seconds``,
+        split by the same outcome label family) so first-paint latency is
+        directly comparable against the full-depth render path.
+        """
+        svc = self.sessions
+        self.counters.inc(obs_names.SESSION_QUERIES)
+        if session_id == 0:
+            state = svc.open(flags)
+        else:
+            state = svc.touch(session_id)
+            if state is None:
+                # Soft reject on a live connection: expired/unknown ids
+                # are a normal part of the lifecycle (TTL, LRU eviction,
+                # gateway restart) — the client reopens with id 0.
+                self.counters.inc(obs_names.SESSION_UNKNOWN)
+                return 0, 0, proto.QUERY_REJECT, None
+        t0 = time.monotonic()
+        status, payload, outcome = await self._session_outcome(
+            state, level, index_real, index_imag, colormap_id)
+        self.registry.observe(obs_names.HIST_SESSION_REQUEST_SECONDS,
+                              time.monotonic() - t0,
+                              labels={"outcome": outcome})
+        return state.session_id, state.caps, status, payload
+
+    async def _session_outcome(
+            self, state, level: int, index_real: int, index_imag: int,
+            colormap_id: int
+    ) -> tuple[int, Optional[bytes | tuple[int, int]], str]:
+        if not proto.query_in_range(level, index_real, index_imag):
+            self.counters.inc("gateway_rejected")
+            return proto.QUERY_REJECT, None, obs_names.OUTCOME_REJECTED
+        redirect = self._redirect_for(level, index_real, index_imag)
+        if redirect is not None:
+            return proto.QUERY_REDIRECT, redirect, obs_names.OUTCOME_REDIRECTED
+        # The viewport hint always lands (trajectory + prefetch verdict
+        # + plan), whatever the admission verdict below — a shed query
+        # is still evidence of where the user is heading.
+        planned = self.sessions.note_query(state, level, index_real,
+                                           index_imag)
+        if planned:
+            self._spawn_prefetch(planned)
+        # Weighted fair admission: the session's private budget is
+        # charged before everything — even cache hits.  The global
+        # bucket below protects compute, so cached bytes rightly skip
+        # it; this one bounds the *session's* service rate, and a hot
+        # session replaying cached tiles must not dodge its budget
+        # while the rest of the crowd queues.
+        if not state.admit():
+            self.counters.inc(obs_names.SESSION_THROTTLED)
+            return (proto.QUERY_OVERLOADED, None,
+                    obs_names.OUTCOME_SESSION_THROTTLED)
+        render_key = (level, index_real, index_imag, colormap_id)
+        body = self.render_cache.get(render_key)
+        if body is not None:
+            self.counters.inc(obs_names.GATEWAY_RENDER_SERVED)
+            return (proto.QUERY_ACCEPT, body,
+                    obs_names.OUTCOME_RENDER_CACHE)
+        if self._active >= self.max_queue_depth \
+                or not self.bucket.try_acquire():
+            self.counters.inc("gateway_overloaded")
+            return proto.QUERY_OVERLOADED, None, obs_names.OUTCOME_OVERLOADED
+        self._active += 1
+        try:
+            body, outcome = await self._render_session(
+                state, level, index_real, index_imag, colormap_id)
+        finally:
+            self._active -= 1
+        if body is None:
+            self.counters.inc("gateway_unavailable")
+            return (proto.QUERY_NOT_AVAILABLE, None,
+                    obs_names.OUTCOME_UNAVAILABLE)
+        self.counters.inc(obs_names.GATEWAY_RENDER_SERVED)
+        return proto.QUERY_ACCEPT, body, outcome
+
+    def _spawn_prefetch(self, keys: list[tuple[int, int, int]]) -> None:
+        """Warm planned tiles off the response path (fire-and-forget)."""
+        task = asyncio.get_running_loop().create_task(
+            self.sessions.prefetch(keys))
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    async def _render_session(
+            self, state, level: int, index_real: int, index_imag: int,
+            colormap_id: int) -> tuple[Optional[bytes], str]:
+        """Render for a session: refine-capable sessions get cold tiles
+        as a cheap low-``max_iter`` first paint (the full depth is
+        scheduled behind it); everything else takes the standard render
+        path.  Warm tiles (tier 1 / store) are full quality either way —
+        the first-paint shortcut only pays when pixels would have to be
+        computed."""
+        full_iter = self._level_max_iter.get(level)
+        fp_iter = None
+        if state.caps & proto.SESSION_CAP_REFINE:
+            fp_iter = self.sessions.first_paint_iter(full_iter)
+        if fp_iter is None or self.ondemand is None:
+            body = await self._render(level, index_real, index_imag,
+                                      colormap_id)
+            return body, obs_names.OUTCOME_RENDERED
+        flight_key = ("render", level, fp_iter, index_real, index_imag,
+                      colormap_id)
+
+        async def supplier() -> tuple[Optional[bytes], str]:
+            payload, outcome = await self._resolve_first_paint(
+                level, index_real, index_imag, fp_iter, full_iter)
+            if payload is None:
+                return None, obs_names.OUTCOME_UNAVAILABLE
+            t0 = time.monotonic()
+            body = await asyncio.to_thread(
+                self._render_body, payload, colormap_id)
+            self.registry.observe(obs_names.HIST_GATEWAY_RENDER_SECONDS,
+                                  time.monotonic() - t0)
+            if outcome is not obs_names.OUTCOME_FIRST_PAINT:
+                # Shallow bodies must not linger in the render cache:
+                # they'd outlive the deep save's invalidation sweep only
+                # if cached before it — which this put would be.
+                body = self.render_cache.put(
+                    (level, index_real, index_imag, colormap_id), body)
+            return body, outcome
+
+        return await self.singleflight.run(flight_key, supplier)
+
+    async def _resolve_first_paint(
+            self, level: int, index_real: int, index_imag: int,
+            fp_iter: int, full_iter: int) -> tuple[Optional[bytes], str]:
+        """Payload for a first paint: warm reads are full quality; a true
+        miss computes the cheap variant and queues the deep one."""
+        key = (level, index_real, index_imag)
+        flight_key = (level, fp_iter, index_real, index_imag)
+
+        async def supplier() -> tuple[Optional[bytes], str]:
+            entry = await asyncio.to_thread(self.cache.load, key)
+            if entry is not None:
+                return entry.payload, obs_names.OUTCOME_STORE
+            entry = await self.ondemand.compute(
+                Workload(level, fp_iter, index_real, index_imag))
+            if entry is None:
+                return None, obs_names.OUTCOME_UNAVAILABLE
+            # Deliberately NOT promoted into tier 1: the shallow payload
+            # is a one-shot paint, and the deep save that follows would
+            # have to invalidate it anyway.
+            self.counters.inc(obs_names.SESSION_FIRST_PAINTS)
+            self.sessions.schedule_refine(
+                Workload(level, full_iter, index_real, index_imag))
+            return entry.payload, obs_names.OUTCOME_FIRST_PAINT
+
+        return await self.singleflight.run(flight_key, supplier)
+
+    def invalidate_saved(self, key: tuple[int, int, int]) -> None:
+        """A (possibly deeper) variant of ``key`` just persisted: drop
+        the stale decoded and rendered cache entries and settle any
+        pending refinement.  The coordinator's save hook fans in here."""
+        self.cache.invalidate(key)
+        self.render_cache.invalidate_tile(key)
+        if self.sessions is not None:
+            self.sessions.on_chunk_saved(key)
 
     async def _resolve(self, level: int, index_real: int,
                        index_imag: int) -> tuple[Optional[bytes], str]:

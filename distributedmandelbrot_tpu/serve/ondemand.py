@@ -11,6 +11,11 @@ Arrival is signalled by the distributer's save path (the coordinator wires
 :meth:`notify_saved` into it), with a slow poll of the store as a backstop
 for tiles that land through any other route (a second coordinator on the
 same data dir, an operator copying files in).
+
+A miss for a tile the scheduler has already marked completed is usually a
+save still in flight — but if the store stays empty past one poll window
+the bytes are genuinely gone, and the tile is un-completed and re-granted
+(:meth:`TileScheduler.refine`) rather than letting every reader time out.
 """
 
 from __future__ import annotations
@@ -66,10 +71,17 @@ class OnDemandComputer:
         if event is None:
             event = self._arrivals[key] = asyncio.Event()
         self.counters.inc("ondemand_requests")
-        # Prioritize returns False only for out-of-grid keys; a completed
-        # tile whose save is still in flight keeps us waiting below.
-        self.scheduler.prioritize(workload)
-        logger.info("on-demand: prioritized %s", workload)
+        # Prioritize returns False for out-of-grid keys and for tiles the
+        # scheduler already recorded as completed.  The usual completed
+        # case is a save still in flight, which lands within a poll; but
+        # we only get here after a cache/store miss, so a completed tile
+        # that stays missing means the bytes are gone (wiped data dir, a
+        # foreign store).  Give the in-flight save one poll window, then
+        # heal: un-complete via ``refine`` and re-grant the compute
+        # instead of waiting out the whole deadline for nothing.
+        heal = not self.scheduler.prioritize(workload)
+        if not heal:
+            logger.info("on-demand: prioritized %s", workload)
         try:
             while True:
                 remaining = t_deadline - loop.time()
@@ -86,6 +98,14 @@ class OnDemandComputer:
                 if entry is not None:
                     self.counters.inc("ondemand_served")
                     return entry
+                if heal:
+                    heal = False
+                    refine = getattr(self.scheduler, "refine", None)
+                    if refine is not None and refine(workload):
+                        self.counters.inc("ondemand_healed")
+                        logger.info(
+                            "on-demand: completed tile missing from store,"
+                            " re-granted %s", workload)
                 # Save notification without a loadable payload (save error
                 # reopened the tile, or a spurious wake): re-arm and wait.
                 event.clear()

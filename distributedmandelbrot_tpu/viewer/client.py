@@ -51,6 +51,11 @@ class DataClient:
         self.timeout = timeout
         # (shard, ring_version) from the most recent REDIRECTED reply.
         self.last_redirect: Optional[tuple[int, int]] = None
+        # Session framing state: the id the gateway issued (0 = none
+        # yet / reopen on next fetch) and the capability bits — the
+        # request until the first exchange, the grant after it.
+        self.session_id = 0
+        self.session_caps = proto.SESSION_CAPS_MASK
         self._sock: Optional[socket.socket] = None
 
     def _connected(self) -> socket.socket:
@@ -149,6 +154,79 @@ class DataClient:
         post-magic handler frame for frame.)"""
         framing.send_all(sock, proto.RENDER_QUERY_TAIL.pack(
             level, index_real, index_imag, colormap_id, 0))
+        status = framing.recv_byte(sock)
+        miss = _STATUS_BY_BYTE.get(status)
+        if miss is not None:
+            return None, miss
+        if status == proto.QUERY_REDIRECT:
+            self._read_redirect(sock)
+            return None, FetchStatus.REDIRECTED
+        if status != proto.QUERY_ACCEPT:
+            raise framing.ProtocolError(f"unknown query status {status:#x}")
+        length = proto.validate_payload_length(framing.recv_u32(sock))
+        return framing.recv_exact(sock, length), FetchStatus.OK
+
+    def open_session(self, caps: int = proto.SESSION_CAPS_MASK) -> None:
+        """Arm the session framing: the next :meth:`fetch_session` opens
+        a session requesting ``caps``; later fetches ride the issued id.
+
+        :attr:`session_id` / :attr:`session_caps` expose what the
+        gateway granted after the first exchange.  Gateway only — a
+        legacy DataServer drops the connection on the magic, which
+        surfaces as the usual transport error.
+        """
+        self.session_id = 0
+        self.session_caps = caps
+
+    def fetch_session(self, level: int, index_real: int, index_imag: int,
+                      colormap_id: int = proto.COLORMAP_JET
+                      ) -> tuple[Optional[bytes], FetchStatus]:
+        """Session-scoped render fetch: like :meth:`fetch_render`, but
+        the query carries the session id + viewport hint so the gateway
+        tracks the trajectory, prefetches ahead of the pan, and may
+        serve a cold tile as a fast low-iter first paint (refined in
+        the background).  Call :meth:`open_session` once first.
+
+        A soft ``REJECTED`` with the reply id 0 means the session
+        expired server-side; the client resets to reopen on the next
+        call, so one retry re-establishes the session.
+        """
+        try:
+            return self._fetch_session_once(level, index_real, index_imag,
+                                            colormap_id)
+        except (ConnectionError, OSError):
+            self.close()
+            return self._fetch_session_once(level, index_real, index_imag,
+                                            colormap_id)
+
+    def _fetch_session_once(self, level: int, index_real: int,
+                            index_imag: int, colormap_id: int
+                            ) -> tuple[Optional[bytes], FetchStatus]:
+        sock = self._connected()
+        framing.send_u32(sock, proto.GATEWAY_SESSION_MAGIC)
+        flags = self.session_caps if self.session_id == 0 else 0
+        return self._session_exchange(sock, self.session_id, level,
+                                      index_real, index_imag, colormap_id,
+                                      flags)
+
+    def _session_exchange(self, sock: socket.socket, session_id: int,
+                          level: int, index_real: int, index_imag: int,
+                          colormap_id: int, flags: int
+                          ) -> tuple[Optional[bytes], FetchStatus]:
+        """The post-magic exchange: 22-byte tail out, reply header +
+        status (+ PNG) in.  (Split from :meth:`_fetch_session_once` so it
+        mirrors the server's post-magic handler frame for frame.)"""
+        framing.send_all(sock, proto.SESSION_QUERY_TAIL.pack(
+            session_id, level, index_real, index_imag, colormap_id, flags))
+        sid, caps = proto.SESSION_REPLY.unpack(
+            framing.recv_exact(sock, proto.SESSION_REPLY_WIRE_SIZE))
+        if sid != 0:
+            self.session_id = sid
+            self.session_caps = caps
+        else:
+            # Unknown/expired session: reopen (with the original
+            # capability request) on the next fetch.
+            self.session_id = 0
         status = framing.recv_byte(sock)
         miss = _STATUS_BY_BYTE.get(status)
         if miss is not None:

@@ -915,6 +915,98 @@ def test_proto_render_exchange_skipped_when_one_side_absent():
     assert findings_for(one_sided, "proto-frames") == []
 
 
+# The session-scoped query (QUERY_EXCHANGES entry "session_query"):
+# magic sniffed like the render exchange, but the reply leads with a
+# fixed SESSION_REPLY header (new session id + granted caps) before the
+# standard status byte — the parity check must see that header on both
+# sides.
+SQUERY_PROTO_SRC = PROTO_SRC + '''
+SESSION_QUERY_TAIL = struct.Struct("<QIIIBB")
+SESSION_QUERY_TAIL_WIRE_SIZE = SESSION_QUERY_TAIL.size
+SESSION_REPLY = struct.Struct("<QB")
+SESSION_REPLY_WIRE_SIZE = SESSION_REPLY.size
+'''
+
+SQUERY_CLIENT = f"{P}/viewer/client.py"
+SQUERY_CLIENT_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (recv_byte, recv_exact,
+                                                   recv_u32, send_all)
+
+class DataClient:
+    def _session_exchange(self, sock, session_id, level, i, j,
+                          colormap_id, flags):
+        send_all(sock, proto.SESSION_QUERY_TAIL.pack(
+            session_id, level, i, j, colormap_id, flags))
+        sid, caps = proto.SESSION_REPLY.unpack(
+            recv_exact(sock, proto.SESSION_REPLY_WIRE_SIZE))
+        status = recv_byte(sock)
+        length = recv_u32(sock)
+        return recv_exact(sock, length), status
+'''
+
+SQUERY_SERVER = f"{P}/serve/gateway.py"
+SQUERY_SERVER_SRC = '''
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.net.framing import (read_exact, write_byte,
+                                                   write_u32)
+
+class TileGateway:
+    async def _serve_session(self, reader, writer):
+        raw = await read_exact(reader, proto.SESSION_QUERY_TAIL.size)
+        (session_id, level, i, j,
+         colormap_id, flags) = proto.SESSION_QUERY_TAIL.unpack(raw)
+        sid, caps, body = self._resolve(session_id, level, i, j,
+                                        colormap_id, flags)
+        writer.write(proto.SESSION_REPLY.pack(sid, caps))
+        write_byte(writer, 0x10)
+        write_u32(writer, len(body))
+        writer.write(body)
+'''
+
+SQUERY_SOURCES = {PROTO_MOD: SQUERY_PROTO_SRC,
+                  SQUERY_CLIENT: SQUERY_CLIENT_SRC,
+                  SQUERY_SERVER: SQUERY_SERVER_SRC}
+
+
+def test_proto_session_query_clean_when_sequences_match():
+    for rule in ("proto-frames", "proto-exact-read"):
+        assert findings_for(SQUERY_SOURCES, rule) == []
+
+
+def test_proto_session_query_fires_when_client_sends_legacy_tail():
+    # Version-skew drift: a legacy client speaking the raw 12-byte QUERY
+    # at the session magic must be caught as a sequence mismatch.
+    skewed = dict(SQUERY_SOURCES)
+    skewed[SQUERY_CLIENT] = SQUERY_CLIENT_SRC.replace(
+        "proto.SESSION_QUERY_TAIL.pack(\n"
+        "            session_id, level, i, j, colormap_id, flags)",
+        "proto.QUERY.pack(level, i, j)")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "session_query" in found[0].message
+    assert "client sends [QUERY]" in found[0].message
+    assert "server reads [SESSION_QUERY_TAIL]" in found[0].message
+
+
+def test_proto_session_query_fires_when_server_drops_reply_header():
+    # The SESSION_REPLY header precedes the status byte; a server that
+    # jumps straight to the status desynchronizes every client read.
+    skewed = dict(SQUERY_SOURCES)
+    skewed[SQUERY_SERVER] = SQUERY_SERVER_SRC.replace(
+        "        writer.write(proto.SESSION_REPLY.pack(sid, caps))\n", "")
+    found = findings_for(skewed, "proto-frames")
+    assert len(found) == 1
+    assert "client awaits [SESSION_REPLY, BYTE, U32, ?]" in found[0].message
+    assert "server writes [BYTE, U32, ?]" in found[0].message
+
+
+def test_proto_session_query_skipped_when_one_side_absent():
+    one_sided = {PROTO_MOD: SQUERY_PROTO_SRC,
+                 SQUERY_SERVER: SQUERY_SERVER_SRC}
+    assert findings_for(one_sided, "proto-frames") == []
+
+
 # The batched lease exchange (SESSION_EXCHANGES entry "lease_reqn"):
 # an exchange INSIDE the multiplexed session stream, so ops carrying
 # the frame-header symbol are filtered from both sides and the payload

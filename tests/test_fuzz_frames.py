@@ -833,3 +833,85 @@ def test_gateway_rejects_malformed_render_frames_and_stays_alive(tmp_path):
             status = sock.recv(1)
             assert status[0] == proto.QUERY_REJECT
         _assert_gateway_alive(farm)
+
+
+def test_gateway_rejects_malformed_session_frames_and_stays_alive(tmp_path):
+    """The session-query fuzz corpus: truncated tails and unknown flag
+    bits drop the connection behind named counters; a bad session id is
+    a *soft* reject (the reply says "reopen") on a live connection."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            exporter=False) as farm:
+        rejected = 0
+
+        # Truncated session tail: magic promised 22 bytes, 10 arrive.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_SESSION_MAGIC)
+                         + proto.SESSION_QUERY_TAIL.pack(
+                             0, 1, 0, 0, proto.COLORMAP_JET, 0)[:10])
+            assert _recv_all(sock) == b""
+        rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_gateway_alive(farm)
+
+        # Unknown capability flag bits: named counter + drop, before any
+        # session state is touched.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_SESSION_MAGIC)
+                         + proto.SESSION_QUERY_TAIL.pack(
+                             0, 1, 0, 0, proto.COLORMAP_JET, 0x80))
+            assert _recv_all(sock) == b""
+        assert _wait_counter(farm, obs_names.SESSION_BAD_FLAGS, 1) >= 1
+        rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_gateway_alive(farm)
+
+        # Unknown colormap on the session tail: same named counter as
+        # the render framing, same drop.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_SESSION_MAGIC)
+                         + proto.SESSION_QUERY_TAIL.pack(
+                             0, 1, 0, 0, 0xEE, 0))
+            assert _recv_all(sock) == b""
+        assert _wait_counter(
+            farm, obs_names.GATEWAY_RENDER_UNKNOWN_COLORMAP, 1) >= 1
+        rejected = _wait_counter(farm, obs_names.GATEWAY_FRAMES_REJECTED,
+                                 rejected + 1)
+        _assert_gateway_alive(farm)
+
+        # Never-issued session id: soft reject.  The reply header carries
+        # sid 0 ("reopen on your next query") + an in-band REJECT — the
+        # connection must stay open, because id expiry is a normal
+        # lifecycle event, not a protocol violation.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_SESSION_MAGIC)
+                         + proto.SESSION_QUERY_TAIL.pack(
+                             0xDEAD_BEEF, 1, 0, 0, proto.COLORMAP_JET, 0))
+            sid, caps = proto.SESSION_REPLY.unpack(
+                _recv_exact(sock, proto.SESSION_REPLY_WIRE_SIZE))
+            assert (sid, caps) == (0, 0)
+            assert _recv_exact(sock, 1)[0] == proto.QUERY_REJECT
+        assert _wait_counter(farm, obs_names.SESSION_UNKNOWN, 1) >= 1
+        _assert_gateway_alive(farm)
+
+        # Out-of-range key on a fresh open: the session IS issued (the
+        # viewport hint is bad, the viewer is not), then an in-band
+        # REJECT — and the issued id is honoured on the next query.
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_SESSION_MAGIC)
+                         + proto.SESSION_QUERY_TAIL.pack(
+                             0, 0, 0, 0, proto.COLORMAP_JET,
+                             proto.SESSION_CAPS_MASK))
+            sid, caps = proto.SESSION_REPLY.unpack(
+                _recv_exact(sock, proto.SESSION_REPLY_WIRE_SIZE))
+            assert sid != 0
+            assert caps & proto.SESSION_CAP_PREFETCH
+            assert _recv_exact(sock, 1)[0] == proto.QUERY_REJECT
+        with _dial(farm.gateway_port) as sock:
+            sock.sendall(U32.pack(proto.GATEWAY_SESSION_MAGIC)
+                         + proto.SESSION_QUERY_TAIL.pack(
+                             sid, 0, 0, 0, proto.COLORMAP_JET, 0))
+            sid2, _ = proto.SESSION_REPLY.unpack(
+                _recv_exact(sock, proto.SESSION_REPLY_WIRE_SIZE))
+            assert sid2 == sid
+            assert _recv_exact(sock, 1)[0] == proto.QUERY_REJECT
+        _assert_gateway_alive(farm)

@@ -289,6 +289,32 @@ def test_ondemand_deadline_expiry(tmp_path):
         assert farm.counters.get("ondemand_timeouts") == 1
 
 
+def test_ondemand_heals_completed_tile_missing_from_store(tmp_path):
+    """A tile the scheduler recorded as completed but whose bytes are gone
+    (wiped data dir) must be un-completed and recomputed on read, not left
+    to time out forever."""
+    with CoordinatorHarness(str(tmp_path), [LevelSetting(1, MAX_ITER)],
+                            ondemand_deadline=120.0) as farm:
+        # Simulate the loss: complete the only tile without saving bytes.
+        w = farm.scheduler.acquire()
+        assert farm.scheduler.complete(w)
+        assert farm.scheduler.is_complete()
+
+        stop = threading.Event()
+        wt = _worker_thread(farm, stop)
+        try:
+            client = DataClient("127.0.0.1", farm.gateway_port, timeout=120)
+            pixels, status = client.fetch(1, 0, 0)
+            assert status is FetchStatus.OK
+            np.testing.assert_array_equal(
+                pixels, golden_tile(1, 0, 0, MAX_ITER))
+            assert farm.counters.get("ondemand_healed") == 1
+            assert farm.counters.get("ondemand_served") == 1
+        finally:
+            stop.set()
+            wt.join(timeout=30)
+
+
 def test_gateway_load_shed_overloaded(tmp_path):
     """Queue-depth load shedding: with one serving slot occupied by an
     on-demand wait, the next miss is shed with an explicit OVERLOADED."""

@@ -1,0 +1,427 @@
+"""Deterministic, jax-free unit tests for the sessions subsystem.
+
+Everything here runs on injected clocks (ManualClock for the table,
+scheduler, and fairness buckets) or on pure functions (the predictor,
+the trajectory workload model) — no sockets, no wall-clock sleeps, no
+accelerator.  The wire-level session behavior lives in
+tests/test_fuzz_frames.py and tests/test_gateway.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from distributedmandelbrot_tpu.core.chunk import Chunk
+from distributedmandelbrot_tpu.core.geometry import CHUNK_PIXELS
+from distributedmandelbrot_tpu.core.workload import LevelSetting, Workload
+from distributedmandelbrot_tpu.coordinator.clock import ManualClock
+from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
+from distributedmandelbrot_tpu.loadgen import (build_session_schedule,
+                                               ok_spread, parse_phases)
+from distributedmandelbrot_tpu.loadgen.trajectory import _reflect
+from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.serve.cache import (DecodedTileCache,
+                                                   RenderedTileCache)
+from distributedmandelbrot_tpu.sessions import (PrefetchPlanner,
+                                                RefinementTracker,
+                                                SessionService,
+                                                SessionState, SessionTable,
+                                                build_session_service,
+                                                predict_tiles)
+from distributedmandelbrot_tpu.sessions.table import ViewportObs
+from distributedmandelbrot_tpu.storage.backends import (MemoryObjectStore,
+                                                        ObjectStoreBackend)
+from distributedmandelbrot_tpu.storage.store import ChunkStore
+from distributedmandelbrot_tpu.utils.metrics import Counters
+
+SETTINGS = [LevelSetting(8, 100)]
+
+
+def obs_at(points, dt=1.0):
+    """ViewportObs sequence from (level, i, j) keys, dt apart."""
+    return tuple(ViewportObs(k * dt, level, i, j)
+                 for k, (level, i, j) in enumerate(points))
+
+
+def make_cache(level=8, tiles=(), counters=None):
+    """DecodedTileCache over a memory store seeded with ``tiles``."""
+    store = ChunkStore(backend=ObjectStoreBackend(MemoryObjectStore()))
+    pixels = np.ones(CHUNK_PIXELS, dtype=np.uint8)
+    for (lvl, i, j) in tiles:
+        store.save(Chunk(lvl, i, j, pixels))
+    return DecodedTileCache(store, counters=counters)
+
+
+# -- predictor ------------------------------------------------------------
+
+
+def test_predictor_pure_pan_extrapolates_exactly():
+    # Steady +1 pan along index_real at a fixed level: predictions are
+    # exactly the next tiles on the line, nearest first.
+    traj = obs_at([(8, i, 3) for i in range(4)])
+    assert predict_tiles(traj, horizon=3) == [(8, 4, 3), (8, 5, 3),
+                                              (8, 6, 3)]
+
+
+def test_predictor_diagonal_pan():
+    traj = obs_at([(8, i, i) for i in range(2, 6)])
+    assert predict_tiles(traj, horizon=2) == [(8, 6, 6), (8, 7, 7)]
+
+
+def test_predictor_stationary_returns_nothing():
+    traj = obs_at([(8, 4, 4)] * 5)
+    assert predict_tiles(traj, horizon=3) == []
+
+
+def test_predictor_needs_two_observations_and_advancing_clock():
+    assert predict_tiles(obs_at([(8, 1, 1)]), horizon=3) == []
+    frozen = (ViewportObs(5.0, 8, 1, 1), ViewportObs(5.0, 8, 2, 1))
+    assert predict_tiles(frozen, horizon=3) == []
+
+
+def test_predictor_zoom_rescales_pan_onto_target_grid():
+    # Doubling the level each step: predictions land on the finer grid,
+    # not on level-8 indices carried verbatim.
+    traj = (ViewportObs(0.0, 4, 2, 2), ViewportObs(1.0, 8, 4, 4))
+    predicted = predict_tiles(traj, horizon=1)
+    assert predicted == [(12, 6, 6)]
+
+
+def test_predictor_dedups_current_tile_and_repeats():
+    # A slow pan (1 tile per 4 steps) predicts sub-tile drift: steps that
+    # round back onto the current tile are dropped, and a repeated target
+    # is emitted once.
+    traj = obs_at([(8, 0, 3), (8, 0, 3), (8, 0, 3), (8, 1, 3), (8, 1, 3)])
+    assert predict_tiles(traj, horizon=3) == [(8, 2, 3)]
+
+
+# -- session table: issuance, TTL, LRU ------------------------------------
+
+
+def test_table_issues_monotonic_nonzero_ids():
+    table = SessionTable(counters=Counters())
+    a, b = table.open(0), table.open(0)
+    assert (a.session_id, b.session_id) == (1, 2)
+    assert table.touch(1) is a
+    assert table.touch(999) is None
+
+
+def test_table_ttl_expires_lazily_on_touch():
+    clock = ManualClock()
+    counters = Counters()
+    table = SessionTable(ttl=10.0, clock=clock.now, counters=counters)
+    sid = table.open(0).session_id
+    clock.advance(10.0)  # exactly ttl: still alive (strict >)
+    assert table.touch(sid) is not None
+    clock.advance(10.5)
+    assert table.touch(sid) is None
+    assert counters.get(obs_names.SESSION_EXPIRED) == 1
+    assert len(table) == 0
+
+
+def test_table_touch_refreshes_idle_clock():
+    clock = ManualClock()
+    table = SessionTable(ttl=10.0, clock=clock.now, counters=Counters())
+    sid = table.open(0).session_id
+    for _ in range(5):
+        clock.advance(8.0)
+        assert table.touch(sid) is not None  # kept alive by activity
+
+
+def test_table_sweep_expires_in_bulk():
+    clock = ManualClock()
+    counters = Counters()
+    table = SessionTable(ttl=10.0, clock=clock.now, counters=counters)
+    for _ in range(3):
+        table.open(0)
+    clock.advance(11.0)
+    survivor = table.open(0).session_id
+    assert table.sweep() == 3
+    assert counters.get(obs_names.SESSION_EXPIRED) == 3
+    assert table.touch(survivor) is not None
+
+
+def test_table_capacity_evicts_least_recently_touched():
+    counters = Counters()
+    table = SessionTable(capacity=2, ttl=None, counters=counters)
+    a = table.open(0).session_id
+    b = table.open(0).session_id
+    table.touch(a)  # b is now LRU
+    c = table.open(0).session_id
+    assert counters.get(obs_names.SESSION_EVICTED) == 1
+    assert table.touch(b) is None
+    assert table.touch(a) is not None and table.touch(c) is not None
+
+
+def test_table_varz_counts():
+    table = SessionTable(capacity=8, ttl=300.0, counters=Counters())
+    table.open(0)
+    table.open(0)
+    varz = table.varz()
+    assert varz["active"] == 2 and varz["issued"] == 2
+    assert varz["opened"] == 2 and varz["evicted"] == 0
+
+
+# -- per-session fairness budgets -----------------------------------------
+
+
+def test_session_budget_throttles_and_refills_on_injected_clock():
+    clock = ManualClock()
+    state = SessionState(1, 0, rate=2.0, burst=2.0, clock=clock.now)
+    assert state.admit() and state.admit()
+    assert not state.admit()  # burst exhausted
+    clock.advance(1.0)  # refill 2 tokens
+    assert state.admit() and state.admit()
+    assert not state.admit()
+
+
+def test_session_weight_scales_rate_and_burst():
+    clock = ManualClock()
+    heavy = SessionState(1, 0, weight=2.0, rate=2.0, burst=2.0,
+                         clock=clock.now)
+    admitted = sum(heavy.admit() for _ in range(10))
+    assert admitted == 4  # burst * weight
+    clock.advance(1.0)
+    assert sum(heavy.admit() for _ in range(10)) == 4  # rate * weight
+
+
+def test_session_no_rate_admits_everything():
+    state = SessionState(1, 0, rate=None)
+    assert all(state.admit() for _ in range(1000))
+
+
+# -- prefetch marks + planner ---------------------------------------------
+
+
+def test_prefetch_marks_consume_once():
+    state = SessionState(1, proto.SESSION_CAP_PREFETCH)
+    assert state.mark_prefetched((8, 1, 1))
+    assert not state.mark_prefetched((8, 1, 1))  # no replanning
+    assert state.consume_prefetch((8, 1, 1))
+    assert not state.consume_prefetch((8, 1, 1))  # hit scored once
+
+
+def test_planner_marks_all_predictions_but_returns_only_cold_keys():
+    clock = ManualClock()
+    counters = Counters()
+    # (8, 4, 3) is already resident in tier 1; (8, 5, 3) and (8, 6, 3)
+    # are cold.
+    cache = make_cache(tiles=[(8, 4, 3)], counters=counters)
+    assert cache.load((8, 4, 3)) is not None
+    planner = PrefetchPlanner(cache, counters=counters)
+    state = SessionState(1, proto.SESSION_CAP_PREFETCH, clock=clock.now)
+    for i in range(4):
+        state.observe(8, i, 3, float(i))
+    picked = planner.plan(state)
+    # The resident tile is marked (prediction accuracy counts it) but
+    # not picked for warming.
+    assert picked == [(8, 5, 3), (8, 6, 3)]
+    assert counters.get(obs_names.PREFETCH_PLANNED) == 3
+    assert state.consume_prefetch((8, 4, 3))
+    # Replanning the same trajectory marks nothing new.
+    assert planner.plan(state) == []
+
+
+def test_planner_drops_out_of_range_predictions():
+    # Pan off the grid edge: predictions past index 7 at level 8 are
+    # discarded, not marked.
+    cache = make_cache()
+    planner = PrefetchPlanner(cache, counters=Counters())
+    state = SessionState(1, proto.SESSION_CAP_PREFETCH)
+    for k, i in enumerate(range(4, 8)):
+        state.observe(8, i, 0, float(k))
+    assert planner.plan(state) == []
+
+
+def test_planner_execute_warms_cache_and_schedules_cold_compute():
+    import asyncio
+    clock = ManualClock()
+    counters = Counters()
+    cache = make_cache(tiles=[(8, 5, 3)], counters=counters)
+    sched = TileScheduler(SETTINGS, clock=clock)
+    planner = PrefetchPlanner(cache, scheduler=sched, counters=counters)
+    asyncio.run(planner.execute([(8, 5, 3), (8, 6, 3)]))
+    assert counters.get(obs_names.PREFETCH_WARMED) == 1
+    assert cache.contains((8, 5, 3))
+    assert counters.get(obs_names.PREFETCH_SCHEDULED) == 1
+    # The scheduled tile is at the frontier head, at full depth.
+    w = sched.acquire()
+    assert w == Workload(8, 100, 6, 3)
+
+
+# -- progressive refinement ----------------------------------------------
+
+
+def test_scheduler_refine_uncompletes_and_regrants_at_depth():
+    clock = ManualClock()
+    sched = TileScheduler(SETTINGS, clock=clock)
+    shallow = sched.acquire()
+    assert sched.complete(shallow)
+    done = sched.completed_count
+    deep = Workload(shallow.level, 5000, shallow.index_real,
+                    shallow.index_imag)
+    assert sched.refine(deep)
+    assert sched.completed_count == done - 1
+    regrant = sched.acquire()
+    assert regrant == deep  # frontier head, at the refined depth
+    assert sched.complete(regrant)
+    assert sched.completed_count == done
+
+
+def test_scheduler_refine_rejects_out_of_grid():
+    sched = TileScheduler(SETTINGS, clock=ManualClock())
+    assert not sched.refine(Workload(16, 100, 0, 0))
+
+
+def test_refinement_tracker_idempotent_until_saved():
+    clock = ManualClock()
+    counters = Counters()
+    sched = TileScheduler(SETTINGS, clock=clock)
+    tracker = RefinementTracker(sched, counters=counters)
+    deep = Workload(8, 5000, 2, 2)
+    assert tracker.schedule(deep)
+    assert tracker.schedule(deep)  # in flight: no double-queue
+    assert counters.get(obs_names.SESSION_REFINES_SCHEDULED) == 1
+    assert tracker.pending == 1
+    tracker.on_saved((8, 9, 9))  # unrelated save: ignored
+    assert tracker.pending == 1
+    tracker.on_saved(deep.key)
+    assert tracker.pending == 0
+    assert counters.get(obs_names.SESSION_REFINES_COMPLETED) == 1
+    tracker.on_saved(deep.key)  # completion counted once
+    assert counters.get(obs_names.SESSION_REFINES_COMPLETED) == 1
+
+
+def test_cache_invalidation_drops_shallow_variants():
+    counters = Counters()
+    cache = make_cache(tiles=[(8, 1, 1)], counters=counters)
+    assert cache.load((8, 1, 1)) is not None
+    assert cache.invalidate((8, 1, 1))
+    assert not cache.contains((8, 1, 1))
+    assert not cache.invalidate((8, 1, 1))  # second drop is a no-op
+    assert counters.get(obs_names.TILE_CACHE_INVALIDATIONS) == 1
+
+    rendered = RenderedTileCache(counters=counters)
+    rendered.put((8, 1, 1, 0), b"png0")
+    rendered.put((8, 1, 1, 1), b"png1")
+    rendered.put((8, 2, 2, 0), b"keep")
+    assert rendered.invalidate_tile((8, 1, 1)) == 2  # every colormap
+    assert rendered.get((8, 2, 2, 0)) == b"keep"
+    assert counters.get(
+        obs_names.GATEWAY_RENDER_CACHE_INVALIDATIONS) == 2
+
+
+# -- session service facade ----------------------------------------------
+
+
+def test_service_negotiates_caps_from_construction():
+    cache = make_cache()
+    # No scheduler: prefetch-by-warming only, refine negotiated away.
+    read_only = build_session_service(cache, counters=Counters())
+    assert read_only.caps == proto.SESSION_CAP_PREFETCH
+    full = build_session_service(cache, scheduler=TileScheduler(
+        SETTINGS, clock=ManualClock()), counters=Counters())
+    assert full.caps == proto.SESSION_CAPS_MASK
+    # Requested ∩ granted.
+    state = read_only.open(proto.SESSION_CAPS_MASK)
+    assert state.caps == proto.SESSION_CAP_PREFETCH
+
+
+def test_service_scores_hits_and_misses_on_marked_tiles():
+    clock = ManualClock()
+    counters = Counters()
+    service = build_session_service(make_cache(counters=counters),
+                                    clock=clock.now, counters=counters)
+    state = service.open(proto.SESSION_CAP_PREFETCH)
+    for i in range(4):
+        clock.advance(1.0)
+        service.note_query(state, 8, i, 3)
+    # First two queries precede any prediction (cold misses); once the
+    # pan is established, each query lands on a marked tile.
+    assert counters.get(obs_names.PREFETCH_MISSES) == 2
+    assert counters.get(obs_names.PREFETCH_HITS) == 2
+    clock.advance(1.0)
+    service.note_query(state, 8, 4, 3)  # predicted continuation: hit
+    assert counters.get(obs_names.PREFETCH_HITS) == 3
+    clock.advance(1.0)
+    service.note_query(state, 8, 0, 0)  # swerve: miss
+    assert counters.get(obs_names.PREFETCH_HITS) == 3
+    assert counters.get(obs_names.PREFETCH_MISSES) == 3
+
+
+def test_service_without_prefetch_cap_scores_nothing():
+    clock = ManualClock()
+    counters = Counters()
+    service = build_session_service(make_cache(counters=counters),
+                                    clock=clock.now, counters=counters)
+    state = service.open(0)  # prefetch not requested
+    for i in range(5):
+        clock.advance(1.0)
+        assert service.note_query(state, 8, i, 3) == []
+    assert counters.get(obs_names.PREFETCH_HITS) == 0
+    assert counters.get(obs_names.PREFETCH_MISSES) == 0
+
+
+def test_service_first_paint_iter_gating():
+    cache = make_cache()
+    sched = TileScheduler(SETTINGS, clock=ManualClock())
+    service = build_session_service(cache, scheduler=sched,
+                                    first_paint_max_iter=64,
+                                    counters=Counters())
+    assert service.first_paint_iter(2500) == 64
+    assert service.first_paint_iter(64) is None  # already that cheap
+    assert service.first_paint_iter(None) is None  # unknown level
+    read_only = build_session_service(cache, counters=Counters())
+    assert read_only.first_paint_iter(2500) is None
+
+
+# -- trajectory workload model --------------------------------------------
+
+
+def test_reflect_bounces_inside_grid():
+    level = 8
+    walk = [_reflect(x, level) for x in range(-3, 3 * level)]
+    assert all(0 <= x < level for x in walk)
+    # A straight pan folds into ... 6 7 7 6 ... — adjacent positions
+    # never jump more than one tile (no teleports to poison velocity).
+    assert all(abs(a - b) <= 1 for a, b in zip(walk, walk[1:]))
+
+
+def test_session_schedule_is_deterministic_and_correlated():
+    phases = parse_phases("steady:50x2")
+    kwargs = dict(level=8, sessions=4, seed=7, hot_share=0.0)
+    a = build_session_schedule(phases, **kwargs)
+    assert a == build_session_schedule(phases, **kwargs)
+    assert {r.session for r in a} <= set(range(4))
+    assert all(proto.query_in_range(r.level, r.index_real, r.index_imag)
+               for r in a)
+    # Per-session streams are straight-line pans: consecutive queries of
+    # one session move at most one tile per axis.
+    for slot in range(4):
+        stream = [r for r in a if r.session == slot]
+        for prev, cur in zip(stream, stream[1:]):
+            assert abs(cur.index_real - prev.index_real) <= 1
+            assert abs(cur.index_imag - prev.index_imag) <= 1
+
+
+def test_session_schedule_hot_share_skews_to_slot_zero():
+    phases = parse_phases("steady:200x2")
+    schedule = build_session_schedule(phases, level=8, sessions=8,
+                                      seed=0, hot_share=0.6)
+    hot = sum(1 for r in schedule if r.session == 0)
+    assert hot / len(schedule) > 0.5
+
+
+def test_session_schedule_validates_inputs():
+    phases = parse_phases("steady:10x1")
+    with pytest.raises(ValueError):
+        build_session_schedule(phases, level=8, sessions=0)
+    with pytest.raises(ValueError):
+        build_session_schedule(phases, level=8, sessions=2, hot_share=1.0)
+
+
+def test_ok_spread_counts_absent_slots_as_zero():
+    assert ok_spread({0: 10, 2: 4}, 4) == (0, 10)
+    assert ok_spread({}, 3) == (0, 0)
