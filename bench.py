@@ -2186,6 +2186,159 @@ def bench_shards(repeats: int, *, levels: str = "64:100",
     return out
 
 
+def bench_obs(repeats: int, *, levels: str = "64:100", n_shards: int = 2,
+              clients: int = 2, duration: float = 3.0, batch: int = 32,
+              scrape_period: float = 2.0) -> dict:
+    """Observability overhead (no accelerator): grant-path throughput
+    of a 2-shard farm under grant storm, measured bare vs with the full
+    fleet plane attached — a FleetAggregator pulling every shard's
+    ``/varz`` + ``/timeseries`` and merging ``snapshot()`` at the
+    deployment-default scrape period.  The shards run their samplers
+    and SLO loops in BOTH legs (they are on whenever an exporter is),
+    so the delta isolates what watching a farm costs the farm: serving
+    scrapes.
+
+    Per repeat the legs run back-to-back on fresh subprocess fleets;
+    the reported rates are each leg's best repeat (the storm numbers
+    are noisy on shared CI boxes, and overhead is a property of the
+    fast path, not of scheduler jitter).  Note ``cpu_count``: on a
+    1-core box the aggregator thread time-slices against the very
+    storm it watches, so the measured delta is an upper bound on real
+    fleet overhead.  The acceptance gate is ``overhead_pct < 1``.
+    """
+    import os
+    import subprocess
+    import tempfile
+    import threading
+
+    from distributedmandelbrot_tpu.obs.fleet import FleetAggregator
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    driver = "distributedmandelbrot_tpu.chaos.driver"
+
+    def _env() -> dict:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep \
+            + env.get("PYTHONPATH", "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        return env
+
+    def spawn_shard(tmp: str, leg: str, k: int
+                    ) -> tuple[subprocess.Popen, str]:
+        port_file = os.path.join(tmp, f"{leg}-ports-{k}.json")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", driver, "shard",
+             os.path.join(tmp, f"farm-{leg}"), port_file, levels,
+             str(k), str(n_shards),
+             "--lease-timeout", "0.05", "--sweep-period", "0.02",
+             "--checkpoint-period", "0"],
+            env=_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        return proc, port_file
+
+    def read_ports(proc: subprocess.Popen, port_file: str) -> dict:
+        deadline = time.monotonic() + 30.0
+        while not os.path.exists(port_file):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"shard died during startup (exit {proc.returncode})")
+            if time.monotonic() > deadline:
+                raise RuntimeError("shard never wrote its port file")
+            time.sleep(0.05)
+        with open(port_file, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def run_leg(tmp: str, leg: str, observed: bool
+                ) -> tuple[float, int, dict]:
+        from distributedmandelbrot_tpu.control.ring import (HashRing,
+                                                            ShardInfo)
+        shards = [spawn_shard(tmp, leg, k) for k in range(n_shards)]
+        scrapes = [0]
+        stop = threading.Event()
+        scraper = None
+        snap: dict = {}
+        try:
+            infos = [read_ports(p, f) for p, f in shards]
+            ring_path = os.path.join(tmp, f"ring-{leg}.json")
+            HashRing([ShardInfo("127.0.0.1",
+                                distributer_port=i["distributer"],
+                                dataserver_port=i["dataserver"],
+                                exporter_port=i["exporter"])
+                      for i in infos], version=1).save(ring_path)
+            agg = None
+            if observed:
+                agg = FleetAggregator(
+                    [f"shard@127.0.0.1:{i['exporter']}" for i in infos],
+                    rate_window=30.0, timeout=1.0)
+
+                def _scrape_loop() -> None:
+                    while not stop.is_set():
+                        agg.scrape_once()
+                        agg.snapshot()
+                        scrapes[0] += 1
+                        stop.wait(scrape_period)
+
+                scraper = threading.Thread(target=_scrape_loop,
+                                           daemon=True)
+                scraper.start()
+            outs, procs = [], []
+            for c in range(clients):
+                out_path = os.path.join(tmp, f"{leg}-drain-{c}.json")
+                outs.append(out_path)
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", driver, "drain", ring_path,
+                     "--duration", str(duration), "--batch", str(batch),
+                     "--out", out_path],
+                    env=_env(), stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+            grants, slowest = 0, 0.0
+            for proc, out_path in zip(procs, outs):
+                proc.wait(timeout=duration + 60.0)
+                with open(out_path, "r", encoding="utf-8") as f:
+                    rep = json.load(f)
+                grants += rep["grants"]
+                slowest = max(slowest, rep["seconds"])
+            if agg is not None:
+                snap = agg.snapshot()
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=10.0)
+            for proc, _ in shards:
+                proc.kill()
+                proc.wait()
+        return (grants / slowest if slowest else 0.0), scrapes[0], snap
+
+    out: dict = {"config": "obs", "levels": levels, "n_shards": n_shards,
+                 "clients": clients, "duration_s": duration,
+                 "scrape_period_s": scrape_period,
+                 "cpu_count": os.cpu_count(), "repeats": repeats}
+    base_rates, observed_rates, scrape_counts = [], [], []
+    last_snap: dict = {}
+    with tempfile.TemporaryDirectory(prefix="dmtpu-obsbench-") as tmp:
+        for r in range(repeats):
+            rate, _, _ = run_leg(tmp, f"base{r}", observed=False)
+            base_rates.append(rate)
+            rate, n_scrapes, snap = run_leg(tmp, f"obs{r}", observed=True)
+            observed_rates.append(rate)
+            scrape_counts.append(n_scrapes)
+            if snap:
+                last_snap = snap
+    base = max(base_rates)
+    observed = max(observed_rates)
+    overhead = (base - observed) / base * 100.0 if base else 0.0
+    out["grants_per_s_bare"] = round(base, 1)
+    out["grants_per_s_observed"] = round(observed, 1)
+    out["scrapes_per_leg"] = scrape_counts
+    out["overhead_pct"] = round(overhead, 2)
+    out["overhead_under_1pct"] = overhead < 1.0
+    out["fleet_totals"] = last_snap.get("totals", {})
+    out["fleet_roles"] = {role: doc.get("healthy", 0)
+                          for role, doc in
+                          (last_snap.get("roles") or {}).items()}
+    return out
+
+
 def _ensure_live_backend(probe_timeout: float = 120.0) -> bool:
     """Guard against a dead accelerator tunnel: on this rig the TPU is
     reached through a network tunnel whose failure mode is jax backend
@@ -2286,6 +2439,11 @@ def main() -> int:
                              "(aggregate grant throughput at 1/2/4 "
                              "coordinator shards, restart-to-first-grant "
                              "under live load; no accelerator needed)")
+    parser.add_argument("--obs", action="store_true",
+                        help="run only the observability-overhead config "
+                             "(grant throughput bare vs under aggressive "
+                             "fleet scraping; gate: overhead < 1%%; no "
+                             "accelerator needed)")
     parser.add_argument("--sessions", action="store_true",
                         help="run only the interactive-sessions config "
                              "(trajectory storm: prefetch hit ratio + "
@@ -2293,6 +2451,10 @@ def main() -> int:
                              "first-paint vs full-depth latency with a "
                              "numpy farm; no accelerator needed)")
     args = parser.parse_args()
+    if args.obs:
+        # Grant path + HTTP scrape plane only — no accelerator probe.
+        print(json.dumps(bench_obs(args.repeats)), flush=True)
+        return 0
     if args.sessions:
         # Session wire + numpy farm only — no accelerator probe.
         print(json.dumps(bench_sessions(args.repeats)), flush=True)

@@ -157,6 +157,10 @@ class ChaosReport:
     # to that shard's first lease grant (its /varz workloads_granted).
     restart_to_first_grant_s: list = field(default_factory=list)
     failures: list = field(default_factory=list)
+    # Fleet snapshot (obs/fleet.py) scraped from the live shards just
+    # before graceful teardown — the per-shard/per-worker rates a chaos
+    # postmortem wants next to the invariant verdicts.
+    fleet: dict = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=1,
@@ -308,7 +312,9 @@ class ChaosRunner:
                                    distributer_port=info.get(
                                        "distributer", 0),
                                    dataserver_port=info.get(
-                                       "dataserver", 0)))
+                                       "dataserver", 0),
+                                   exporter_port=info.get(
+                                       "exporter", 0)))
         HashRing(infos, version=1).save(self.ring_path)
 
     # -- observation -------------------------------------------------------
@@ -351,6 +357,33 @@ class ChaosRunner:
                 return json.loads(resp.read().decode("utf-8"))
         except Exception:
             return None
+
+    def _capture_fleet(self) -> dict:
+        """A fleet snapshot (obs/fleet.py) over the still-live shards.
+
+        Best-effort by design: the scenario verdict rests on the
+        invariant audit, and a dead exporter at teardown time is a
+        normal chaos outcome, not a reason to fail the report.  Two
+        scrape rounds a beat apart give the aggregator the pair of
+        samples it needs for rates.
+        """
+        from distributedmandelbrot_tpu.obs.fleet import FleetAggregator
+        peers = []
+        for slot in self.coords:
+            port = (slot.info or {}).get("exporter")
+            if slot.alive and port:
+                peers.append(f"shard@127.0.0.1:{port}")
+        if not peers:
+            return {}
+        try:
+            agg = FleetAggregator(peers, timeout=1.0)
+            agg.scrape_once()
+            time.sleep(0.25)
+            agg.scrape_once()
+            return agg.snapshot()
+        except Exception as e:
+            self._log(f"fleet snapshot failed: {e!r}")
+            return {}
 
     @staticmethod
     def _granted(varz: dict) -> int:
@@ -509,6 +542,7 @@ class ChaosRunner:
                 f"deadline: {len(self._last_scan & self.expected)}/"
                 f"{len(self.expected)} tiles after {sc.deadline:.0f}s")
 
+        fleet_snapshot = self._capture_fleet()
         self._stop_workers()
         self._stop_coords()
         self._check_invariants()
@@ -527,7 +561,8 @@ class ChaosRunner:
             kills=self.kill_count,
             restarts=self.restart_count,
             restart_to_first_grant_s=self.blips,
-            failures=list(self.failures))
+            failures=list(self.failures),
+            fleet=fleet_snapshot)
         self._log(f"scenario {sc.name}: "
                   f"{'OK' if report.ok else 'FAILED'} in "
                   f"{report.duration_s:.1f}s ({report.kills} kills, "

@@ -611,6 +611,12 @@ def cmd_serve(argv: Sequence[str]) -> int:
                         default=proto.DEFAULT_EXPORTER_PORT,
                         help="HTTP metrics port (/metrics, /varz, "
                              "/healthz); 0 = ephemeral, -1 disables")
+    parser.add_argument("--sample-period", type=float, default=2.0,
+                        help="seconds between /timeseries snapshots of "
+                             "the registry")
+    parser.add_argument("--history-window", type=float, default=600.0,
+                        help="seconds of timeseries history kept in the "
+                             "ring buffer")
     parser.add_argument("--no-info-log", action="store_true")
     _add_common(parser)
     args = parser.parse_args(argv)
@@ -630,6 +636,8 @@ def cmd_serve(argv: Sequence[str]) -> int:
             read_timeout=None if args.no_read_timeout else args.read_timeout,
             fsync_index=args.fsync_index, stats_period=args.stats_period,
             checkpoint_period=args.checkpoint_period,
+            sample_period=args.sample_period,
+            history_window=args.history_window,
             gateway_port=args.gateway_port,
             gateway_cache_tiles=args.cache_tiles,
             gateway_render_tiles=args.render_cache_tiles,
@@ -742,6 +750,12 @@ def cmd_worker(argv: Sequence[str]) -> int:
                              "snapshot and pipeline stage stats to PATH as "
                              "JSON (how bench.py --farm-workers collects "
                              "per-subprocess wire/lane metrics)")
+    parser.add_argument("--exporter-port", type=int, default=-1,
+                        help="HTTP metrics port for this worker (/varz, "
+                             "/timeseries); 0 = ephemeral, -1 (default) "
+                             "disables — workers stay fleet-visible "
+                             "through span-reported stats on their "
+                             "shards' /varz either way")
     parser.add_argument("--reconnect", type=int, default=0, metavar="N",
                         help="redial the coordinator up to N times per "
                              "exchange on connection failure (capped "
@@ -866,6 +880,20 @@ def cmd_worker(argv: Sequence[str]) -> int:
                     grant_batch=args.grant_batch,
                     use_session=not args.no_session,
                     ring=ring)
+    exporter = None
+    if args.exporter_port >= 0:
+        from distributedmandelbrot_tpu.obs.exporter import ExporterThread
+        from distributedmandelbrot_tpu.obs.timeseries import \
+            TimeseriesSampler
+        exporter = ExporterThread(
+            worker.counters.registry,
+            sampler=TimeseriesSampler(worker.counters.registry),
+            varz_extra=lambda: {
+                "role": "worker",
+                "worker_id": format(worker.spans.worker_id, "016x")},
+            port=args.exporter_port)
+        exporter.start()
+        print(f"worker exporter on port {exporter.port}", flush=True)
     profiling = False
     if args.profile:
         import jax
@@ -908,6 +936,8 @@ def cmd_worker(argv: Sequence[str]) -> int:
               f"({e})", file=sys.stderr)
         return 1
     finally:
+        if exporter is not None:
+            exporter.stop()
         if profiling:
             import jax
             jax.profiler.stop_trace()
@@ -1970,6 +2000,12 @@ def cmd_coord(argv: Sequence[str]) -> int:
     parser.add_argument("--exporter-port", type=int, default=0,
                         help="HTTP metrics port; 0 = ephemeral, "
                              "-1 disables")
+    parser.add_argument("--sample-period", type=float, default=2.0,
+                        help="seconds between /timeseries snapshots of "
+                             "the registry")
+    parser.add_argument("--history-window", type=float, default=600.0,
+                        help="seconds of timeseries history kept in the "
+                             "ring buffer")
     _add_common(parser)
     args = parser.parse_args(argv)
     _configure_logging(args)
@@ -1994,6 +2030,8 @@ def cmd_coord(argv: Sequence[str]) -> int:
             fsync_index=args.fsync_index,
             checkpoint_period=args.checkpoint_period,
             stats_period=args.stats_period,
+            sample_period=args.sample_period,
+            history_window=args.history_window,
             exporter_port=(None if args.exporter_port < 0
                            else args.exporter_port))
     except (RingConfigError, DataDirError, LevelOwnedError) as e:
@@ -2096,6 +2134,253 @@ def cmd_chaos(argv: Sequence[str]) -> int:
     return 0 if report.ok else 1
 
 
+def _parse_peer_args(args: argparse.Namespace) -> list:
+    """--peers/--ring -> ``[role@]host:port`` peer specs for the
+    aggregator (shared by cmd_top's direct mode and the smoke farm)."""
+    peers: list = []
+    for chunk in args.peers or []:
+        peers.extend(s for s in (p.strip() for p in chunk.split(","))
+                     if s)
+    if args.ring:
+        from distributedmandelbrot_tpu.control.ring import (HashRing,
+                                                            RingConfigError)
+        try:
+            ring = HashRing.load(args.ring)
+        except RingConfigError as e:
+            raise SystemExit(f"dmtpu top: {e}")
+        for info in ring.shards:
+            if info.exporter_port:
+                peers.append(f"shard@{info.host}:{info.exporter_port}")
+    return peers
+
+
+def _fetch_fleet_doc(url: str, timeout: float = 5.0) -> dict:
+    """One /fleet document from a running FleetService/exporter."""
+    import json as _json
+
+    from distributedmandelbrot_tpu.obs.fleet import ScrapeError, http_fetch
+    base = url if "://" in url else "http://" + url
+    body = http_fetch(base.rstrip("/") + "/fleet", timeout)
+    doc = _json.loads(body.decode("utf-8", errors="replace"))
+    if not isinstance(doc, dict):
+        raise ScrapeError(f"/fleet returned {type(doc).__name__}")
+    return doc
+
+
+def _top_smoke(args) -> int:
+    """Throwaway jax-free farm (2 shards + 2 numpy workers via the
+    chaos driver, 1 in-process gateway replica), one dashboard frame
+    against it, and hard assertions that every role reports: the CI
+    proof that the whole observability plane is wired end to end."""
+    import json as _json
+    import shutil
+    import subprocess
+    import tempfile
+
+    from distributedmandelbrot_tpu.control.ring import HashRing, ShardInfo
+    from distributedmandelbrot_tpu.loadgen.driver import GatewayDriver
+    from distributedmandelbrot_tpu.loadgen.replicas import GatewayFleet
+    from distributedmandelbrot_tpu.obs.fleet import FleetAggregator
+    from distributedmandelbrot_tpu.obs.top import render_top
+    from distributedmandelbrot_tpu.storage.backends import MemoryObjectStore
+
+    n_shards, n_workers, levels = 2, 2, "3:2"
+    root = tempfile.mkdtemp(prefix="dmtpu-top-smoke-")
+    procs: list = []
+    fleet_gw = None
+    failures: list[str] = []
+    try:
+        data_dir = f"{root}/farm"
+        ring_path = f"{root}/ring.json"
+        port_files = [f"{root}/shard-{k}.ports" for k in range(n_shards)]
+        for k in range(n_shards):
+            cmd = [sys.executable, "-m",
+                   "distributedmandelbrot_tpu.chaos.driver", "shard",
+                   data_dir, port_files[k], levels, str(k), str(n_shards),
+                   "--lease-timeout", "30", "--sweep-period", "0.5",
+                   "--checkpoint-period", "0.5"]
+            with open(f"{root}/shard-{k}.log", "w", encoding="utf-8") as lf:
+                procs.append(subprocess.Popen(cmd, stdout=lf, stderr=lf))
+        deadline = time.monotonic() + 30.0
+        infos = []
+        for k in range(n_shards):
+            while True:
+                try:
+                    with open(port_files[k], "r", encoding="utf-8") as f:
+                        infos.append(_json.load(f))
+                    break
+                except (OSError, ValueError):
+                    if time.monotonic() > deadline:
+                        raise SystemExit(
+                            f"dmtpu top --smoke: shard {k} never "
+                            f"published its ports (see {root})")
+                    time.sleep(0.05)
+        HashRing([ShardInfo("127.0.0.1",
+                            distributer_port=i["distributer"],
+                            dataserver_port=i["dataserver"],
+                            exporter_port=i["exporter"])
+                  for i in infos], version=1).save(ring_path)
+        for _ in range(n_workers):
+            cmd = [sys.executable, "-m",
+                   "distributedmandelbrot_tpu.chaos.driver", "worker",
+                   ring_path, "--poll-interval", "0.2"]
+            with open(f"{root}/worker.log", "a", encoding="utf-8") as lf:
+                procs.append(subprocess.Popen(cmd, stdout=lf, stderr=lf))
+        # The read tier: one exporter-bearing gateway replica over an
+        # (empty) shared object store — misses still count queries and
+        # time the request histogram, which is all the dashboard needs.
+        fleet_gw = GatewayFleet(MemoryObjectStore(), replicas=1,
+                                exporter=True).start()
+        gw_peer = f"gateway@127.0.0.1:{fleet_gw.exporter_ports[0]}"
+        agg = FleetAggregator(
+            [f"shard@127.0.0.1:{i['exporter']}" for i in infos]
+            + [gw_peer], rate_window=30.0)
+        driver = GatewayDriver(fleet_gw.addresses)
+
+        async def _storm(n: int) -> None:
+            for i in range(n):
+                await driver(3, i % 8, (i // 8) % 8)
+
+        snap: dict = {}
+        probe_deadline = time.monotonic() + 60.0
+        while time.monotonic() < probe_deadline:
+            asyncio.run(_storm(6))
+            agg.scrape_once()
+            snap = agg.snapshot()
+            roles = snap.get("roles", {})
+            if (snap["totals"]["grants_per_s"] > 0
+                    and snap["totals"]["queries_per_s"] > 0
+                    and snap.get("workers")
+                    and "shard" in roles and "gateway" in roles):
+                break
+            time.sleep(1.0)
+        print(render_top(snap, color=False), flush=True)
+
+        roles = snap.get("roles", {})
+        totals = snap.get("totals", {})
+        if roles.get("shard", {}).get("healthy", 0) != n_shards:
+            failures.append(f"expected {n_shards} healthy shard peers, "
+                            f"got {roles.get('shard')}")
+        if roles.get("gateway", {}).get("healthy", 0) < 1:
+            failures.append(f"no healthy gateway peer: "
+                            f"{roles.get('gateway')}")
+        if not snap.get("workers"):
+            failures.append("no span-reported worker rows")
+        if not totals.get("grants_per_s", 0) > 0:
+            failures.append(f"zero grant rate: {totals}")
+        if not totals.get("queries_per_s", 0) > 0:
+            failures.append(f"zero gateway query rate: {totals}")
+        if not any(g.get("queries_per_s", 0) > 0
+                   for g in snap.get("gateways", [])):
+            failures.append("no gateway row with a nonzero query rate")
+        for failure in failures:
+            print(f"SMOKE FAIL: {failure}", file=sys.stderr)
+        print(f"top smoke: {'OK' if not failures else 'FAILED'} — "
+              f"{len(snap.get('peers', []))} peers, "
+              f"{len(snap.get('workers', []))} workers, "
+              f"{totals.get('grants_per_s')} grants/s, "
+              f"{totals.get('queries_per_s')} q/s", flush=True)
+        return 0 if not failures else 1
+    finally:
+        if fleet_gw is not None:
+            fleet_gw.stop()
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def cmd_top(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu top",
+        description="Live fleet dashboard: scrape every exporter "
+                    "(--peers / --ring), or read a running /fleet "
+                    "endpoint (--fleet-url), and render per-role rates, "
+                    "SLO burn, and straggler flags.")
+    parser.add_argument("--peers", action="append", metavar="SPECS",
+                        help="comma-separated [role@]host:port exporter "
+                             "endpoints (repeatable)")
+    parser.add_argument("--ring", default=None, metavar="RING_JSON",
+                        help="scrape the exporter ports named in this "
+                             "ring config")
+    parser.add_argument("--fleet-url", default=None, metavar="URL",
+                        help="read an existing fleet aggregator's /fleet "
+                             "instead of scraping peers directly")
+    parser.add_argument("--once", action="store_true",
+                        help="print one frame and exit (CI mode)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw /fleet snapshot as JSON "
+                             "instead of the dashboard")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="seconds between frames (and scrapes)")
+    parser.add_argument("--window", type=float, default=60.0,
+                        help="trailing rate window in seconds")
+    parser.add_argument("--no-color", action="store_true",
+                        help="plain text (auto when stdout is not a tty)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="spawn a throwaway jax-free farm (2 shards, "
+                             "2 numpy workers, 1 gateway), render one "
+                             "frame against it, and assert every role "
+                             "reports — the CI end-to-end check")
+    _add_common(parser)
+    args = parser.parse_args(argv)
+    _configure_logging(args)
+
+    from distributedmandelbrot_tpu.obs.fleet import (FleetAggregator,
+                                                     ScrapeError)
+    from distributedmandelbrot_tpu.obs.top import render_frame
+
+    if args.smoke:
+        return _top_smoke(args)
+
+    color = not args.no_color and sys.stdout.isatty()
+    interval = max(0.2, args.interval)
+
+    if args.fleet_url:
+        def take_snapshot() -> dict:
+            return _fetch_fleet_doc(args.fleet_url)
+    else:
+        peers = _parse_peer_args(args)
+        if not peers:
+            parser.error("need --peers, --ring, or --fleet-url "
+                         "(or --smoke)")
+        agg = FleetAggregator(peers, rate_window=args.window)
+
+        def take_snapshot() -> dict:
+            agg.scrape_once()
+            return agg.snapshot()
+
+    try:
+        if args.once and not args.fleet_url:
+            # Rates need two scrape points: one warmup round, a beat,
+            # then the rendered snapshot.
+            take_snapshot()
+            time.sleep(min(interval, 1.0))
+        while True:
+            try:
+                snap = take_snapshot()
+            except (ScrapeError, OSError, ValueError) as e:
+                if args.once:
+                    raise SystemExit(f"dmtpu top: {e}")
+                snap = {"peers": [], "error": str(e)}
+            if args.json:
+                import json as _json
+                print(_json.dumps(snap, indent=1, sort_keys=True))
+            else:
+                sys.stdout.write(render_frame(snap, color=color,
+                                              clear=not args.once))
+                sys.stdout.flush()
+            if args.once:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 class _NoFile:
     """Stand-in for findings on unparseable files (no suppressions)."""
 
@@ -2112,7 +2397,7 @@ COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
             "animate": cmd_animate, "compact": cmd_compact,
             "stats": cmd_stats, "trace": cmd_trace, "admin": cmd_admin,
             "check": cmd_check, "loadgen": cmd_loadgen,
-            "coord": cmd_coord, "chaos": cmd_chaos}
+            "coord": cmd_coord, "chaos": cmd_chaos, "top": cmd_top}
 
 
 def _enable_compile_cache() -> None:
@@ -2170,7 +2455,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m distributedmandelbrot_tpu "
               "{coordinator|coord|worker|serve|viewer|render|animate|"
-              "compact|stats|trace|admin|check|loadgen|chaos} [options]\n"
+              "compact|stats|trace|admin|check|loadgen|chaos|top} "
+              "[options]\n"
               "Run each subcommand with -h for its options.")
         return 0 if argv else 2
     cmd = argv[0]

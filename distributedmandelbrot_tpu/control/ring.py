@@ -77,12 +77,17 @@ class ShardInfo:
     distributer_port: int
     dataserver_port: int = 0
     gateway_port: int = 0
+    # Metrics exporter endpoint (0 = none bound): what the fleet
+    # aggregator scrapes; optional so pre-observability ring files keep
+    # loading, and ownership never depends on it.
+    exporter_port: int = 0
 
     def to_config(self) -> dict:
         return {"host": self.host,
                 "distributer_port": self.distributer_port,
                 "dataserver_port": self.dataserver_port,
-                "gateway_port": self.gateway_port}
+                "gateway_port": self.gateway_port,
+                "exporter_port": self.exporter_port}
 
     @classmethod
     def from_config(cls, doc: dict) -> "ShardInfo":
@@ -90,7 +95,8 @@ class ShardInfo:
             return cls(host=str(doc["host"]),
                        distributer_port=int(doc["distributer_port"]),
                        dataserver_port=int(doc.get("dataserver_port", 0)),
-                       gateway_port=int(doc.get("gateway_port", 0)))
+                       gateway_port=int(doc.get("gateway_port", 0)),
+                       exporter_port=int(doc.get("exporter_port", 0)))
         except (KeyError, TypeError, ValueError) as e:
             raise RingConfigError(f"bad shard entry {doc!r}: {e}") from None
 

@@ -80,7 +80,8 @@ class ShardedCoordinator:
         return ShardInfo(host,
                          distributer_port=self.coordinator.distributer_port,
                          dataserver_port=self.coordinator.dataserver_port,
-                         gateway_port=self.coordinator.gateway_port or 0)
+                         gateway_port=self.coordinator.gateway_port or 0,
+                         exporter_port=self.coordinator.exporter_port or 0)
 
     # -- delegated lifecycle ----------------------------------------------
 

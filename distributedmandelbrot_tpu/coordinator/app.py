@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional, Sequence
 
 from distributedmandelbrot_tpu.coordinator.clock import Clock
@@ -27,7 +28,10 @@ from distributedmandelbrot_tpu.net import protocol as proto
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.exporter import MetricsExporter
 from distributedmandelbrot_tpu.obs.metrics import Registry
+from distributedmandelbrot_tpu.obs.slo import standard_slos
 from distributedmandelbrot_tpu.obs.spans import SpanStore
+from distributedmandelbrot_tpu.obs.timeseries import (
+    DEFAULT_HISTORY_WINDOW, DEFAULT_SAMPLE_PERIOD, TimeseriesSampler)
 from distributedmandelbrot_tpu.obs.trace import TraceLog
 from distributedmandelbrot_tpu.serve.cache import DecodedTileCache
 from distributedmandelbrot_tpu.serve.gateway import TileGateway
@@ -69,6 +73,8 @@ class Coordinator:
                  ondemand_deadline: float = proto.DEFAULT_ONDEMAND_DEADLINE,
                  ondemand_poll_interval: float = 1.0,
                  exporter_port: Optional[int] = None,
+                 sample_period: float = DEFAULT_SAMPLE_PERIOD,
+                 history_window: float = DEFAULT_HISTORY_WINDOW,
                  accept_spans: bool = True,
                  accept_session: bool = True,
                  checkpoint_period: float = 0.0,
@@ -204,13 +210,28 @@ class Coordinator:
                 period=checkpoint_period, registry=self.registry,
                 pending_keys_fn=self.distributer.pending_save_keys,
                 namespace=namespace)
+            # Fleet observability: the ring-buffer sampler rides the
+            # exporter (no exporter, nobody can read the history), and
+            # gateway-bearing processes track the standard SLO pair on
+            # it; the obs loop (start()) advances both.
             self.exporter: Optional[MetricsExporter] = None
+            self.sampler: Optional[TimeseriesSampler] = None
+            self.slos: list = []
+            self._slo_status: list[dict] = []
+            self._worker_stats_cache: tuple[float, Optional[dict]] = \
+                (0.0, None)
             if exporter_port is not None:
+                self.sampler = TimeseriesSampler(
+                    self.registry, period=sample_period,
+                    window=history_window)
+                if gateway_port is not None:
+                    self.slos = standard_slos(self.sampler)
                 self.exporter = MetricsExporter(
                     self.registry, trace=self.trace,
                     spans=self.spans,
                     varz_extra=self._varz_extra,
                     checkpoint_cb=self.recovery.checkpoint,
+                    sampler=self.sampler,
                     host=host, port=exporter_port)
         except BaseException:
             # Construction failed after the claim: release it, or the
@@ -219,6 +240,7 @@ class Coordinator:
             raise
         self.stats_period = stats_period
         self._stats_task: Optional[asyncio.Task] = None
+        self._obs_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
         try:
@@ -252,8 +274,18 @@ class Coordinator:
         await self.recovery.start()
         if self.stats_period > 0:
             self._stats_task = asyncio.create_task(self._stats_loop())
+        if self.sampler is not None:
+            self._obs_task = asyncio.create_task(self._obs_loop())
 
     async def stop(self) -> None:
+        if self._obs_task is not None:
+            self._obs_task.cancel()
+            try:
+                await self._obs_task
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                logger.exception("obs sampling task had failed")
         if self._stats_task is not None:
             self._stats_task.cancel()
             try:
@@ -305,6 +337,23 @@ class Coordinator:
                 # Reporting must never kill itself (or shutdown, see stop).
                 logger.exception("stats reporting failed")
 
+    async def _obs_loop(self) -> None:
+        """Drive the timeseries sampler and the SLO state machines at
+        the sample period.  SLO evaluation happens HERE, not per /varz
+        request: the state machine must advance on one clock, not at
+        whatever rate scrapers poll."""
+        assert self.sampler is not None
+        while True:
+            await asyncio.sleep(self.sampler.period)
+            try:
+                self.sampler.sample()
+                if self.slos:
+                    self._slo_status = [slo.evaluate()
+                                        for slo in self.slos]
+            except Exception:
+                # Observability must never kill the services it watches.
+                logger.exception("obs sampling failed")
+
     async def run_forever(self) -> None:
         await self.start()
         try:
@@ -331,6 +380,8 @@ class Coordinator:
     def _varz_extra(self) -> dict:
         """Scheduler frontier state for /varz (beyond the gauge family)."""
         extra = {
+            "role": ("shard" if self.ring_slice is not None
+                     else "coordinator"),
             "scheduler": {
                 "frontier_depth": self.scheduler.frontier_depth,
                 "outstanding_leases": self.scheduler.outstanding_leases,
@@ -342,6 +393,11 @@ class Coordinator:
                 "checkpoint_period": self.recovery.period,
             },
         }
+        workers = self._worker_stats_cached()
+        if workers:
+            extra["workers"] = workers
+        if self._slo_status:
+            extra["slo"] = self._slo_status
         if self.sessions is not None:
             extra["sessions"] = self.sessions.varz()
         if self.ring_slice is not None:
@@ -352,3 +408,26 @@ class Coordinator:
                 "owned_tiles": self.scheduler.owned_tiles,
             }
         return extra
+
+    def _worker_stats_cached(self) -> dict:
+        """Span-reported per-worker roll-up, persist seconds joined from
+        the trace ring — what the fleet aggregator merges into its
+        worker table (workers need no exporter to be visible).
+
+        Cached for one sample period: the roll-up walks the full trace
+        ring and span store (milliseconds on a loaded coordinator, on
+        the event loop), and /varz is served per-scraper — a fleet of
+        aggregators polling must not multiply that walk.  Worker rates
+        are window deltas aggregator-side, so sample-period staleness
+        is invisible there."""
+        now = time.monotonic()
+        ttl = self.sampler.period if self.sampler is not None else 2.0
+        cached_at, cached = self._worker_stats_cache
+        if cached is not None and now - cached_at < ttl:
+            return cached
+        persist_by_key = {tuple(s["key"]): s.get("persist_s", 0.0)
+                          for s in self.trace.spans()
+                          if s.get("complete")}
+        workers = self.spans.per_worker_stats(persist_by_key)
+        self._worker_stats_cache = (now, workers)
+        return workers

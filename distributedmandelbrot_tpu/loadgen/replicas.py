@@ -48,9 +48,12 @@ class _Replica:
                  session_rate: Optional[float] = None,
                  session_burst: float = 32.0,
                  session_ttl: Optional[float] = 300.0,
-                 prefetch_horizon: int = 3) -> None:
+                 prefetch_horizon: int = 3,
+                 exporter: bool = False) -> None:
         self.counters = Counters()
         self.port: Optional[int] = None
+        self.exporter_port: Optional[int] = None
+        self._exporter = exporter
         self._kv = kv
         self._gateway_kwargs = dict(
             max_queue_depth=max_queue_depth, rate=rate, burst=burst,
@@ -104,12 +107,34 @@ class _Replica:
         gateway = TileGateway(cache, host="127.0.0.1", port=0,
                               counters=self.counters, sessions=service,
                               **self._gateway_kwargs)
+        exporter = None
+        sampler_task = None
+        if self._exporter:
+            # A scrapable replica: /varz + /timeseries with role
+            # "gateway" so the fleet aggregator (obs/fleet.py) gives it
+            # a gateway row with windowed latency percentiles.
+            from distributedmandelbrot_tpu.obs.exporter import \
+                MetricsExporter
+            from distributedmandelbrot_tpu.obs.timeseries import \
+                TimeseriesSampler
+            sampler = TimeseriesSampler(self.counters.registry)
+            exporter = MetricsExporter(
+                self.counters.registry, sampler=sampler,
+                varz_extra=lambda: {"role": "gateway"},
+                host="127.0.0.1", port=0)
+            await exporter.start()
+            self.exporter_port = exporter.port
+            sampler_task = asyncio.ensure_future(sampler.run())
         await gateway.start()
         self.port = gateway.port
         self._ready.set()
         try:
             await self._stop_event.wait()
         finally:
+            if sampler_task is not None:
+                sampler_task.cancel()
+            if exporter is not None:
+                await exporter.stop()
             await gateway.stop()
 
 
@@ -125,7 +150,8 @@ class GatewayFleet:
                  session_rate: Optional[float] = None,
                  session_burst: float = 32.0,
                  session_ttl: Optional[float] = 300.0,
-                 prefetch_horizon: int = 3) -> None:
+                 prefetch_horizon: int = 3,
+                 exporter: bool = False) -> None:
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
         self.kv = kv
@@ -136,7 +162,7 @@ class GatewayFleet:
                      burst=burst, read_timeout=read_timeout,
                      sessions=sessions, session_rate=session_rate,
                      session_burst=session_burst, session_ttl=session_ttl,
-                     prefetch_horizon=prefetch_horizon)
+                     prefetch_horizon=prefetch_horizon, exporter=exporter)
             for _ in range(replicas)]
 
     def start(self) -> "GatewayFleet":
@@ -165,6 +191,13 @@ class GatewayFleet:
     def addresses(self) -> list[tuple[str, int]]:
         return [("127.0.0.1", r.port) for r in self._replicas
                 if r.port is not None]
+
+    @property
+    def exporter_ports(self) -> list[int]:
+        """Bound metrics-exporter ports (``exporter=True`` launches
+        only) — feed these to a FleetAggregator as gateway peers."""
+        return [r.exporter_port for r in self._replicas
+                if r.exporter_port is not None]
 
     def counter(self, name: str) -> int:
         """Sum of one named counter across every replica."""

@@ -17,6 +17,11 @@ gateway, ephemeral port by default).
 - ``/healthz`` — liveness probe, ``ok``;
 - ``/trace.json`` — the merged coordinator + worker timeline in Chrome
   trace-event JSON (obs/chrome.py), loadable at ui.perfetto.dev;
+- ``/timeseries?name=&window=`` — ring-buffer history from the attached
+  :class:`~distributedmandelbrot_tpu.obs.timeseries.TimeseriesSampler`
+  (counter rates, gauge traces, histogram percentile series);
+- ``/fleet`` — the merged fleet snapshot from an attached
+  :class:`~distributedmandelbrot_tpu.obs.fleet.FleetAggregator`;
 - ``POST /checkpoint`` — on-demand durability checkpoint (admin-only
   write route, present iff the embedding coordinator supplies
   ``checkpoint_cb``; `dmtpu admin checkpoint` posts here).
@@ -29,6 +34,8 @@ import json
 import logging
 import math
 import re
+import threading
+import urllib.parse
 from typing import Callable, Optional
 
 from distributedmandelbrot_tpu.obs.chrome import render_chrome_trace
@@ -121,11 +128,16 @@ class MetricsExporter:
                  varz_extra: Optional[Callable[[], dict]] = None,
                  checkpoint_cb: Optional[Callable[[], "asyncio.Future"]]
                  = None,
+                 sampler=None, fleet=None,
                  host: str = "127.0.0.1", port: int = 0) -> None:
         self.registry = registry
         self.trace = trace
         self.spans = spans
         self.varz_extra = varz_extra
+        # Optional TimeseriesSampler (/timeseries) and FleetAggregator
+        # (/fleet) — duck-typed so the exporter needs neither module.
+        self.sampler = sampler
+        self.fleet = fleet
         # Async callable -> stats dict; enables the POST /checkpoint
         # admin route (the coordinator wires its RecoveryManager here).
         self.checkpoint_cb = checkpoint_cb
@@ -155,7 +167,8 @@ class MetricsExporter:
             parts = request.decode("latin-1").split()
             if len(parts) < 2:
                 return
-            method, path = parts[0].upper(), parts[1].split("?", 1)[0]
+            method = parts[0].upper()
+            path, _, query = parts[1].partition("?")
             # Drain headers; every response closes the connection, so
             # nothing after the header block matters.
             while True:
@@ -200,10 +213,30 @@ class MetricsExporter:
                         + "\n").encode()
                 self._respond(writer, 200, "application/json", body,
                               head=method == "HEAD")
+            elif path == "/timeseries" and self.sampler is not None:
+                params = urllib.parse.parse_qs(query)
+                name = (params.get("name") or [None])[0]
+                window = None
+                try:
+                    raw = (params.get("window") or [None])[0]
+                    if raw is not None:
+                        window = max(0.0, float(raw))
+                except ValueError:
+                    window = None  # garbage window -> whole history
+                doc = self.sampler.to_json(name, window=window)
+                status = 404 if "error" in doc else 200
+                body = (json.dumps(doc, sort_keys=True) + "\n").encode()
+                self._respond(writer, status, "application/json", body,
+                              head=method == "HEAD")
+            elif path == "/fleet" and self.fleet is not None:
+                body = (json.dumps(self.fleet.snapshot(), sort_keys=True)
+                        + "\n").encode()
+                self._respond(writer, 200, "application/json", body,
+                              head=method == "HEAD")
             else:
                 self._respond(writer, 404, "text/plain; charset=utf-8",
                               b"not found (try /metrics /varz /healthz "
-                              b"/trace.json)\n")
+                              b"/trace.json /timeseries /fleet)\n")
             await writer.drain()
         except (ConnectionError, TimeoutError, asyncio.TimeoutError,
                 asyncio.CancelledError):
@@ -254,3 +287,74 @@ class MetricsExporter:
             except Exception:
                 logger.exception("varz_extra callback failed")
         return out
+
+
+class ExporterThread:
+    """A MetricsExporter on its own thread-owned loop, for processes
+    with no asyncio loop of their own (the synchronous worker, bench
+    harnesses).  start() blocks until the port is bound so the caller
+    can immediately advertise it."""
+
+    def __init__(self, registry: Registry, *,
+                 varz_extra: Optional[Callable[[], dict]] = None,
+                 sampler=None, fleet=None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.registry = registry
+        self.varz_extra = varz_extra
+        self.sampler = sampler
+        self.fleet = fleet
+        self.host = host
+        self.port = port
+        self._thread: Optional[threading.Thread] = None
+        self._ready = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._stop_event: Optional[asyncio.Event] = None
+        self._startup_error: Optional[BaseException] = None
+
+    def start(self, timeout: float = 10.0) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="dmtpu-exporter",
+                                        daemon=True)
+        self._thread.start()
+        if not self._ready.wait(timeout):
+            raise RuntimeError("exporter thread did not start in time")
+        if self._startup_error is not None:
+            raise RuntimeError("exporter thread failed to start") \
+                from self._startup_error
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._loop is not None and self._stop_event is not None:
+            self._loop.call_soon_threadsafe(self._stop_event.set)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _run(self) -> None:
+        try:
+            asyncio.run(self._main())
+        except BaseException as e:
+            self._startup_error = e
+            self._ready.set()
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop_event = asyncio.Event()
+        exporter = MetricsExporter(
+            self.registry, varz_extra=self.varz_extra,
+            sampler=self.sampler, fleet=self.fleet,
+            host=self.host, port=self.port)
+        await exporter.start()
+        self.port = exporter.port
+        sampler_task = (asyncio.create_task(self.sampler.run())
+                        if self.sampler is not None else None)
+        self._ready.set()
+        try:
+            await self._stop_event.wait()
+        finally:
+            if sampler_task is not None:
+                sampler_task.cancel()
+                try:
+                    await sampler_task
+                except asyncio.CancelledError:
+                    pass
+            await exporter.stop()

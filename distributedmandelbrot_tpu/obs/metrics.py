@@ -40,6 +40,45 @@ def _label_key(labels: Optional[Mapping[str, str]]) -> LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def quantile_from_counts(bounds: Sequence[float], counts: Sequence[int],
+                         q: float) -> float:
+    """Deterministic quantile (``q`` in 0..1) from a bucket-count vector.
+
+    ``counts[i]`` holds observations ``<= bounds[i]``; a trailing extra
+    entry is the +Inf overflow bucket.  The edge cases are pinned rather
+    than left to interpolation:
+
+    - an empty vector returns 0.0 (a timeseries point needs a number,
+      and "no observations yet" plots as zero latency, not a gap);
+    - ``q >= 1.0`` returns the upper bound of the highest nonempty
+      bucket EXACTLY — interpolation at the max must never manufacture
+      a value past the last log bucket the data actually reached;
+    - the overflow bucket always reports ``bounds[-1]`` (the histogram
+      cannot see past its last boundary).
+    """
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    q = min(max(float(q), 0.0), 1.0)
+    if q >= 1.0:
+        for i in range(len(counts) - 1, -1, -1):
+            if counts[i] > 0:
+                return bounds[min(i, len(bounds) - 1)]
+        return 0.0  # unreachable: total > 0 means some count is nonzero
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= rank and c > 0:
+            if i >= len(bounds):
+                return bounds[-1]
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - (cum - c)) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+    return bounds[-1]
+
+
 class Counter:
     """Monotonic integer, incremented under the registry's lock."""
 
@@ -120,26 +159,18 @@ class Histogram:
 
     def percentile(self, q: float) -> Optional[float]:
         """Estimated q-th percentile (0..100) by linear interpolation
-        inside the winning bucket; None with no observations.  The
-        overflow bucket reports its lower bound (the histogram cannot
-        see past its last boundary)."""
+        inside the winning bucket (:func:`quantile_from_counts`); None
+        with no observations.  p100 reports the highest nonempty
+        bucket's upper bound exactly, and the overflow bucket reports
+        its lower bound (the histogram cannot see past its last
+        boundary)."""
         with self._lock:
             total = self.count
             counts = list(self.counts)
         if total == 0:
             return None
-        rank = max(q, 0.0) / 100.0 * total
-        cum = 0
-        for i, c in enumerate(counts):
-            cum += c
-            if cum >= rank and c > 0:
-                if i == len(self.bounds):
-                    return self.bounds[-1]
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i]
-                frac = (rank - (cum - c)) / c
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-        return self.bounds[-1]
+        return quantile_from_counts(self.bounds, counts,
+                                    max(q, 0.0) / 100.0)
 
     def state(self) -> tuple[list[int], float, int]:
         """Consistent (bucket counts, sum, count) cut for rendering."""

@@ -363,6 +363,32 @@ LOADGEN_CLIENT_SATURATED = "loadgen_client_saturated"
 LOADGEN_BYTES = "loadgen_bytes"
 HIST_LOADGEN_LATENCY_SECONDS = "loadgen_latency_seconds"
 
+# -- fleet observability plane (obs/timeseries + obs/fleet + obs/slo) ------
+
+# Ring-buffer sampler: snapshots taken, per-snapshot cost, and the live
+# count of distinct series the history currently carries.
+TS_SAMPLES = "ts_samples"
+HIST_TS_SAMPLE_SECONDS = "ts_sample_seconds"
+GAUGE_TS_SERIES = "ts_series"
+
+# Fleet aggregator: scrape rounds completed, per-peer fetch failures
+# (malformed/truncated/oversized bodies, unreachable peers — the peer is
+# marked stale, never crashed on), per-fetch latency, and the live
+# peer/stale/straggler population gauges the dashboard header reads.
+FLEET_SCRAPES = "fleet_scrapes"
+FLEET_SCRAPE_ERRORS = "fleet_scrape_errors"
+HIST_FLEET_SCRAPE_SECONDS = "fleet_scrape_seconds"
+GAUGE_FLEET_PEERS = "fleet_peers"
+GAUGE_FLEET_PEERS_STALE = "fleet_peers_stale"
+GAUGE_FLEET_STRAGGLERS = "fleet_stragglers"
+
+# SLO layer: live burn-rate gauges (labels: slo=<name>,
+# window=fast|slow) and the alert-transition counters (labels:
+# slo=<name>) bumped by the state machine in obs/slo.py.
+GAUGE_SLO_BURN = "slo_burn_rate"
+SLO_ALERTS_FIRED = "slo_alerts_fired"
+SLO_ALERTS_RECOVERED = "slo_alerts_recovered"
+
 # -- legacy aliases -------------------------------------------------------
 
 # canonical name -> the spelling pre-registry call sites read.  Reads of a

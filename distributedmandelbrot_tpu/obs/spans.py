@@ -288,6 +288,53 @@ class SpanStore:
                 for key, stages in self.stage_seconds_by_key().items()
                 if obs_names.SPAN_COMPUTE in stages}
 
+    def per_worker_stats(self, persist_s_by_key: Optional[dict[Key, float]]
+                         = None) -> dict[str, dict]:
+        """Per-worker roll-up for /varz and the fleet aggregator.
+
+        Durations need no clock alignment, so every ingested span
+        contributes even before an offset estimate exists.  ``tiles``
+        counts distinct (key, lease seq) with a compute span;
+        ``lease_to_persist_s`` sums each tile's prefetch-start ->
+        upload-end wall time plus the coordinator-side persist seconds
+        when the caller joins them in (``persist_s_by_key`` from the
+        trace ring) — the straggler detector's skew signal.  Worker ids
+        render as zero-padded hex (JSON keys must be strings)."""
+        with self._lock:
+            items = list(self._spans)
+        per: dict[int, dict] = {}
+        tiles: dict[tuple[int, Key, int], dict] = {}
+        for wid, span in items:
+            w = per.setdefault(wid, {
+                "tiles": 0, "compute_s": 0.0, "upload_s": 0.0,
+                "prefetch_s": 0.0, "lease_to_persist_s": 0.0})
+            dur = max(0.0, span.t1 - span.t0)
+            if span.stage == obs_names.SPAN_COMPUTE:
+                w["compute_s"] += dur
+            elif span.stage == obs_names.SPAN_UPLOAD:
+                w["upload_s"] += dur
+            elif span.stage == obs_names.SPAN_PREFETCH:
+                w["prefetch_s"] += dur
+            t = tiles.setdefault((wid, span.key, span.seq), {})
+            if span.stage == obs_names.SPAN_PREFETCH:
+                t["t0"] = min(t.get("t0", span.t0), span.t0)
+            elif span.stage == obs_names.SPAN_UPLOAD:
+                t["t1"] = max(t.get("t1", span.t1), span.t1)
+            elif span.stage == obs_names.SPAN_COMPUTE:
+                t["compute"] = True
+        for (wid, key, _seq), t in tiles.items():
+            w = per[wid]
+            if t.get("compute"):
+                w["tiles"] += 1
+            if "t0" in t and "t1" in t:
+                wall = max(0.0, t["t1"] - t["t0"])
+                persist = (persist_s_by_key or {}).get(key, 0.0)
+                w["lease_to_persist_s"] += wall + persist
+        return {format(wid, "016x"):
+                {k: (round(v, 6) if isinstance(v, float) else v)
+                 for k, v in w.items()}
+                for wid, w in per.items()}
+
 
 def critical_path(trace_spans: list[dict],
                   store: Optional[SpanStore]) -> dict:

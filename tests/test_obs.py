@@ -81,6 +81,31 @@ def test_histogram_family_shares_first_registered_bounds():
     assert reg.family_percentile("missing", 50) is None
 
 
+def test_quantile_from_counts_edges():
+    from distributedmandelbrot_tpu.obs.metrics import quantile_from_counts
+
+    bounds = (1.0, 2.0, 4.0)
+    # No observations: a timeseries point needs a number, not a gap.
+    assert quantile_from_counts(bounds, [], 0.5) == 0.0
+    assert quantile_from_counts(bounds, [0, 0, 0], 0.99) == 0.0
+    # q >= 1.0 pins to the upper bound of the highest NONEMPTY bucket —
+    # interpolation must never manufacture a value past the last bucket
+    # the data actually reached.
+    assert quantile_from_counts(bounds, [3, 5, 0], 1.0) == 2.0
+    assert quantile_from_counts(bounds, [3, 5, 0], 1.5) == 2.0  # clamped
+    assert quantile_from_counts(bounds, [1, 0, 0], 1.0) == 1.0
+    # Overflow bucket (trailing extra entry) reports bounds[-1]: the
+    # histogram cannot see past its last boundary.
+    assert quantile_from_counts(bounds, [0, 0, 0, 7], 0.5) == 4.0
+    assert quantile_from_counts(bounds, [0, 0, 0, 7], 1.0) == 4.0
+    # q <= 0 clamps to 0 and interpolates from the bucket's lower edge.
+    assert quantile_from_counts(bounds, [4, 0, 0], -1.0) == 0.0
+    # Interpolation inside a bucket: 2 obs in (1, 2], rank(p50)=1 lands
+    # halfway through that bucket.
+    assert quantile_from_counts(bounds, [0, 2, 0], 0.5) == \
+        pytest.approx(1.5)
+
+
 def test_registry_name_kind_binding_enforced():
     reg = Registry()
     reg.counter("x").inc()

@@ -1,0 +1,214 @@
+"""SLO burn rates on a virtual clock (fire / hold / recover), latency
+threshold bucketing, and the worker straggler detector."""
+
+import math
+
+import pytest
+
+from distributedmandelbrot_tpu.coordinator.clock import ManualClock
+from distributedmandelbrot_tpu.obs import names as obs_names
+from distributedmandelbrot_tpu.obs.metrics import Registry
+from distributedmandelbrot_tpu.obs.slo import (AvailabilitySLO, LatencySLO,
+                                               burn_rate, detect_stragglers,
+                                               standard_slos)
+from distributedmandelbrot_tpu.obs.timeseries import TimeseriesSampler
+
+
+# -- burn-rate arithmetic --------------------------------------------------
+
+
+def test_burn_rate_math():
+    # 1.0 = spending the error budget exactly on schedule.
+    assert burn_rate(99, 1, 0.99) == pytest.approx(1.0)
+    assert burn_rate(90, 10, 0.99) == pytest.approx(10.0)
+    assert burn_rate(0, 0, 0.99) == 0.0
+    # Zero budget: any error is an infinite burn, no errors is none.
+    assert burn_rate(5, 1, 1.0) == math.inf
+    assert burn_rate(5, 0, 1.0) == 0.0
+
+
+def test_slo_rejects_bad_objective():
+    reg = Registry()
+    sampler = TimeseriesSampler(reg)
+    with pytest.raises(ValueError, match="objective"):
+        AvailabilitySLO(sampler, objective=1.5)
+
+
+# -- availability SLO state machine on a virtual clock ---------------------
+
+
+class _Farm:
+    """Manual-clock sampler fed synthetic gateway request outcomes."""
+
+    def __init__(self, **slo_kwargs):
+        self.reg = Registry()
+        self.clk = ManualClock()
+        self.sampler = TimeseriesSampler(self.reg, period=1.0,
+                                         window=120.0, clock=self.clk.now)
+        kwargs = dict(objective=0.99, fast_window=10.0, slow_window=60.0,
+                      burn_threshold=10.0)
+        kwargs.update(slo_kwargs)
+        self.slo = AvailabilitySLO(self.sampler, **kwargs)
+
+    def step(self, good=0, bad=0):
+        for _ in range(good):
+            self.reg.observe(obs_names.HIST_GATEWAY_REQUEST_SECONDS, 0.01,
+                             labels={"outcome": obs_names.OUTCOME_TIER1})
+        for _ in range(bad):
+            self.reg.observe(obs_names.HIST_GATEWAY_REQUEST_SECONDS, 0.01,
+                             labels={"outcome":
+                                     obs_names.OUTCOME_REJECTED})
+        self.clk.advance(1.0)
+        self.sampler.sample()
+
+
+def test_availability_slo_fire_hold_recover():
+    farm = _Farm()
+    slo = farm.slo
+    for _ in range(5):  # t=1..5: healthy traffic
+        farm.step(good=10)
+    doc = slo.evaluate()
+    assert doc["state"] == "ok" and slo.fired == 0
+    assert doc["fast"]["burn"] == 0.0
+
+    for _ in range(7):  # t=6..12: half the requests bounce
+        farm.step(good=5, bad=5)
+    doc = slo.evaluate()
+    # Fast AND slow windows both over threshold -> fire, once.
+    assert doc["state"] == "firing"
+    assert slo.fired == 1
+    assert doc["fast"]["burn"] >= 10.0
+    assert doc["slow"]["burn"] >= 10.0
+    assert farm.reg.counter_value(
+        obs_names.SLO_ALERTS_FIRED,
+        labels={"slo": slo.name}) == 1
+
+    for _ in range(11):  # t=13..23: healthy again
+        farm.step(good=10)
+    doc = slo.evaluate()
+    # Fast window clean, slow window still burning: hold, not recovered.
+    assert doc["state"] == "hold"
+    assert doc["fast"]["burn"] < 10.0
+    assert doc["slow"]["burn"] >= 10.0
+    assert slo.recovered == 0
+
+    for _ in range(72):  # t=24..95: the bad samples age out of 60s
+        farm.step(good=10)
+    doc = slo.evaluate()
+    assert doc["state"] == "ok"
+    assert slo.recovered == 1
+    assert farm.reg.counter_value(
+        obs_names.SLO_ALERTS_RECOVERED,
+        labels={"slo": slo.name}) == 1
+    # Burn gauges carry the per-window values for /varz and the fleet.
+    assert farm.reg.gauge(obs_names.GAUGE_SLO_BURN,
+                          labels={"slo": slo.name,
+                                  "window": "fast"}).read() == 0.0
+
+
+def test_availability_slo_refire_from_hold_counts_once():
+    farm = _Farm()
+    slo = farm.slo
+    for _ in range(5):
+        farm.step(good=10)
+    for _ in range(7):
+        farm.step(good=5, bad=5)
+    assert slo.evaluate()["state"] == "firing"
+    for _ in range(11):
+        farm.step(good=10)
+    assert slo.evaluate()["state"] == "hold"
+    for _ in range(5):  # errors return while the slow window still burns
+        farm.step(bad=10)
+    doc = slo.evaluate()
+    # hold -> firing is a re-entry, not a new alert: fired stays 1.
+    assert doc["state"] == "firing"
+    assert slo.fired == 1
+    assert slo.recovered == 0
+
+
+def test_availability_slo_quiet_farm_never_fires():
+    farm = _Farm()
+    for _ in range(30):
+        farm.step()  # no traffic at all
+        assert farm.slo.evaluate()["state"] == "ok"
+    assert farm.slo.fired == 0
+
+
+# -- latency SLO -----------------------------------------------------------
+
+
+def test_latency_slo_threshold_bucketing():
+    reg = Registry()
+    clk = ManualClock()
+    sampler = TimeseriesSampler(reg, period=1.0, window=120.0,
+                                clock=clk.now)
+    slo = LatencySLO(sampler, threshold_s=0.1024, objective=0.95,
+                     fast_window=10.0, slow_window=60.0)
+    assert slo.name == "gateway_latency_0.1024s"
+    # Window counts are first-vs-last deltas, so the family must exist
+    # in the opening cut (a live gateway registers it at startup).
+    reg.histogram(obs_names.HIST_GATEWAY_REQUEST_SECONDS)
+    clk.advance(1.0)
+    sampler.sample()
+    for _ in range(8):
+        reg.observe(obs_names.HIST_GATEWAY_REQUEST_SECONDS, 0.05)
+    # Exactly on the threshold (a DEFAULT_BUCKETS bound, 1e-4 * 2^10):
+    # still good — the bound's bucket is included despite float noise.
+    reg.observe(obs_names.HIST_GATEWAY_REQUEST_SECONDS, 0.1024)
+    reg.observe(obs_names.HIST_GATEWAY_REQUEST_SECONDS, 1.0)
+    clk.advance(1.0)
+    sampler.sample()
+    wb = slo.window_burn(10.0)
+    assert (wb.good, wb.bad) == (9, 1)
+    assert wb.error_rate == pytest.approx(0.1)
+    assert wb.burn == pytest.approx(0.1 / 0.05)
+
+
+def test_standard_slos_pair():
+    reg = Registry()
+    sampler = TimeseriesSampler(reg)
+    slos = standard_slos(sampler)
+    assert [s.name for s in slos] == ["gateway_availability",
+                                     "gateway_latency_0.1024s"]
+    for slo in slos:
+        assert slo.evaluate()["state"] == "ok"
+
+
+# -- straggler detection ---------------------------------------------------
+
+
+def _worker_row(wid, tiles, compute_per_tile, persist_per_tile=0.2):
+    return {"worker": wid, "tiles": tiles,
+            "compute_s": compute_per_tile * tiles,
+            "lease_to_persist_s": persist_per_tile * tiles}
+
+
+def test_detect_stragglers_one_slow_of_four():
+    rows = [_worker_row("w1", 10, 0.10), _worker_row("w2", 12, 0.11),
+            _worker_row("w3", 9, 0.09),
+            _worker_row("w4", 10, 1.00, persist_per_tile=1.5)]
+    flagged = detect_stragglers(rows)
+    assert set(flagged) == {"w4"}
+    assert "slow_compute" in flagged["w4"]
+    assert "lease_to_persist_skew" in flagged["w4"]
+
+
+def test_detect_stragglers_needs_enough_peers():
+    rows = [_worker_row("w1", 10, 0.1), _worker_row("w2", 10, 1.0)]
+    # A median of two is meaningless: no verdicts.
+    assert detect_stragglers(rows) == {}
+
+
+def test_detect_stragglers_absolute_floor_mutes_noise():
+    # 10x outlier among microsecond medians is noise, not a straggler.
+    rows = [_worker_row(f"w{i}", 10, 1e-6, persist_per_tile=1e-6)
+            for i in range(3)]
+    rows.append(_worker_row("w9", 10, 1e-5, persist_per_tile=1e-5))
+    assert detect_stragglers(rows) == {}
+
+
+def test_detect_stragglers_skips_thin_workers():
+    # A worker with one tile has no meaningful per-tile statistic.
+    rows = [_worker_row("w1", 10, 0.1), _worker_row("w2", 10, 0.1),
+            _worker_row("w3", 10, 0.1), _worker_row("slow", 1, 50.0)]
+    assert detect_stragglers(rows) == {}
