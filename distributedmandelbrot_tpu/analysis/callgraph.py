@@ -44,7 +44,8 @@ from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
 from distributedmandelbrot_tpu.analysis.astutil import (FunctionNode,
-                                                        attr_chain)
+                                                        attr_chain,
+                                                        cached_walk)
 from distributedmandelbrot_tpu.analysis.engine import PACKAGE, Project
 
 __all__ = ["CallGraph", "CallSite", "ClassInfo", "FunctionInfo",
@@ -226,7 +227,7 @@ class CallGraph:
             params = {a.arg: _annotation_class(a.annotation)
                       for a in (meth.args.posonlyargs + meth.args.args
                                 + meth.args.kwonlyargs)}
-            for node in ast.walk(meth):
+            for node in cached_walk(meth):
                 if isinstance(node, ast.Assign) and len(node.targets) == 1:
                     target = attr_chain(node.targets[0])
                     typ = self._expr_class(env, node.value, params)
@@ -245,7 +246,7 @@ class CallGraph:
     def _propagated_attr_types(self, env: _ModuleEnv,
                                info: ClassInfo) -> None:
         for meth in info.methods.values():
-            for node in ast.walk(meth):
+            for node in cached_walk(meth):
                 if not (isinstance(node, ast.Assign)
                         and len(node.targets) == 1):
                     continue
@@ -388,7 +389,7 @@ class CallGraph:
             typ = _annotation_class(a.annotation)
             if typ is not None and self._class_named(env, typ) is not None:
                 out[a.arg] = typ
-        for node in ast.walk(fn):
+        for node in cached_walk(fn):
             if not (isinstance(node, ast.Assign) and len(node.targets) == 1
                     and isinstance(node.targets[0], ast.Name)):
                 continue

@@ -65,7 +65,8 @@ from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
 from distributedmandelbrot_tpu.analysis import callgraph
-from distributedmandelbrot_tpu.analysis.astutil import attr_chain
+from distributedmandelbrot_tpu.analysis.astutil import (attr_chain,
+                                                        cached_walk)
 from distributedmandelbrot_tpu.analysis.engine import Project
 
 __all__ = ["Sink", "TaintSummary", "ProjectTaint", "WIRE", "analyze"]
@@ -230,7 +231,7 @@ class _FunctionTaint:
             if stmt.exc is not None:
                 self._expr(stmt.exc, env)
         elif isinstance(stmt, (ast.Delete, ast.Assert)):
-            for sub in ast.walk(stmt):
+            for sub in cached_walk(stmt):
                 if isinstance(sub, ast.expr):
                     self._expr_shallow_sinks(sub, env)
         # pass/break/continue/global/import: nothing to do
@@ -267,7 +268,7 @@ class _FunctionTaint:
 
     def _while(self, stmt: ast.While, env: _Env) -> None:
         test_origins = frozenset()
-        for sub in ast.walk(stmt.test):
+        for sub in cached_walk(stmt.test):
             name = _dotted(sub) if isinstance(sub, ast.expr) else None
             if name is not None:
                 test_origins |= env.get(name)
@@ -536,7 +537,7 @@ def _compared_names(test: ast.expr) -> set[str]:
     test — the 'range/clamp comparison' sanitizer shape.  ``if flag:``
     sanitizes nothing; ``if n == 0 or n > MAX:`` sanitizes ``n``."""
     names: set[str] = set()
-    for node in ast.walk(test):
+    for node in cached_walk(test):
         if isinstance(node, ast.Compare):
             for side in [node.left] + list(node.comparators):
                 name = _dotted(side)

@@ -200,12 +200,12 @@ def fingerprints_at_ref(root: Path | str, ref: str,
 def _rule_modules():
     # Imported lazily: rule modules import this module for Rule/Finding.
     from distributedmandelbrot_tpu.analysis import (rules_async, rules_exc,
-                                                    rules_jax, rules_locks,
-                                                    rules_obs, rules_proto,
-                                                    rules_res, rules_taint,
-                                                    rules_wire)
+                                                    rules_fsm, rules_jax,
+                                                    rules_locks, rules_obs,
+                                                    rules_proto, rules_res,
+                                                    rules_taint, rules_wire)
     return (rules_locks, rules_async, rules_wire, rules_jax, rules_proto,
-            rules_res, rules_obs, rules_taint, rules_exc)
+            rules_res, rules_obs, rules_taint, rules_exc, rules_fsm)
 
 
 def all_rules() -> dict[str, Rule]:
@@ -241,15 +241,25 @@ def expand_rule_ids(rule_ids: Sequence[str]) -> list[str]:
 
 
 def check_project(project: Project,
-                  rule_ids: Optional[Sequence[str]] = None) -> list[Finding]:
+                  rule_ids: Optional[Sequence[str]] = None,
+                  timings: Optional[dict] = None) -> list[Finding]:
     """Run every rule family; returns ALL findings (suppression and
     baseline filtering is :func:`run_check`'s job).  ``rule_ids`` may mix
-    rule ids and family names."""
+    rule ids and family names.  When ``timings`` is given, per-family
+    wall seconds are recorded into it keyed by module basename (the
+    ``--profile`` feed: as families grow, the tier-1 gate's time budget
+    stays attributable to the family that spent it)."""
+    import time
     findings = [Finding(PARSE_ERROR.id, PARSE_ERROR.severity, rel, 1, msg)
                 for rel, msg in sorted(project.parse_failures.items())]
     wanted = set(expand_rule_ids(rule_ids)) if rule_ids else None
     for mod in _rule_modules():
+        t0 = time.perf_counter()
         findings.extend(mod.check(project))
+        if timings is not None:
+            name = mod.__name__.rsplit(".", 1)[-1]
+            timings[name] = timings.get(name, 0.0) \
+                + (time.perf_counter() - t0)
     if wanted is not None:
         findings = [f for f in findings if f.rule in wanted]
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
@@ -274,8 +284,9 @@ class Report:
 
 def run_check(project: Project,
               rule_ids: Optional[Sequence[str]] = None,
-              baseline: Optional[Iterable[str]] = None) -> Report:
-    all_findings = check_project(project, rule_ids)
+              baseline: Optional[Iterable[str]] = None,
+              timings: Optional[dict] = None) -> Report:
+    all_findings = check_project(project, rule_ids, timings=timings)
     base = set(baseline or ())
     actionable: list[Finding] = []
     suppressed: list[Finding] = []

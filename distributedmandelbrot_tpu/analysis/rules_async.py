@@ -33,7 +33,7 @@ import ast
 from typing import Iterator
 
 from distributedmandelbrot_tpu.analysis.astutil import (
-    call_chain, class_defs, walk_skipping_nested_async)
+    cached_walk, call_chain, class_defs, walk_skipping_nested_async)
 from distributedmandelbrot_tpu.analysis.engine import (Finding, Project, Rule,
                                                        SourceFile)
 
@@ -80,7 +80,7 @@ TASK_SPAWNERS = frozenset({"create_task", "ensure_future"})
 
 
 def _async_defs(sf: SourceFile) -> Iterator[ast.AsyncFunctionDef]:
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if isinstance(node, ast.AsyncFunctionDef):
             yield node
 
@@ -205,7 +205,7 @@ def _check_unawaited(sf: SourceFile) -> list[Finding]:
 
 def _check_dropped_tasks(sf: SourceFile) -> list[Finding]:
     out: list[Finding] = []
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if not isinstance(node, ast.Expr) \
                 or not isinstance(node.value, ast.Call):
             continue

@@ -29,6 +29,7 @@ from typing import Iterator, Optional
 
 from distributedmandelbrot_tpu.analysis.astutil import (FunctionNode,
                                                         attr_chain,
+                                                        cached_walk,
                                                         class_defs,
                                                         methods_of)
 from distributedmandelbrot_tpu.analysis.engine import (Finding, Project,
@@ -97,13 +98,13 @@ def _acquisition(stmt: ast.stmt) -> Optional[tuple[str, str, tuple]]:
 
 def _releases(stmt: ast.stmt, name: str, methods: tuple) -> bool:
     """Does this statement release or hand off the resource ``name``?"""
-    for node in ast.walk(stmt):
+    for node in cached_walk(stmt):
         if isinstance(node, ast.Call):
             chain = attr_chain(node.func)
             if chain and chain[-1] in methods:
                 return True
         elif isinstance(node, ast.Return) and node.value is not None:
-            for sub in ast.walk(node.value):
+            for sub in cached_walk(node.value):
                 if isinstance(sub, ast.Name) and sub.id == name:
                     return True
         elif isinstance(node, (ast.With, ast.AsyncWith)):
@@ -149,7 +150,7 @@ def _escapes(body: list) -> bool:
 
 def _can_raise(stmt: ast.stmt) -> Optional[int]:
     """Line of the first await / I/O call in the statement, else None."""
-    for node in ast.walk(stmt):
+    for node in cached_walk(stmt):
         if isinstance(node, ast.Await):
             return node.lineno
         if isinstance(node, ast.Call):
@@ -239,11 +240,11 @@ def _handler_swallows(handler: ast.ExceptHandler) -> bool:
     # Re-binding the exception and using it is handling, not swallowing
     # (``except BaseException as e: self._error = e``).
     if handler.name:
-        for node in ast.walk(handler):
+        for node in cached_walk(handler):
             if isinstance(node, ast.Name) and node.id == handler.name \
                     and isinstance(node.ctx, ast.Load):
                 return False
-    for node in ast.walk(handler):
+    for node in cached_walk(handler):
         if isinstance(node, ast.Raise):
             return False
         if isinstance(node, ast.Call):
@@ -255,7 +256,7 @@ def _handler_swallows(handler: ast.ExceptHandler) -> bool:
 
 def _swallow_findings(sf: SourceFile) -> Iterator[Finding]:
     rule = RULES[1]
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if not isinstance(node, ast.ExceptHandler):
             continue
         if _handler_is_overbroad(node) and _handler_swallows(node):

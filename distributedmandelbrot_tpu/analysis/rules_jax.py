@@ -37,6 +37,7 @@ import ast
 from typing import Iterator
 
 from distributedmandelbrot_tpu.analysis.astutil import (FunctionNode,
+                                                        cached_walk,
                                                         call_chain,
                                                         dotted_names)
 from distributedmandelbrot_tpu.analysis.engine import (Finding, Project, Rule,
@@ -74,7 +75,7 @@ def _traced_functions(sf: SourceFile) -> Iterator[FunctionNode]:
     """Functions compiled by XLA: jit-decorated, jit-wrapped by name, or
     passed to pallas_call as the kernel."""
     wrapped: set[str] = set()
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if not isinstance(node, ast.Call):
             continue
         chain = call_chain(node)
@@ -84,7 +85,7 @@ def _traced_functions(sf: SourceFile) -> Iterator[FunctionNode]:
         if (last in JIT_NAMES or last == "pallas_call") and node.args \
                 and isinstance(node.args[0], ast.Name):
             wrapped.add(node.args[0].id)
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if node.name in wrapped \
                     or any(_is_traced_decorator(d)
@@ -104,7 +105,7 @@ def check(project: Project) -> list[Finding]:
 
 
 def _imports_ensure_x64(sf: SourceFile) -> bool:
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if isinstance(node, ast.ImportFrom) and node.module \
                 and node.module.endswith("precision"):
             if any(a.name == "ensure_x64" for a in node.names):
@@ -118,7 +119,7 @@ def _imports_mixed_precision(sf: SourceFile) -> bool:
     (or a dotted use of its helpers) marks the module as a reviewed
     mixed-precision site.  mixed_precision.py itself hosts the only
     sanctioned literal (at module scope, outside any trace)."""
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if isinstance(node, ast.ImportFrom) and node.module \
                 and node.module.endswith("mixed_precision"):
             return True
@@ -138,7 +139,7 @@ def _check_traced(sf: SourceFile, fn: FunctionNode,
     # Nested defs inside a traced function are traced too -> full walk,
     # but skip the decorator list (it runs at def time, outside the trace).
     for stmt in fn.body:
-        for node in ast.walk(stmt):
+        for node in cached_walk(stmt):
             if isinstance(node, ast.Global):
                 flag("jax-impure", "error", node.lineno,
                      "global statement: mutation happens at trace time, "
@@ -173,7 +174,7 @@ def _check_traced(sf: SourceFile, fn: FunctionNode,
                      "float() on a tracer forces a host transfer")
     if not has_precision:
         for stmt in fn.body:
-            for node in ast.walk(stmt):
+            for node in cached_walk(stmt):
                 if isinstance(node, ast.Constant) \
                         and isinstance(node.value, str) \
                         and node.value in DTYPE_64:
@@ -189,7 +190,7 @@ def _check_traced(sf: SourceFile, fn: FunctionNode,
                          f"without utils/precision.ensure_x64 in the module")
     if not has_mixed:
         for stmt in fn.body:
-            for node in ast.walk(stmt):
+            for node in cached_walk(stmt):
                 if isinstance(node, ast.Constant) \
                         and isinstance(node.value, str) \
                         and node.value in DTYPE_HALF:

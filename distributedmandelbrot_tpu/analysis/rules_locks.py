@@ -44,6 +44,7 @@ from typing import Optional
 
 from distributedmandelbrot_tpu.analysis import callgraph
 from distributedmandelbrot_tpu.analysis.astutil import (FunctionNode,
+                                                        cached_walk,
                                                         call_chain,
                                                         class_defs,
                                                         methods_of, self_attr,
@@ -130,7 +131,7 @@ class _ClassAnalysis:
         in the class is a lock (covers both ``self._lock = Lock()`` and
         locks injected through ``__init__`` parameters)."""
         locks: set[str] = set()
-        for node in ast.walk(self.cls):
+        for node in cached_walk(self.cls):
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
                     attr = self_attr(item.context_expr)
@@ -290,24 +291,36 @@ class _Summaries:
         return out
 
 
-def _walk_own(fn: FunctionNode):
+def _walk_own(fn: FunctionNode) -> tuple:
     """Walk a function body without descending into nested defs or
-    lambdas (their bodies run at some later call)."""
-    stack = list(fn.body)
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            continue
-        yield node
-        stack.extend(ast.iter_child_nodes(node))
+    lambdas (their bodies run at some later call).  Built as a filter
+    over :func:`cached_walk`'s preorder tuples — a nested def's subtree
+    is the contiguous run of its own cached walk, so skipping it is an
+    index jump instead of a re-traversal."""
+    cached = getattr(fn, "_dmtpu_walk_own", None)
+    if cached is not None:
+        return cached
+    skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    out: list = []
+    for stmt in fn.body:
+        nodes = cached_walk(stmt)
+        i, n = 0, len(nodes)
+        while i < n:
+            node = nodes[i]
+            if isinstance(node, skip):
+                i += len(node._dmtpu_walk)
+                continue
+            out.append(node)
+            i += 1
+    fn._dmtpu_walk_own = tuple(out)
+    return fn._dmtpu_walk_own
 
 
 def _bare_with_attrs(cls: ast.ClassDef) -> set[str]:
     """Same lock-attr evidence as :meth:`_ClassAnalysis._find_lock_attrs`
     but usable for classes outside the findings scope."""
     locks: set[str] = set()
-    for node in ast.walk(cls):
+    for node in cached_walk(cls):
         if isinstance(node, (ast.With, ast.AsyncWith)):
             for item in node.items:
                 attr = self_attr(item.context_expr)

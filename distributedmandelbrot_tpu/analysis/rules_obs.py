@@ -1,4 +1,9 @@
-"""``obs-name`` rule: instrumentation literals must be registered names.
+"""``obs-*`` rules: instrumentation names and the registry stay in sync.
+
+``obs-name`` checks the forward direction (every instrumentation
+literal is registered); ``obs-dead`` checks the reverse (every
+registration is instrumented or at least referenced) — the registry
+must describe the fleet's actual telemetry, not its aspirations.
 
 The ``results_accepted`` collision (PR 2) happened because two call
 sites spelled the same metric differently and nothing arbitrated.
@@ -23,7 +28,8 @@ from __future__ import annotations
 import ast
 from typing import Iterator, Optional
 
-from distributedmandelbrot_tpu.analysis.astutil import attr_chain
+from distributedmandelbrot_tpu.analysis.astutil import (attr_chain,
+                                                        cached_walk)
 from distributedmandelbrot_tpu.analysis.engine import (Finding, Project,
                                                        Rule, SourceFile)
 
@@ -31,6 +37,9 @@ RULES = (
     Rule("obs-name", "obs", "error",
          "metric/span name literals at instrumentation sites must be "
          "registered in obs/names.py"),
+    Rule("obs-dead", "obs", "warning",
+         "names registered in obs/names.py must be instrumented (or "
+         "referenced) somewhere — unused registrations are drift"),
 )
 
 NAMES_SUFFIX = "obs/names.py"
@@ -48,14 +57,19 @@ INSTRUMENT_METHODS = {
 }
 
 
+def _names_file(project: Project) -> Optional[str]:
+    for rel in sorted(project.files):
+        if rel.endswith(NAMES_SUFFIX):
+            return rel
+    return None
+
+
 def known_names(project: Project) -> Optional[set[str]]:
     """Registered names from the names module's AST: every uppercase
     top-level string constant plus the LEGACY_ALIASES dict's legacy
     spellings.  None when the project has no names module."""
-    for rel in sorted(project.files):
-        if rel.endswith(NAMES_SUFFIX):
-            break
-    else:
+    rel = _names_file(project)
+    if rel is None:
         return None
     known: set[str] = set()
     for node in project.files[rel].tree.body:
@@ -83,7 +97,7 @@ def iter_sites(project: Project) -> Iterator[tuple[SourceFile, int, str]]:
     argument is a string literal."""
     for rel in sorted(project.files):
         sf = project.files[rel]
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr in INSTRUMENT_METHODS):
@@ -100,13 +114,76 @@ def iter_sites(project: Project) -> Iterator[tuple[SourceFile, int, str]]:
             yield sf, node.args[0].lineno, node.args[0].value
 
 
+def registered_consts(project: Project
+                      ) -> Optional[dict[str, tuple[str, int]]]:
+    """Constant target -> (wire name, definition line) for every
+    uppercase top-level string constant in the names module (legacy
+    alias spellings are read-side compatibility, not registrations,
+    so LEGACY_ALIASES is excluded here)."""
+    rel = _names_file(project)
+    if rel is None:
+        return None
+    out: dict[str, tuple[str, int]] = {}
+    for node in project.files[rel].tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            target, value = node.target.id, node.value
+        else:
+            continue
+        if target.isupper() and isinstance(value, ast.Constant) \
+                and isinstance(value.value, str):
+            out[target] = (value.value, node.lineno)
+    return out
+
+
+def _dead_findings(project: Project) -> list[Finding]:
+    """obs-dead: a registered constant nobody instruments.  'Used'
+    means an ``<...names>.CONST`` attribute reference or a
+    ``from ...obs.names import CONST`` anywhere outside the names
+    module, or the wire spelling appearing as an instrumentation-site
+    literal — anything else is a name the registry promises but no
+    layer ever emits."""
+    consts = registered_consts(project)
+    if not consts:
+        return []
+    names_rel = _names_file(project)
+    used: set[str] = set()
+    for rel in sorted(project.files):
+        if rel == names_rel:
+            continue
+        for node in cached_walk(project.files[rel].tree):
+            if isinstance(node, ast.Attribute) and node.attr.isupper():
+                chain = attr_chain(node)
+                if chain and len(chain) >= 2 \
+                        and "names" in chain[-2].lower():
+                    used.add(node.attr)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.endswith("obs.names"):
+                used.update(alias.name for alias in node.names)
+    lit_used = {name for _, _, name in iter_sites(project)}
+    rule = RULES[1]
+    sf = project.files[names_rel]
+    return [
+        Finding(rule.id, rule.severity, sf.relpath, line,
+                f"registered name {target} ({wire!r}) is never "
+                f"instrumented or referenced outside obs/names.py")
+        for target, (wire, line) in sorted(consts.items())
+        if target not in used and wire not in lit_used]
+
+
 def check(project: Project) -> list[Finding]:
     known = known_names(project)
     if known is None:
         return []
     rule = RULES[0]
-    return [
+    out = [
         Finding(rule.id, rule.severity, sf.relpath, line,
                 f"metric name {name!r} is not registered in obs/names.py")
         for sf, line, name in iter_sites(project)
         if name not in known]
+    out.extend(_dead_findings(project))
+    return out

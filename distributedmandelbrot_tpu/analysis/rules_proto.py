@@ -56,7 +56,8 @@ from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
 from distributedmandelbrot_tpu.analysis import callgraph
-from distributedmandelbrot_tpu.analysis.astutil import attr_chain
+from distributedmandelbrot_tpu.analysis.astutil import (attr_chain,
+                                                        cached_walk)
 from distributedmandelbrot_tpu.analysis.engine import (PACKAGE, Finding,
                                                        Project, Rule)
 
@@ -275,7 +276,7 @@ class _Extractor:
         already produced a send op in source order."""
         out: set[str] = set()
         for stmt in stmts:
-            for node in ast.walk(stmt):
+            for node in cached_walk(stmt):
                 if (isinstance(node, ast.Assign) and len(node.targets) == 1
                         and isinstance(node.targets[0], ast.Name)
                         and isinstance(node.value, ast.Call)
@@ -414,10 +415,10 @@ def _purpose_tests(test: ast.expr, table: ProtoTable) -> set[str]:
     ``purpose == proto.PURPOSE_X and self.accept_spans`` shape and
     membership tests over tuples)."""
     out: set[str] = set()
-    for node in ast.walk(test):
+    for node in cached_walk(test):
         if isinstance(node, ast.Compare):
             for expr in [node.left, *node.comparators]:
-                for sub in ast.walk(expr):
+                for sub in cached_walk(expr):
                     chain = attr_chain(sub) if isinstance(
                         sub, (ast.Name, ast.Attribute)) else None
                     if chain and chain[-1] in table.purposes:
@@ -437,7 +438,7 @@ def _dispatch_arms(graph: callgraph.CallGraph,
                    table: ProtoTable) -> list[_Arm]:
     arms: list[_Arm] = []
     for info in graph.functions.values():
-        for node in ast.walk(info.node):
+        for node in cached_walk(info.node):
             if not isinstance(node, ast.If):
                 continue
             for purpose in sorted(_purpose_tests(node.test, table)):
@@ -453,7 +454,7 @@ _UNPACKERS = {"unpack", "unpack_from", "iter_unpack"}
 
 def _find_read_call(expr: ast.expr) -> Optional[ast.Call]:
     """The framing read (or raw ``.recv``) feeding an expression."""
-    for node in ast.walk(expr):
+    for node in cached_walk(expr):
         if isinstance(node, ast.Call):
             last = _last(attr_chain(node.func))
             if last in _RECV_EXACT or last == "recv":
@@ -465,7 +466,7 @@ def _feeding_exprs(fn: callgraph.FunctionNode,
                    name: str) -> Iterator[ast.expr]:
     """Every expression assigned to a local name in a function
     (both branches of ``x = A if cond else B``)."""
-    for node in ast.walk(fn):
+    for node in cached_walk(fn):
         if (isinstance(node, ast.Assign) and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
                 and node.targets[0].id == name):
@@ -481,7 +482,7 @@ def _exact_read_findings(graph: callgraph.CallGraph, table: ProtoTable,
                          extractor: _Extractor) -> Iterator[Finding]:
     rule = RULES[2]
     for info in graph.functions.values():
-        for node in ast.walk(info.node):
+        for node in cached_walk(info.node):
             if not isinstance(node, ast.Call):
                 continue
             chain = attr_chain(node.func)

@@ -36,6 +36,7 @@ from typing import Iterator, Optional
 
 from distributedmandelbrot_tpu.analysis.astutil import (FunctionNode,
                                                         attr_chain,
+                                                        cached_walk,
                                                         class_defs,
                                                         methods_of)
 from distributedmandelbrot_tpu.analysis.engine import (Finding, Project,
@@ -99,14 +100,14 @@ def _joined_names(scope: ast.AST) -> set[str]:
     resolving one level of ``for v in <name>`` loop aliasing so joining
     the loop variable joins the iterated list."""
     loop_alias: dict[str, str] = {}
-    for node in ast.walk(scope):
+    for node in cached_walk(scope):
         if isinstance(node, (ast.For, ast.AsyncFor)) \
                 and isinstance(node.target, ast.Name):
             src = attr_chain(node.iter)
             if src:
                 loop_alias[node.target.id] = ".".join(src)
     joined: set[str] = set()
-    for node in ast.walk(scope):
+    for node in cached_walk(scope):
         if isinstance(node, ast.Call):
             chain = attr_chain(node.func)
             if chain and chain[-1] == "join" and len(chain) >= 2:
@@ -123,7 +124,7 @@ def _thread_targets(fn: FunctionNode) -> Iterator[tuple[ast.Call,
     is the dotted name the thread — or the list containing it — lives
     under; None means the thread has no joinable handle at all."""
     claimed: set[int] = set()
-    for node in ast.walk(fn):
+    for node in cached_walk(fn):
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             target = attr_chain(node.targets[0])
             name = ".".join(target) if target else None
@@ -143,7 +144,7 @@ def _thread_targets(fn: FunctionNode) -> Iterator[tuple[ast.Call,
                     if _is_thread_call(arg):
                         claimed.add(id(arg))
                         yield arg, ".".join(chain[:-1])
-    for node in ast.walk(fn):
+    for node in cached_walk(fn):
         if _is_thread_call(node) and id(node) not in claimed:
             yield node, None
 
@@ -190,7 +191,7 @@ def _socket_findings(sf: SourceFile) -> Iterator[Finding]:
     rule = RULES[1]
     for _cls, fn in _functions(sf):
         acquired: dict[str, int] = {}
-        for node in ast.walk(fn):
+        for node in cached_walk(fn):
             if (isinstance(node, ast.Assign) and len(node.targets) == 1
                     and isinstance(node.targets[0], ast.Name)
                     and _is_acquire_call(node.value)):
@@ -198,7 +199,7 @@ def _socket_findings(sf: SourceFile) -> Iterator[Finding]:
         if not acquired:
             continue
         released: set[str] = set()
-        for node in ast.walk(fn):
+        for node in cached_walk(fn):
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
                     expr = item.context_expr
@@ -233,7 +234,7 @@ def _socket_findings(sf: SourceFile) -> Iterator[Finding]:
 
 def _queue_findings(sf: SourceFile) -> Iterator[Finding]:
     rule = RULES[2]
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if not isinstance(node, ast.Call):
             continue
         chain = attr_chain(node.func)
@@ -266,7 +267,7 @@ def _shutdown_findings(sf: SourceFile) -> Iterator[Finding]:
     rule = RULES[3]
     for cls in class_defs(sf.tree):
         stored: dict[str, tuple[int, str, tuple[str, ...]]] = {}
-        for node in ast.walk(cls):
+        for node in cached_walk(cls):
             if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
                 continue
             target = attr_chain(node.targets[0])
@@ -283,7 +284,7 @@ def _shutdown_findings(sf: SourceFile) -> Iterator[Finding]:
         if not stored:
             continue
         closed: set[tuple[str, str]] = set()
-        for node in ast.walk(cls):
+        for node in cached_walk(cls):
             if isinstance(node, ast.Call):
                 chain = attr_chain(node.func)
                 if chain and len(chain) == 3 and chain[0] == "self":

@@ -32,7 +32,9 @@ import ast
 import struct as _struct
 from typing import Optional
 
-from distributedmandelbrot_tpu.analysis.astutil import call_chain, dotted_names
+from distributedmandelbrot_tpu.analysis.astutil import (cached_walk,
+                                                        call_chain,
+                                                        dotted_names)
 from distributedmandelbrot_tpu.analysis.engine import (PACKAGE, Finding,
                                                        Project, Rule,
                                                        SourceFile)
@@ -107,7 +109,7 @@ def _format_literal(call: ast.Call) -> Optional[str]:
 
 def _check_literals(sf: SourceFile) -> list[Finding]:
     out: list[Finding] = []
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if isinstance(node, ast.Call):
             fmt = _format_literal(node)
             if fmt is not None:
@@ -198,7 +200,7 @@ def _protocol_refs(sf: SourceFile) -> set[str]:
     net.protocol, plus ``<alias>.NAME`` for any alias of the module."""
     aliases: set[str] = set()
     imported: set[str] = set()
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if isinstance(node, ast.ImportFrom) and node.module:
             if node.module.endswith("net.protocol"):
                 imported.update(a.asname or a.name for a in node.names)

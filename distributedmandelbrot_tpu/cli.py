@@ -1505,7 +1505,8 @@ def cmd_check(argv: Sequence[str]) -> int:
                     "async hygiene, wire-format parity, protocol "
                     "conformance, resource lifecycle, metric-name "
                     "registration, JAX purity, wire-input taint tracking, "
-                    "exception-path leaks) over the package.  Exits 0 "
+                    "exception-path leaks, protocol state-machine "
+                    "exploration) over the package.  Exits 0 "
                     "when clean, 1 when there are unsuppressed findings.")
     parser.add_argument("--json", action="store_true",
                         help="emit the versioned JSON report instead of text")
@@ -1534,6 +1535,13 @@ def cmd_check(argv: Sequence[str]) -> int:
                              "given git ref (fingerprint-based; findings "
                              "already present at the ref are treated as "
                              "an ephemeral baseline) — fast pre-commit runs")
+    parser.add_argument("--fsm-dump", metavar="DOT_PATH", default=None,
+                        help="extract the protocol endpoint automata and "
+                             "write them as Graphviz DOT to this path, "
+                             "then exit (no rules are run)")
+    parser.add_argument("--profile", action="store_true",
+                        help="print per-rule-family wall-clock timings to "
+                             "stderr after the run")
     args = parser.parse_args(argv)
     if args.rules:
         # --rules taint,exc and --rules taint exc are both accepted.
@@ -1557,6 +1565,16 @@ def cmd_check(argv: Sequence[str]) -> int:
         str(root), "tools", "lint_baseline.json")
     project = analysis.Project.from_root(root)
 
+    if args.fsm_dump:
+        from distributedmandelbrot_tpu.analysis import fsm
+        pairs = fsm.build_pairs(project)
+        with open(args.fsm_dump, "w", encoding="utf-8") as fh:
+            fh.write(fsm.to_dot(pairs))
+        print(f"dmtpu check: wrote {len(pairs)} exchange automaton "
+              f"pair(s) -> {args.fsm_dump}")
+        return 0
+
+    timings: dict = {}
     try:
         if args.update_baseline:
             findings = analysis.check_project(project, args.rules)
@@ -1574,7 +1592,9 @@ def cmd_check(argv: Sequence[str]) -> int:
             ref_fps = analysis.fingerprints_at_ref(root, args.diff,
                                                    args.rules)
         report = analysis.run_check(project, args.rules,
-                                    baseline | ref_fps)
+                                    baseline | ref_fps,
+                                    timings=timings if args.profile
+                                    else None)
         if ref_fps:
             # Ephemeral entries that no longer match are expected churn
             # (the point of --diff is that old findings went away or
@@ -1594,6 +1614,12 @@ def cmd_check(argv: Sequence[str]) -> int:
     if args.severity == "error":
         report.findings = [f for f in report.findings
                            if f.severity == "error"]
+    if args.profile:
+        # stderr so the JSON report on stdout stays machine-parseable
+        total = sum(timings.values())
+        for fam, secs in sorted(timings.items(), key=lambda kv: -kv[1]):
+            print(f"dmtpu check: {fam:14s} {secs:6.3f}s", file=sys.stderr)
+        print(f"dmtpu check: {'total':14s} {total:6.3f}s", file=sys.stderr)
     print(analysis.render_json(report) if args.json
           else analysis.render_text(report))
     return 0 if report.clean else 1

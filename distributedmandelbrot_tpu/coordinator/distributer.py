@@ -396,7 +396,8 @@ class Distributer:
                                              negotiated)
             else:
                 raise framing.ProtocolError(
-                    f"unknown session frame type {frame_type:#x}")
+                    f"unknown session frame type "
+                    f"{proto.frame_name(frame_type)}")
             await writer.drain()
 
     async def _session_lease(self, reader: asyncio.StreamReader,

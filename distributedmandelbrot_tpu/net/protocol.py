@@ -162,6 +162,27 @@ FRAME_RING_INFO = 0x09  # server->client: RING_INFO (version, shard, n)
 # re-routes the result there — bounded by MAX_REDIRECT_HOPS.
 FRAME_REDIRECT = 0x0A  # server->client: REDIRECT (shard, ring version)
 
+# Wire-value -> symbolic name, for diagnostics.  Protocol errors that
+# name the frame (not just its byte) turn a hexdump hunt into a grep.
+FRAME_NAMES = {
+    FRAME_LEASE_REQ: "FRAME_LEASE_REQ",
+    FRAME_LEASE_GRANT: "FRAME_LEASE_GRANT",
+    FRAME_UPLOAD: "FRAME_UPLOAD",
+    FRAME_UPLOAD_ACK: "FRAME_UPLOAD_ACK",
+    FRAME_SPANS: "FRAME_SPANS",
+    FRAME_LEASE_REQN: "FRAME_LEASE_REQN",
+    FRAME_LEASE_GRANTN: "FRAME_LEASE_GRANTN",
+    FRAME_RING_REQ: "FRAME_RING_REQ",
+    FRAME_RING_INFO: "FRAME_RING_INFO",
+    FRAME_REDIRECT: "FRAME_REDIRECT",
+}
+
+
+def frame_name(frame_type: int) -> str:
+    """``FRAME_UPLOAD (0x03)`` for known types, ``0x2a`` for garbage."""
+    name = FRAME_NAMES.get(frame_type)
+    return f"{name} ({frame_type:#x})" if name else f"{frame_type:#x}"
+
 # Upload result codecs (UPLOAD_HEADER.codec).  RLE reuses the storage
 # codec's body format (codecs/rle.py, code 0x01) so wire and disk agree.
 WIRE_CODEC_RAW = 0x00

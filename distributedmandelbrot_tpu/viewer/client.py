@@ -104,7 +104,14 @@ class DataClient:
 
     def _read_response(self, sock: socket.socket
                        ) -> tuple[Optional[np.ndarray], FetchStatus]:
-        status = framing.recv_byte(sock)
+        # The model pairs this reader with the dataserver, which never
+        # sheds, so the QUERY_OVERLOADED arm is dead in every explored
+        # configuration.  The arm is still live in production: the
+        # gateway's plain-query path answers the same framing and DOES
+        # send OVERLOADED under admission pressure, but it reads the
+        # request as u32 + tail rather than one QUERY struct and so
+        # sits outside the extracted exchange pairs.  Audited 2026-08.
+        status = framing.recv_byte(sock)  # dmtpu: ignore[fsm-dead-arm]
         miss = _STATUS_BY_BYTE.get(status)
         if miss is not None:
             return None, miss

@@ -362,8 +362,9 @@ class DistributerSession:
             framing.recv_exact(self._sock, proto.SESSION_FRAME_WIRE_SIZE))
         if frame_type not in want_types:
             raise framing.ProtocolError(
-                f"unexpected session frame type {frame_type:#x} "
-                f"(wanted one of {[f'{t:#x}' for t in want_types]})")
+                f"unexpected session frame type "
+                f"{proto.frame_name(frame_type)} (wanted one of "
+                f"{[proto.frame_name(t) for t in want_types]})")
         proto.validate_session_seq(seq, want_seq)
         return frame_type, proto.validate_payload_length(length)
 

@@ -30,7 +30,7 @@ def test_rule_catalogue_covers_all_families():
     rules = all_rules()
     families = {r.family for r in rules.values()}
     assert {"locks", "async", "wire", "jax", "engine",
-            "proto", "res", "obs"} <= families
+            "proto", "res", "obs", "fsm"} <= families
     for rule in rules.values():
         assert rule.severity in ("error", "warning")
         assert rule.doc
@@ -1332,6 +1332,207 @@ class W:
     found = findings_for({NAMES_MOD: NAMES_SRC, f"{P}/worker/w.py": src},
                          "obs-name")
     assert len(found) == 1
+
+
+# -- obs-dead --------------------------------------------------------------
+
+DEAD_NAMES_SRC = '''
+TILES_DONE = "tiles_done"
+GHOST_DEPTH = "ghost_depth"
+
+LEGACY_ALIASES: dict[str, str] = {TILES_DONE: "tiles_complete"}
+'''
+
+
+def test_obs_dead_fires_on_uninstrumented_registration():
+    src = '''
+class W:
+    def f(self):
+        self.counters.inc("tiles_done")
+'''
+    found = findings_for({NAMES_MOD: DEAD_NAMES_SRC,
+                          f"{P}/worker/w.py": src}, "obs-dead")
+    assert len(found) == 1
+    assert "GHOST_DEPTH" in found[0].message
+    assert found[0].path == NAMES_MOD  # anchored at the registration
+
+
+def test_obs_dead_clean_when_referenced_by_attribute_or_literal():
+    src = f'''
+from {P}.obs import names as obs_names
+
+
+class W:
+    def f(self):
+        self.counters.inc("tiles_done")
+        self.gauges.set(obs_names.GHOST_DEPTH, 2)
+'''
+    assert findings_for({NAMES_MOD: DEAD_NAMES_SRC,
+                         f"{P}/worker/w.py": src}, "obs-dead") == []
+
+
+# -- fsm: protocol state machines ------------------------------------------
+
+FSM_CLIENT_REL = f"{P}/viewer/client.py"
+FSM_SERVER_REL = f"{P}/coordinator/dataserver.py"
+
+FSM_QUERY_CLIENT = f'''
+from {P}.net import framing
+from {P}.net import protocol as proto
+
+
+class DataClient:
+    def _fetch_once(self, sock, level, ir, ii):
+        framing.send_all(sock, proto.QUERY.pack(level, ir, ii))
+        status = framing.recv_byte(sock)
+        if status == proto.QUERY_REJECT:
+            return None
+        if status != proto.QUERY_ACCEPT:
+            raise framing.ProtocolError("bad status")
+        return b"tile"
+'''
+
+FSM_QUERY_SERVER = f'''
+from {P}.net import framing
+from {P}.net import protocol as proto
+
+
+class DataServer:
+    def _handle_connection(self, conn):
+        level, ir, ii = proto.QUERY.unpack(
+            framing.recv_exact(conn, proto.QUERY.size))
+        if self._have(level, ir, ii):
+            framing.send_byte(conn, proto.QUERY_ACCEPT)
+        else:
+            framing.send_byte(conn, proto.QUERY_REJECT)
+
+    def _have(self, level, ir, ii):
+        return True
+'''
+
+
+def test_fsm_dual_fires_on_send_without_receive_arm():
+    # The client piggybacks a RENDER_QUERY_TAIL the server never reads.
+    client = FSM_QUERY_CLIENT.replace(
+        "        status = framing.recv_byte(sock)",
+        "        framing.send_all(sock, proto.RENDER_QUERY_TAIL.pack(0, 0))\n"
+        "        status = framing.recv_byte(sock)")
+    found = findings_for({FSM_CLIENT_REL: client,
+                          FSM_SERVER_REL: FSM_QUERY_SERVER}, "fsm-dual")
+    assert found
+    assert "RENDER_QUERY_TAIL" in found[0].message
+
+
+def test_fsm_dual_clean_on_matched_pair():
+    assert findings_for({FSM_CLIENT_REL: FSM_QUERY_CLIENT,
+                         FSM_SERVER_REL: FSM_QUERY_SERVER}, "fsm-dual") == []
+
+
+def test_fsm_dead_arm_fires_on_branch_no_config_reaches():
+    # Server can only ever accept, so the client's REJECT arm is dead.
+    server = FSM_QUERY_SERVER.replace(
+        """        if self._have(level, ir, ii):
+            framing.send_byte(conn, proto.QUERY_ACCEPT)
+        else:
+            framing.send_byte(conn, proto.QUERY_REJECT)""",
+        "        framing.send_byte(conn, proto.QUERY_ACCEPT)")
+    found = findings_for({FSM_CLIENT_REL: FSM_QUERY_CLIENT,
+                          FSM_SERVER_REL: server}, "fsm-dead-arm")
+    assert len(found) == 1
+    assert "QUERY_REJECT" in found[0].message
+    assert found[0].path == FSM_CLIENT_REL
+
+
+def test_fsm_dead_arm_clean_when_both_branches_reachable():
+    assert findings_for({FSM_CLIENT_REL: FSM_QUERY_CLIENT,
+                         FSM_SERVER_REL: FSM_QUERY_SERVER},
+                        "fsm-dead-arm") == []
+
+
+FSM_SESSION_CLIENT_REL = f"{P}/worker/client.py"
+FSM_SESSION_SERVER_REL = f"{P}/coordinator/distributer.py"
+
+# The gate test on the send is what separates fire from no-fire below.
+FSM_SESSION_CLIENT_GUARDED = f'''
+from {P}.net import framing
+from {P}.net import protocol as proto
+
+
+class DistributerSession:
+    def connect(self):
+        framing.send_byte(self._sock, proto.PURPOSE_SESSION)
+        return True
+
+    def upload(self, seq):
+        framing.send_all(
+            self._sock,
+            proto.SESSION_FRAME.pack(proto.FRAME_UPLOAD, seq, 0))
+
+    def send_spans(self, seq):
+        if self.flags & proto.SESSION_FLAG_RLE:
+            framing.send_all(
+                self._sock,
+                proto.SESSION_FRAME.pack(proto.FRAME_SPANS, seq, 0))
+'''
+
+FSM_SESSION_SERVER_GATED = f'''
+from {P}.net import framing
+from {P}.net import protocol as proto
+
+
+class Distributer:
+    async def _handle_session(self, reader, writer):
+        while True:
+            try:
+                frame_type, seq, length = proto.SESSION_FRAME.unpack(
+                    await framing.read_exact(
+                        reader, proto.SESSION_FRAME.size))
+            except ConnectionError:
+                return
+            if frame_type == proto.FRAME_UPLOAD:
+                continue
+            if self.caps & proto.SESSION_FLAG_RLE:
+                if frame_type == proto.FRAME_SPANS:
+                    continue
+            raise framing.ProtocolError("unexpected frame")
+'''
+
+
+def test_fsm_cap_gate_fires_on_unguarded_send():
+    client = FSM_SESSION_CLIENT_GUARDED.replace(
+        """        if self.flags & proto.SESSION_FLAG_RLE:
+            framing.send_all(
+                self._sock,
+                proto.SESSION_FRAME.pack(proto.FRAME_SPANS, seq, 0))""",
+        """        framing.send_all(
+            self._sock,
+            proto.SESSION_FRAME.pack(proto.FRAME_SPANS, seq, 0))""")
+    found = findings_for({FSM_SESSION_CLIENT_REL: client,
+                          FSM_SESSION_SERVER_REL: FSM_SESSION_SERVER_GATED},
+                         "fsm-cap-gate")
+    assert found
+    assert "RLE" in found[0].message
+
+
+def test_fsm_cap_gate_clean_when_send_guarded_by_same_cap():
+    sources = {FSM_SESSION_CLIENT_REL: FSM_SESSION_CLIENT_GUARDED,
+               FSM_SESSION_SERVER_REL: FSM_SESSION_SERVER_GATED}
+    assert findings_for(sources, "fsm-cap-gate") == []
+
+
+def test_fsm_deadlock_fires_on_desynced_fixture():
+    # One send, two reads: the product wedges with both sides waiting.
+    server = FSM_QUERY_SERVER.replace(
+        "        level, ir, ii = proto.QUERY.unpack(\n"
+        "            framing.recv_exact(conn, proto.QUERY.size))",
+        "        level, ir, ii = proto.QUERY.unpack(\n"
+        "            framing.recv_exact(conn, proto.QUERY.size))\n"
+        "        level, ir, ii = proto.QUERY.unpack(\n"
+        "            framing.recv_exact(conn, proto.QUERY.size))")
+    found = findings_for({FSM_CLIENT_REL: FSM_QUERY_CLIENT,
+                          FSM_SERVER_REL: server}, "fsm-deadlock")
+    assert found
+    assert "client@" in found[0].message and "server@" in found[0].message
 
 
 # -- engine: suppressions, baseline, reporters -----------------------------

@@ -530,3 +530,28 @@ def test_enable_compile_cache_env_and_knob(tmp_path, monkeypatch):
     cli._enable_compile_cache()
     assert not calls
     assert "JAX_COMPILATION_CACHE_DIR" not in os.environ
+
+
+def test_check_fsm_dump_roundtrip(tmp_path, capsys):
+    dot = tmp_path / "fsm.dot"
+    assert cli.main(["check", "--fsm-dump", str(dot)]) == 0
+    out = capsys.readouterr().out
+    assert "wrote 4 exchange automaton pair(s)" in out
+    text = dot.read_text(encoding="utf-8")
+    assert text.startswith("digraph fsm {")
+    assert text.rstrip().endswith("}")
+    # two endpoint clusters per exchange pair
+    assert text.count("subgraph") == 8
+    for exchange in ("session", "query", "render_query", "session_query"):
+        assert exchange in text
+    # send/recv edge labels carry the !/? convention
+    assert "!" in text and "?" in text
+
+
+def test_check_profile_prints_per_family_timings(capsys):
+    assert cli.main(["check", "--profile"]) == 0
+    captured = capsys.readouterr()
+    # timings go to stderr so --json output stays machine-parseable
+    assert "rules_fsm" in captured.err
+    assert "total" in captured.err
+    assert "rules_fsm" not in captured.out

@@ -67,6 +67,18 @@ def test_v3_taint_and_exc_families_are_registered():
         assert rule.severity in ("error", "warning")
 
 
+def test_v4_fsm_family_is_registered():
+    # The model-checking family rides in the same gate: the protocol
+    # automata explore clean on the real tree with it on.
+    from distributedmandelbrot_tpu import analysis
+    families = {r.family for r in analysis.all_rules().values()}
+    assert "fsm" in families
+    expanded = analysis.expand_rule_ids(["fsm"])
+    assert {"fsm-dual", "fsm-deadlock", "fsm-cap-gate",
+            "fsm-dead-arm"} <= set(expanded)
+    assert "obs-dead" in analysis.all_rules()
+
+
 def test_baseline_has_no_entries():
     # The v2 rollout fixed or inline-suppressed every true positive; the
     # committed baseline must stay empty so new findings always surface.
@@ -79,9 +91,11 @@ def test_baseline_has_no_entries():
 def test_metric_name_literals_are_registered():
     result = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "check_metrics.py"),
-         "--offline", "--names"],
+         "--offline", "--names", "--dead"],
         cwd=REPO, capture_output=True, text=True, timeout=120,
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert result.returncode == 0, \
-        f"check_metrics --names failed:\n{result.stdout}\n{result.stderr}"
+        f"check_metrics --names --dead failed:\n" \
+        f"{result.stdout}\n{result.stderr}"
     assert "names:" in result.stdout
+    assert "dead:" in result.stdout

@@ -241,7 +241,7 @@ def check_names() -> int:
         raise MetricsFormatError(
             "obs/names.py not found — cannot audit metric names")
     sites = sum(1 for _ in rules_obs.iter_sites(project))
-    unknown = rules_obs.check(project)
+    unknown = [f for f in rules_obs.check(project) if f.rule == "obs-name"]
     for f in unknown:
         print(f"{f.path}:{f.line}: {f.message}", file=sys.stderr)
     if unknown:
@@ -250,6 +250,28 @@ def check_names() -> int:
     print(f"names: {sites} instrumentation literals OK "
           f"against {len(known)} registered names")
     return sites
+
+
+def check_dead() -> int:
+    """The reverse audit (``obs-dead`` in ``dmtpu check``): every name
+    obs/names.py registers must be instrumented or referenced somewhere,
+    or the registry is describing telemetry the fleet no longer emits."""
+    from distributedmandelbrot_tpu import analysis
+    from distributedmandelbrot_tpu.analysis import rules_obs
+    project = analysis.Project.from_root(REPO)
+    consts = rules_obs.registered_consts(project)
+    if consts is None:
+        raise MetricsFormatError(
+            "obs/names.py not found — cannot audit registered names")
+    dead = [f for f in rules_obs.check(project) if f.rule == "obs-dead"]
+    for f in dead:
+        print(f"{f.path}:{f.line}: {f.message}", file=sys.stderr)
+    if dead:
+        raise MetricsFormatError(
+            f"{len(dead)} registered-but-uninstrumented name(s)")
+    print(f"dead: {len(consts)} registered names all instrumented "
+          f"or referenced")
+    return len(consts)
 
 
 def main() -> int:
@@ -263,10 +285,15 @@ def main() -> int:
     parser.add_argument("--names", action="store_true",
                         help="also audit metric-name literals at "
                              "instrumentation sites against obs/names.py")
+    parser.add_argument("--dead", action="store_true",
+                        help="also audit obs/names.py registrations for "
+                             "names nothing instruments any more")
     args = parser.parse_args()
     check_rendered()
     if args.names:
         check_names()
+    if args.dead:
+        check_dead()
     if not args.offline:
         check_live(args.url)
     print("check_metrics: OK")
