@@ -91,6 +91,24 @@ WORKER_KERNEL_FUSED_LAUNCHES = "worker_kernel_fused_launches"
 WORKER_KERNEL_FUSED_TILES = "worker_kernel_fused_tiles"
 WORKER_KERNEL_BF16_PRUNED = "worker_kernel_bf16_pruned_pixels"
 
+# Mesh megakernel route (one fused launch shard_map'd over every local
+# device): launches that took the route, and device-launch equivalents
+# (devices per launch summed, so devices/launches = the mesh width the
+# route actually spanned; 1-device rings never touch these — the route
+# degenerates to the single-device fused launch).
+WORKER_MESH_LAUNCHES = "worker_mesh_launches"
+WORKER_MESH_DEVICES = "worker_mesh_devices"
+
+# MXU iteration-map gate (ops/mxu_iteration): fused launches that ran
+# the matmul-form recurrence (full mode — bit-parity proven on this
+# platform), launches demoted to the advisory census because the gate
+# was enabled but parity unproven, and the panel pixels that census
+# predicted escape for (advisory only, same precision-boundary contract
+# as the bf16 scout above).
+WORKER_KERNEL_MXU_LAUNCHES = "worker_kernel_mxu_launches"
+WORKER_KERNEL_MXU_DEMOTIONS = "worker_kernel_mxu_demotions"
+WORKER_KERNEL_MXU_CENSUS = "worker_kernel_mxu_census_pixels"
+
 # -- distributed tracing (cross-process spans) ----------------------------
 
 # Worker-side span push over PURPOSE_SPANS (0x04): records pushed,

@@ -1,0 +1,193 @@
+"""MXU reformulation of the escape iteration map (opt-in, parity-gated).
+
+The escape loop is VPU-bound (BENCH_r05: 0.874 VPU utilization with the
+MXU idle).  "Accelerating Compact Fractals with Tensor Core GPUs"
+(PAPERS.md) shows the complex square can ride the matrix units instead:
+embed ``z = zr + i*zi`` as the 2x2 rotation-scaling matrix
+``[[zr, -zi], [zi, zr]]`` — complex multiplication IS multiplication of
+these matrices — and one iteration ``z <- z^2 + c`` becomes a batched
+2x2 matmul over the pixel-block panel plus a vector add:
+
+    [zr']   [zr  -zi] [zr]   [cr]
+    [zi'] = [zi   zr] [zi] + [ci]
+
+:func:`mxu_step` expresses exactly that with ``lax.dot_general`` (batch
+dims = the panel, a 2-element contraction), which Mosaic/XLA can place
+on the matrix units, freeing VPU issue slots for the escape test and
+count bookkeeping that must stay elementwise.
+
+The gate (mirroring ``ops/mixed_precision.py``'s opt-in contract):
+
+- **off** (default) — ``DMTPU_MXU`` unset/0: nothing changes.
+- **full** — ``DMTPU_MXU=1`` *and* :func:`mxu_parity_proven`: the kernel
+  recurrence itself runs through :func:`mxu_step`.  Escape counts are a
+  bit-exact contract, so full mode is admitted only where the probe
+  shows the matmul form rounds identically to the VPU form (a
+  2-term dot may contract into an FMA or reassociate — platform
+  dependent; f32-via-bf16x3 on real MXU passes never qualifies).
+- **census** — ``DMTPU_MXU=1`` but parity unproven: the MXU form runs
+  only as an *advisory* shadow (:func:`mxu_census_counts`, a bf16
+  panel census like the bf16 scout) and never feeds outputs — the same
+  parity-guard contract as ``ops/mixed_precision.py``, which this
+  module imports as its sanctioned half-precision gateway.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributedmandelbrot_tpu.ops.mixed_precision import (scout_cast,
+                                                           scout_const)
+
+# The opt-in env gate (unset/0 = off); see the module docstring.
+MXU_ENV = "DMTPU_MXU"
+
+# Probe geometry: a fixed panel spanning the escape-relevant dynamic
+# range, iterated long enough for one ulp of divergence to surface
+# (divergence compounds exponentially on boundary orbits, so 32 steps
+# exposes any rounding difference the first step introduces).
+_PROBE_N = 64
+_PROBE_STEPS = 32
+
+# Census panel edge: the advisory shadow samples the tile on a coarse
+# sub-grid so a whole batch costs a fraction of one real segment.
+CENSUS_PANEL = 32
+
+_parity_cache: dict[str, bool] = {}
+
+
+def mxu_step(zr, zi, c_real, c_imag):
+    """One ``z <- z^2 + c`` step in the 2x2 rotation-matrix matmul form.
+
+    Panel-batched: ``zr/zi/c_real/c_imag`` share any leading shape; the
+    dot contracts the trailing 2-vector against the per-pixel 2x2
+    embed.  Mathematically identical to the VPU form (``zr^2 - zi^2 +
+    cr``, ``2*zr*zi + ci``); bit-identity depends on how the platform
+    rounds the 2-term contraction, which is exactly what
+    :func:`mxu_parity_proven` probes."""
+    state = jnp.stack([zr, zi], axis=-1)
+    embed = jnp.stack([jnp.stack([zr, -zi], axis=-1),
+                       jnp.stack([zi, zr], axis=-1)], axis=-2)
+    n_batch = state.ndim - 1
+    batch = tuple(range(n_batch))
+    sq = lax.dot_general(
+        embed, state,
+        dimension_numbers=(((embed.ndim - 1,), (state.ndim - 1,)), (batch, batch)),
+        preferred_element_type=state.dtype)
+    return sq[..., 0] + c_real, sq[..., 1] + c_imag
+
+
+def _probe_vpu(zr, zi, c_real, c_imag):
+    """The kernel recurrence's exact rounding order (_run_seg_loop's
+    cached-squares form), chained _PROBE_STEPS times."""
+    zr2 = zr * zr
+    zi2 = zi * zi
+    for _ in range(_PROBE_STEPS):
+        cross = (zr + zr) * zi
+        zi = cross + c_imag
+        zr = zr2 - zi2 + c_real
+        zr2 = zr * zr
+        zi2 = zi * zi
+    return zr, zi
+
+
+def _probe_mxu(zr, zi, c_real, c_imag):
+    for _ in range(_PROBE_STEPS):
+        zr, zi = mxu_step(zr, zi, c_real, c_imag)
+    return zr, zi
+
+
+def mxu_parity_proven() -> bool:
+    """True when the matmul form rounds bit-identically to the VPU form
+    on this platform (cached per process; NaN lanes compared as bit
+    patterns, so an orbit that overflows to inf/NaN must do so in both
+    forms to pass)."""
+    key = jax.default_backend()
+    hit = _parity_cache.get(key)
+    if hit is not None:
+        return hit
+    xs = np.linspace(-2.0, 1.0, _PROBE_N, dtype=np.float32)
+    ys = np.linspace(-1.5, 1.5, _PROBE_N, dtype=np.float32)
+    cr, ci = np.meshgrid(xs, ys)
+    args = (jnp.asarray(cr), jnp.asarray(ci), jnp.asarray(cr),
+            jnp.asarray(ci))
+    v = jax.jit(_probe_vpu)(*args)
+    m = jax.jit(_probe_mxu)(*args)
+    proven = all(
+        np.array_equal(np.asarray(a).view(np.int32),
+                       np.asarray(b).view(np.int32))
+        for a, b in zip(v, m))
+    _parity_cache[key] = proven
+    return proven
+
+
+def mxu_mode() -> str:
+    """Resolve the gate: ``"off"`` / ``"census"`` / ``"full"`` (see the
+    module docstring).  Full requires proven bit-parity; an enabled but
+    unproven platform demotes to the advisory census."""
+    if os.environ.get(MXU_ENV, "0") == "0":
+        return "off"
+    return "full" if mxu_parity_proven() else "census"
+
+
+def reset_mxu_cache() -> None:
+    """Drop the cached parity verdict (tests that monkeypatch platforms)."""
+    _parity_cache.clear()
+
+
+@partial(jax.jit, static_argnames=("k", "panel", "steps"))
+def _census_panel(params, mrds, *, k: int, panel: int, steps: int):
+    """bf16 MXU-form shadow over a coarse per-tile panel: count pixels
+    predicted to escape within ``steps`` iterations (capped by each
+    tile's own budget).  Advisory only — bf16 orbits diverge on boundary
+    pixels and the panel undersamples; both are fine for an occupancy
+    census (the parity-guard contract)."""
+    col = lax.broadcasted_iota(jnp.int32, (k, panel, panel), 2)
+    row = lax.broadcasted_iota(jnp.int32, (k, panel, panel), 1)
+    start_r = params[:, 0][:, None, None]
+    start_i = params[:, 1][:, None, None]
+    step_r = params[:, 2][:, None, None]
+    step_i = params[:, 3][:, None, None]
+    c_real = scout_cast(start_r + col.astype(jnp.float32) * step_r)
+    c_imag = scout_cast(start_i + row.astype(jnp.float32) * step_i)
+    four = scout_const(4.0)
+    zr = c_real
+    zi = c_imag
+    act = jnp.ones((k, panel, panel), jnp.int32)
+    esc = jnp.zeros((k, panel, panel), jnp.int32)
+    for it in range(steps):
+        zr, zi = mxu_step(zr, zi, c_real, c_imag)
+        in_budget = jnp.asarray(it + 1, jnp.int32) <= mrds[:, None, None]
+        hit = jnp.where((zr * zr + zi * zi >= four) & in_budget, act, 0)
+        esc = esc + hit
+        act = act - hit
+    return jnp.sum(esc, axis=(1, 2))
+
+
+def mxu_census_counts(params, max_iters, *, height: int, width: int,
+                      steps: int = 64,
+                      panel: int = CENSUS_PANEL) -> np.ndarray:
+    """The census-only fallback: run the bf16 MXU-form shadow on a
+    ``panel x panel`` sub-grid of each tile and return the per-tile
+    count of panel pixels predicted to escape within ``min(steps,
+    budget)`` iterations.  ``params`` is the kernel's (k, 4) per-axis
+    pitch rows (host array); the pitch is stretched by
+    ``(extent - 1) / (panel - 1)`` so the panel spans the same complex
+    window the full ``height x width`` tile covers."""
+    params = np.array(params, np.float32, copy=True)
+    k = params.shape[0]
+    if k == 0:
+        return np.zeros((0,), np.int32)
+    if panel > 1:
+        params[:, 2] *= (width - 1) / (panel - 1)
+        params[:, 3] *= (height - 1) / (panel - 1)
+    mrds = jnp.asarray([int(m) for m in max_iters], jnp.int32)
+    out = _census_panel(jnp.asarray(params), mrds, k=k, panel=int(panel),
+                        steps=int(steps))
+    return np.asarray(out, np.int32)

@@ -46,6 +46,7 @@ from distributedmandelbrot_tpu.ops.escape_time import (
     family_interior, family_step, probe_step, resolve_cycle_check)
 from distributedmandelbrot_tpu.ops.mixed_precision import (scout_cast,
                                                            scout_const)
+from distributedmandelbrot_tpu.ops.mxu_iteration import mxu_step
 
 def _pallas():
     """Import pallas lazily: on some builds the import itself fails unless
@@ -165,7 +166,8 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                          unroll: int, block_h: int, block_w: int,
                          clamp: bool, interior_check: bool,
                          cycle_check: bool, julia: bool = False,
-                         power: int = 2, burning: bool = False):
+                         power: int = 2, burning: bool = False,
+                         use_mxu: bool = False):
     """One (block_h, block_w) block: in-kernel grid -> escape loop -> uint8.
 
     Semantics pinned to the reference kernel
@@ -190,7 +192,8 @@ def _escape_block_kernel(params_ref, mrd_ref, out_ref, zr_ref, zi_ref,
                       snap_refs, max_iter=max_iter, unroll=unroll,
                       block_h=block_h, block_w=block_w, clamp=clamp,
                       interior_check=interior_check, cycle_check=cycle_check,
-                      julia=julia, power=power, burning=burning)
+                      julia=julia, power=power, burning=burning,
+                      use_mxu=use_mxu)
 
 
 def _load_block_coords(params_ref, mrd_ref, t, i, j, shape,
@@ -223,7 +226,7 @@ def _load_block_coords(params_ref, mrd_ref, t, i, j, shape,
 def _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
                   live0, *, cond_cap, sat_steps, unroll: int,
                   cycle_check: bool, power: int, burning: bool,
-                  it0=None, dyn_ref=None):
+                  it0=None, dyn_ref=None, use_mxu: bool = False):
     """The ONE segmented escape while-loop, shared by the single-tile,
     batch-grid, phase-1 state, and compaction resume kernels — sharing
     this body is what makes every dispatch (and the two halves of a
@@ -280,7 +283,13 @@ def _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
         zr2 = zr * zr
         zi2 = zi * zi
         for step in range(unroll):
-            if power == 2:
+            if use_mxu and power == 2 and not burning:
+                # MXU full mode (gate-admitted only where the parity
+                # probe proved the matmul form rounds identically —
+                # see ops/mxu_iteration.py): the square rides a
+                # batched 2x2 matmul, the escape test stays VPU.
+                zr, zi = mxu_step(zr, zi, c_real, c_imag)
+            elif power == 2:
                 # Cached-squares form.  The Burning Ship fold reduces to
                 # ONE extra abs here: squares are abs-invariant, so the
                 # zr update is unchanged and 2|zr||zi| = |2 zr zi|.
@@ -344,7 +353,7 @@ def _escape_tile_body(i, j, t, shape, store, params_ref, mrd_ref, zr_ref,
                       zi_ref, act_ref, n_ref, snap_refs, *, max_iter: int,
                       unroll: int, block_h: int, block_w: int, clamp: bool,
                       interior_check: bool, cycle_check: bool, julia: bool,
-                      power: int, burning: bool):
+                      power: int, burning: bool, use_mxu: bool = False):
     """The one escape-loop body shared by the single-tile and batch-grid
     kernels (they differ only in which params/mrd row ``t`` feeds the
     block and where ``store`` lands the uint8 result).  Keeping this a
@@ -376,7 +385,7 @@ def _escape_tile_body(i, j, t, shape, store, params_ref, mrd_ref, zr_ref,
     _run_seg_loop(zr_ref, zi_ref, act_ref, n_ref, snap_refs, c_real, c_imag,
                   live0, cond_cap=dyn_steps, sat_steps=dyn_steps,
                   unroll=unroll, cycle_check=cycle_check, power=power,
-                  burning=burning)
+                  burning=burning, use_mxu=use_mxu)
 
     n = n_ref[:]
     counts = jnp.where(n >= dyn_steps, 0, n + 1)
@@ -389,14 +398,15 @@ def _escape_tile_body(i, j, t, shape, store, params_ref, mrd_ref, zr_ref,
 @partial(jax.jit, static_argnames=("height", "width", "max_iter", "unroll",
                                    "block_h", "block_w", "clamp", "interpret",
                                    "interior_check", "cycle_check", "julia",
-                                   "power", "burning"))
+                                   "power", "burning", "use_mxu"))
 def _pallas_escape(params, mrd=None, *, height: int, width: int,
                    max_iter: int, unroll: int = DEFAULT_UNROLL,
                    block_h: int = DEFAULT_BLOCK_H,
                    block_w: int = DEFAULT_BLOCK_W, clamp: bool = False,
                    interpret: bool = False, interior_check: bool = True,
                    cycle_check: bool | None = None, julia: bool = False,
-                   power: int = 2, burning: bool = False):
+                   power: int = 2, burning: bool = False,
+                   use_mxu: bool = False):
     """``max_iter`` is the static compile cap; ``mrd`` (defaults to the
     cap) is this tile's traced budget — see ``_escape_block_kernel``.
     params shape (1, 4): ``(start_real, start_imag, step_real,
@@ -418,7 +428,8 @@ def _pallas_escape(params, mrd=None, *, height: int, width: int,
                      unroll=max(1, min(unroll, max(1, max_iter - 1))),
                      block_h=block_h, block_w=block_w, clamp=clamp,
                      interior_check=interior_check, cycle_check=cycle_check,
-                     julia=julia, power=power, burning=burning)
+                     julia=julia, power=power, burning=burning,
+                     use_mxu=use_mxu)
     n_params = 6 if julia else 4
     return pl.pallas_call(
         kernel,
@@ -630,7 +641,8 @@ def _escape_mega_kernel(params_ref, mrd_ref, out_ref, scout_ref, zr_ref,
                         unroll: int, block_h: int, block_w: int, clamp: bool,
                         interior_check: bool, cycle_check: bool,
                         scout_steps: int, julia: bool = False,
-                        power: int = 2, burning: bool = False):
+                        power: int = 2, burning: bool = False,
+                        use_mxu: bool = False):
     """One (block_h, block_w) block of tile ``t = program_id(0)``, with
     the INTEGER half of the prologue software-pipelined one grid step
     ahead.
@@ -713,7 +725,8 @@ def _escape_mega_kernel(params_ref, mrd_ref, out_ref, scout_ref, zr_ref,
     _run_seg_loop(zr_ref, zi_ref, act_ref.at[p], n_ref.at[p], snap_refs,
                   c_real, c_imag, live_ref[p], cond_cap=dyn_steps,
                   sat_steps=dyn_steps, unroll=unroll,
-                  cycle_check=cycle_check, power=power, burning=burning)
+                  cycle_check=cycle_check, power=power, burning=burning,
+                  use_mxu=use_mxu)
 
     n = n_ref[p]
     counts = jnp.where(n >= dyn_steps, 0, n + 1)
@@ -744,7 +757,7 @@ def _escape_mega_kernel(params_ref, mrd_ref, out_ref, scout_ref, zr_ref,
                                    "unroll", "block_h", "block_w", "clamp",
                                    "interpret", "interior_check",
                                    "cycle_check", "scout_segments", "julia",
-                                   "power", "burning"))
+                                   "power", "burning", "use_mxu"))
 def _pallas_escape_mega(params, mrds, *, k: int, height: int, width: int,
                         max_iter: int, unroll: int = DEFAULT_UNROLL,
                         block_h: int = DEFAULT_BLOCK_H,
@@ -752,7 +765,8 @@ def _pallas_escape_mega(params, mrds, *, k: int, height: int, width: int,
                         interpret: bool = False, interior_check: bool = True,
                         cycle_check: bool | None = None,
                         scout_segments: int = 0, julia: bool = False,
-                        power: int = 2, burning: bool = False):
+                        power: int = 2, burning: bool = False,
+                        use_mxu: bool = False):
     """``k`` tiles in ONE launch with pipelined prologues and the bf16
     scouting census -> ``((k, height, width) uint8, (k, 1) int32)``.
     Same params/mrds layout as :func:`_pallas_escape_batch`; outputs are
@@ -768,7 +782,8 @@ def _pallas_escape_mega(params, mrds, *, k: int, height: int, width: int,
                      block_w=block_w, clamp=clamp,
                      interior_check=interior_check, cycle_check=cycle_check,
                      scout_steps=int(scout_segments) * unroll_eff,
-                     julia=julia, power=power, burning=burning)
+                     julia=julia, power=power, burning=burning,
+                     use_mxu=use_mxu)
     return pl.pallas_call(
         kernel,
         grid=(k, gh, gw),
@@ -807,7 +822,8 @@ def compute_tiles_mega_pallas(specs, max_iters, *,
                               scout_segments: int | None = None,
                               power: int = 2, burning: bool = False,
                               julia_cs=None,
-                              device: jax.Device | None = None):
+                              device: jax.Device | None = None,
+                              use_mxu: bool | None = None):
     """Fuse ``k`` same-shaped tiles into ONE megakernel launch; returns
     ``(tiles, scout)`` still on device — ``tiles`` is (k, height, width)
     uint8 (slice per-tile handles off it), ``scout`` is (k, 1) int32
@@ -824,10 +840,56 @@ def compute_tiles_mega_pallas(specs, max_iters, *,
     :func:`compute_tile_pallas_device`.  Raises
     :class:`PallasUnsupported` on the usual shape/pitch/budget limits —
     fall-back sites dispatch per-tile instead.
+
+    ``use_mxu``: ``None`` (default) resolves the ops/mxu_iteration gate —
+    the recurrence rides the 2x2-matmul form only when ``DMTPU_MXU=1``
+    AND the parity probe proved bit-identical rounding on this platform
+    (the census-only fallback never reaches this kernel; the backend
+    runs it as a separate advisory shadow).  An explicit ``True``
+    (tests, benches) skips the gate but still requires the degree-2
+    non-burning recurrence.
     """
+    rows, mrd_rows, kw = mega_dispatch_plan(
+        specs, max_iters, unroll=unroll, block_h=block_h, block_w=block_w,
+        clamp=clamp, interpret=interpret, interior_check=interior_check,
+        cycle_check=cycle_check, scout_segments=scout_segments, power=power,
+        burning=burning, julia_cs=julia_cs, use_mxu=use_mxu)
+    params = jnp.asarray(rows, jnp.float32)
+    mrds = jnp.asarray(mrd_rows, jnp.int32)
+    if device is not None:
+        params = jax.device_put(params, device)
+        mrds = jax.device_put(mrds, device)
+    return _pallas_escape_mega(params, mrds, k=len(specs), **kw)
+
+
+def mega_dispatch_plan(specs, max_iters, *, unroll: int = DEFAULT_UNROLL,
+                       block_h: int = DEFAULT_BLOCK_H,
+                       block_w: int | None = None, clamp: bool = False,
+                       interpret: bool | None = None,
+                       interior_check: bool = True,
+                       cycle_check: bool | None = None,
+                       scout_segments: int | None = None,
+                       power: int = 2, burning: bool = False,
+                       julia_cs=None, use_mxu: bool | None = None):
+    """Validate a fused tile batch and resolve every static dispatch
+    decision of the megakernel — the ONE copy of the policy shared by
+    the single-device route (:func:`compute_tiles_mega_pallas`) and the
+    mesh route (parallel/sharding.compute_tiles_mega_sharded), so the
+    two can never drift (the pallas_batch_config precedent).  Returns
+    ``(rows, mrd_rows, kwargs)``: host-side params rows, ``(k, 1)``
+    budget rows, and the static keyword set for
+    :func:`_pallas_escape_mega` (everything but ``k``, which the mesh
+    path rewrites to its per-device shard size)."""
     k = len(specs)
     julia = julia_cs is not None
     _check_dispatch_mode(power, burning, julia)
+    if use_mxu is None:
+        from distributedmandelbrot_tpu.ops.mxu_iteration import mxu_mode
+        use_mxu = mxu_mode() == "full"
+    if use_mxu and (power != 2 or burning):
+        raise PallasUnsupported(
+            "mxu iteration form supports the degree-2 non-burning "
+            "recurrence only")
     if k < 1:
         raise ValueError("empty tile batch")
     if len(max_iters) != k:
@@ -845,21 +907,18 @@ def compute_tiles_mega_pallas(specs, max_iters, *,
         interpret = not pallas_available()
     rows = [_params_row(spec, julia_cs[idx] if julia else None)
             for idx, spec in enumerate(specs)]
-    params = jnp.asarray(rows, jnp.float32)
-    mrds = jnp.asarray([[int(m)] for m in max_iters], jnp.int32)
-    if device is not None:
-        params = jax.device_put(params, device)
-        mrds = jax.device_put(mrds, device)
+    mrd_rows = [[int(m)] for m in max_iters]
     if scout_segments is None:
         scout_segments = (SCOUT_SEGMENTS_DEFAULT
                           if cap_req >= SCOUT_MIN_ITER else 0)
-    return _pallas_escape_mega(
-        params, mrds, k=k, height=h, width=w, max_iter=bucket_cap(cap_req),
-        unroll=unroll, block_h=block_h, block_w=block_w, clamp=clamp,
-        interpret=interpret, interior_check=interior_check and not julia,
+    kwargs = dict(
+        height=h, width=w, max_iter=bucket_cap(cap_req), unroll=unroll,
+        block_h=block_h, block_w=block_w, clamp=clamp, interpret=interpret,
+        interior_check=interior_check and not julia,
         cycle_check=resolve_cycle_check(cycle_check, cap_req),
         scout_segments=int(scout_segments), julia=julia, power=power,
-        burning=burning)
+        burning=burning, use_mxu=bool(use_mxu))
+    return rows, mrd_rows, kwargs
 
 
 # --- Packed multi-tile kernel ------------------------------------------------

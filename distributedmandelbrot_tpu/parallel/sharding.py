@@ -44,9 +44,28 @@ from distributedmandelbrot_tpu.ops.escape_time import (DEFAULT_SEGMENT,
 from distributedmandelbrot_tpu.parallel.mesh import ROW_AXIS, TILE_AXIS
 
 try:
-    from jax import shard_map  # JAX >= 0.8
+    from jax import shard_map as _shard_map  # JAX >= 0.8
 except ImportError:  # older JAX
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+# The "skip the static sharding checker" kwarg was renamed check_rep ->
+# check_vma across JAX versions; resolve once at import.  Every wrapper
+# here runs with the checker OFF: the per-tile computations carry no
+# collectives (nothing for the check to protect), pallas_call out_shapes
+# carry no varying-mesh-axes annotation (the vma checker rejects them),
+# and older JAX has no replication rule for while_loop at all (the
+# rep checker rejects the escape loop itself).
+import inspect as _inspect
+
+_SHARD_CHECK_KW = ("check_vma" if "check_vma"
+                   in _inspect.signature(_shard_map).parameters
+                   else "check_rep")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+    kwargs.setdefault(_SHARD_CHECK_KW, False)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
 
 
 def _device_grid(start_r, start_i, step, shape, dtype, row_offset=0):
@@ -251,13 +270,13 @@ def _batched_pallas_sharded(params, mrds, *, mesh: Mesh, definition: int,
                 clamp=clamp, interpret=interpret, cycle_check=cycle_check)
         return lax.map(lambda args: one_tile(*args), (p_shard, m_shard))
 
-    # check_vma off: pallas_call's out_shape is a plain ShapeDtypeStruct
-    # with no varying-mesh-axes annotation, which the checker rejects;
-    # the computation is per-tile with no collectives, so there is
-    # nothing for the check to protect.
+    # Checker off (see the module-level shard_map wrapper): pallas_call's
+    # out_shape is a plain ShapeDtypeStruct with no varying-mesh-axes
+    # annotation, which the vma checker rejects; the computation is
+    # per-tile with no collectives, so there is nothing to protect.
     return shard_map(shard_fn, mesh=mesh,
                      in_specs=(P(TILE_AXIS), P(TILE_AXIS)),
-                     out_specs=P(TILE_AXIS), check_vma=False)(params, mrds)
+                     out_specs=P(TILE_AXIS))(params, mrds)
 
 
 def pallas_batch_config(definition: int, cap: int,
@@ -328,6 +347,107 @@ def batched_escape_pixels_pallas(mesh: Mesh, starts_steps: np.ndarray,
                                   definition=definition, clamp=clamp,
                                   **cfg)
     return np.asarray(out)[:k]
+
+
+def _pad_mega(rows: list, mrd_rows: list, n_dev: int) -> tuple[list, list]:
+    """Right-pad megakernel params/budget rows to a multiple of the mesh
+    size with trivial tiles (z0 far outside the set, budget 1 — they
+    escape immediately; same policy as :func:`pad_to_mesh`, in the mega
+    kernel's per-axis-pitch row layout)."""
+    pad = (-len(rows)) % n_dev
+    if pad:
+        trivial = [3.0, 3.0] + [0.0] * (len(rows[0]) - 2)
+        rows = list(rows) + [list(trivial) for _ in range(pad)]
+        mrd_rows = list(mrd_rows) + [[1] for _ in range(pad)]
+    return rows, mrd_rows
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "k_loc", "height", "width", "max_iter",
+                          "unroll", "block_h", "block_w", "clamp",
+                          "interpret", "interior_check", "cycle_check",
+                          "scout_segments", "julia", "power", "burning",
+                          "use_mxu"))
+def _mega_sharded(params, mrds, *, mesh: Mesh, k_loc: int, height: int,
+                  width: int, max_iter: int, unroll: int, block_h: int,
+                  block_w: int, clamp: bool, interpret: bool,
+                  interior_check: bool, cycle_check: bool,
+                  scout_segments: int, julia: bool, power: int,
+                  burning: bool, use_mxu: bool):
+    """The megakernel under shard_map: each device runs ONE fused
+    ``k_loc``-tile launch over its shard of the ``tiles`` axis, so a
+    K-tile batch costs one dispatch constant per *host call*, not per
+    device-tile.  Per-tile outputs (pixels + scout census) stay sharded;
+    slicing tile ``i`` off the global array lands on the device that
+    computed it.  Statics arrive pre-resolved from mega_dispatch_plan —
+    every device compiles the identical executable."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        _pallas_escape_mega)
+
+    def shard_fn(p_shard, m_shard):
+        return _pallas_escape_mega(
+            p_shard, m_shard, k=k_loc, height=height, width=width,
+            max_iter=max_iter, unroll=unroll, block_h=block_h,
+            block_w=block_w, clamp=clamp, interpret=interpret,
+            interior_check=interior_check, cycle_check=cycle_check,
+            scout_segments=scout_segments, julia=julia, power=power,
+            burning=burning, use_mxu=use_mxu)
+
+    # Checker off for the same reason as _batched_pallas_sharded: the
+    # pallas_call out_shape carries no varying-mesh-axes annotation, and
+    # the computation is per-tile with no collectives.
+    return shard_map(shard_fn, mesh=mesh,
+                     in_specs=(P(TILE_AXIS), P(TILE_AXIS)),
+                     out_specs=(P(TILE_AXIS), P(TILE_AXIS)))(params, mrds)
+
+
+def compute_tiles_mega_sharded(specs, max_iters, *, mesh: Mesh | None = None,
+                               clamp: bool = False,
+                               interpret: bool | None = None,
+                               interior_check: bool = True,
+                               cycle_check: bool | None = None,
+                               scout_segments: int | None = None,
+                               power: int = 2, burning: bool = False,
+                               julia_cs=None, use_mxu: bool | None = None,
+                               unroll: int | None = None,
+                               block_h: int | None = None,
+                               block_w: int | None = None):
+    """Mesh twin of ops/pallas_escape.compute_tiles_mega_pallas: ONE
+    fused K-tile batch sharded over the ``tiles`` axis across all of
+    ``mesh``'s devices (default: every local device in device_ring
+    order).  Returns ``(tiles, scout)`` still on device — (k, h, w)
+    uint8 and (k, 1) int32, batch order, padding already stripped.
+
+    Bit-identity: every static dispatch decision comes from the same
+    mega_dispatch_plan as the single-device route, and each device runs
+    the unmodified megakernel on its shard — so mesh output is
+    bit-identical to the single-device megakernel (and hence to k
+    single-tile calls) by construction, for any device count.  Raises
+    :class:`~...ops.pallas_escape.PallasUnsupported` on the same
+    shape/pitch/budget limits; callers fall back to the single-device
+    route."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        DEFAULT_BLOCK_H, DEFAULT_UNROLL, mega_dispatch_plan)
+    if mesh is None:
+        from distributedmandelbrot_tpu.parallel.mesh import tile_mesh
+        mesh = tile_mesh()
+    n_dev = mesh.devices.size
+    rows, mrd_rows, kw = mega_dispatch_plan(
+        specs, max_iters,
+        unroll=DEFAULT_UNROLL if unroll is None else unroll,
+        block_h=DEFAULT_BLOCK_H if block_h is None else block_h,
+        block_w=block_w, clamp=clamp, interpret=interpret,
+        interior_check=interior_check, cycle_check=cycle_check,
+        scout_segments=scout_segments, power=power, burning=burning,
+        julia_cs=julia_cs, use_mxu=use_mxu)
+    k = len(rows)
+    rows, mrd_rows = _pad_mega(rows, mrd_rows, n_dev)
+    sharding = NamedSharding(mesh, P(TILE_AXIS))
+    params = jax.device_put(jnp.asarray(rows, jnp.float32), sharding)
+    mrds = jax.device_put(jnp.asarray(mrd_rows, jnp.int32), sharding)
+    tiles, scout = _mega_sharded(params, mrds, mesh=mesh,
+                                 k_loc=len(rows) // n_dev, **kw)
+    return tiles[:k], scout[:k]
 
 
 @partial(jax.jit, static_argnames=("mesh", "definition", "max_iter", "segment",

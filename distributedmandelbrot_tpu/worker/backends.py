@@ -161,11 +161,17 @@ class PallasBackend:
                  registry: Optional[Registry] = None) -> None:
         from distributedmandelbrot_tpu.ops.pallas_escape import (
             compute_tile_pallas_device, compute_tiles_mega_pallas)
+        from distributedmandelbrot_tpu.parallel.sharding import (
+            compute_tiles_mega_sharded)
         self._dispatch = compute_tile_pallas_device
         self._dispatch_mega = compute_tiles_mega_pallas
+        self._dispatch_mesh = compute_tiles_mega_sharded
         # Escape hatch for the fused route (DMTPU_MEGA=0): dispatch_many
         # then degrades to a per-tile loop without touching callers.
         self._mega_enabled = os.environ.get("DMTPU_MEGA", "1") != "0"
+        # Escape hatch for the mesh route (DMTPU_MESH=0): fused batches
+        # then stay on one device per launch, the pre-mesh behavior.
+        self._mesh_enabled = os.environ.get("DMTPU_MESH", "1") != "0"
         self.definition = definition
         self.clamp = clamp
         self.registry = registry if registry is not None else Registry()
@@ -192,6 +198,36 @@ class PallasBackend:
         """Dispatch targets, in the shared mesh placement order."""
         from distributedmandelbrot_tpu.parallel.mesh import device_ring
         return device_ring()
+
+    @property
+    def mesh_width(self) -> int:
+        """Devices one fused launch spans on the mesh route (1 = the
+        route is off: a single local device, ``DMTPU_MESH=0``, or the
+        fused path itself disabled).  The pipelined executor reads this
+        to account dispatch-stage permits per device, not per launch."""
+        if not (self._mega_enabled and self._mesh_enabled):
+            return 1
+        return max(1, len(self.devices()))
+
+    def _mxu_shadow(self, specs, max_iters) -> None:
+        """Census-only MXU mode: run the advisory bf16 panel census for
+        one fused batch and record what it predicts.  Host-blocking but
+        tiny (a ``CENSUS_PANEL**2`` sub-grid per tile, <=64 steps)."""
+        from distributedmandelbrot_tpu.ops.mxu_iteration import (
+            mxu_census_counts)
+        from distributedmandelbrot_tpu.ops.pallas_escape import _params_row
+        try:
+            rows = [_params_row(s) for s in specs]
+            counts = mxu_census_counts(rows, max_iters,
+                                       height=specs[0].height,
+                                       width=specs[0].width)
+        except Exception:
+            # Advisory-only by contract: a census failure must never
+            # take down the real dispatch it shadows.
+            logger.debug("mxu census shadow failed", exc_info=True)
+            return
+        self.registry.inc(obs_names.WORKER_KERNEL_MXU_CENSUS,
+                          by=int(counts.sum()))
 
     def dispatch_tile(self, workload: Workload, device=None):
         """Enqueue one tile's kernel on ``device``; returns the handle to
@@ -228,29 +264,62 @@ class PallasBackend:
         This is the default dispatch route for fused batches — the
         per-call dispatch constant is paid once per batch instead of
         once per tile (ROADMAP item 4; BENCH_r05's 610-vs-1461 Mpix/s
-        gap).  Falls back to the per-tile :meth:`dispatch_tile` loop
+        gap).  With more than one local device (and ``device=None``,
+        i.e. the caller did not pin the launch) the batch additionally
+        shards over the ``tiles`` mesh axis so ONE launch drives every
+        chip (the mesh route; ``DMTPU_MESH=0`` opts out, and a
+        mesh-unsupported batch demotes to the single-device fused
+        launch).  Falls back to the per-tile :meth:`dispatch_tile` loop
         (which has its own XLA fallback) when the batch is a singleton,
         when any tile's shape/pitch/budget is Pallas-unsupported, or
         under ``DMTPU_MEGA=0``.  One unsupported tile demotes the whole
         batch: mixed routes would reorder completion against the
         per-device window the executor leases, for a case (odd shapes
         on the farm path) that is already the slow path.
+
+        The MXU gate (``ops/mxu_iteration``) resolves here too: in
+        ``full`` mode the fused kernels run the matmul-form recurrence
+        (bit-parity proven on this platform); in ``census`` mode the
+        recurrence stays on the VPU form and the advisory shadow census
+        runs alongside, with the demotion counted.
         """
+        from distributedmandelbrot_tpu.ops.mxu_iteration import mxu_mode
         from distributedmandelbrot_tpu.ops.pallas_escape import (
             PallasUnsupported)
         if len(workloads) == 1 or not self._mega_enabled:
             return [self.dispatch_tile(w, device) for w in workloads]
+        specs = [_spec_for(w, self.definition) for w in workloads]
+        max_iters = [w.max_iter for w in workloads]
+        mode = mxu_mode()
         t0 = time.monotonic()
-        try:
-            specs = [_spec_for(w, self.definition) for w in workloads]
-            tiles, scout = self._dispatch_mega(
-                specs, [w.max_iter for w in workloads], clamp=self.clamp,
-                device=device)
-        except PallasUnsupported:
-            return [self.dispatch_tile(w, device) for w in workloads]
+        tiles = None
+        mesh_n = self.mesh_width if device is None else 1
+        if mesh_n > 1:
+            try:
+                tiles, scout = self._dispatch_mesh(
+                    specs, max_iters, clamp=self.clamp,
+                    use_mxu=(mode == "full"))
+            except PallasUnsupported:
+                tiles = None  # demote to the single-device fused launch
+        if tiles is None:
+            mesh_n = 1
+            try:
+                tiles, scout = self._dispatch_mega(
+                    specs, max_iters, clamp=self.clamp, device=device,
+                    use_mxu=(mode == "full"))
+            except PallasUnsupported:
+                return [self.dispatch_tile(w, device) for w in workloads]
         self.registry.inc(obs_names.WORKER_KERNEL_FUSED_LAUNCHES)
         self.registry.inc(obs_names.WORKER_KERNEL_FUSED_TILES,
                           by=len(workloads))
+        if mesh_n > 1:
+            self.registry.inc(obs_names.WORKER_MESH_LAUNCHES)
+            self.registry.inc(obs_names.WORKER_MESH_DEVICES, by=mesh_n)
+        if mode == "full":
+            self.registry.inc(obs_names.WORKER_KERNEL_MXU_LAUNCHES)
+        elif mode == "census":
+            self.registry.inc(obs_names.WORKER_KERNEL_MXU_DEMOTIONS)
+            self._mxu_shadow(specs, max_iters)
         self._observe_phase(obs_names.PHASE_DISPATCH,
                             time.monotonic() - t0)
         return [MegaTileHandle(tiles[i], scout[i, 0])

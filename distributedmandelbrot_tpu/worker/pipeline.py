@@ -117,6 +117,13 @@ class DeviceDispatcher:
         if hasattr(backend, "dispatch_many"):
             self.dispatch_many = backend.dispatch_many
 
+    @property
+    def mesh_width(self) -> int:
+        """Devices one fused launch spans (1 = no mesh route): forwarded
+        from the backend so the executor sizes fusion and accounts
+        dispatch permits per device, not per launch."""
+        return int(getattr(self._backend, "mesh_width", 1) or 1)
+
     def devices(self) -> list:
         return list(self._backend.devices()) or [None]
 
@@ -190,10 +197,14 @@ class PipelineExecutor:
     ``batch_tiles`` caps how many already-queued leases the dispatch
     stage coalesces into one fused launch when the dispatcher exposes
     ``dispatch_many`` (the PallasBackend megakernel); 0 means "up to
-    ``depth``".  A fused launch holds one device-depth permit per tile
+    ``depth`` per device the launch spans".  A fused launch holds one
+    device-depth permit per tile ON THE DEVICE whose shard carries it
     (materialize releases them one by one), so the effective fusion
-    width is ``min(batch_tiles or depth, depth)`` — raise ``depth`` to
-    fuse wider.
+    width is ``min(batch_tiles or depth*mesh, depth*mesh)`` where
+    ``mesh`` is the dispatcher's ``mesh_width`` (1 without the mesh
+    route) — raise ``depth`` to fuse wider.  A mesh launch spans every
+    local device, so its permits spread over all of them instead of
+    charging one device for the whole launch.
 
     ``grant_batch`` sizes batched lease requests (FRAME_LEASE_REQN) when
     the session negotiated ``SESSION_FLAG_GRANTN``: 0 auto-sizes to
@@ -282,6 +293,7 @@ class PipelineExecutor:
         # the backend's dispatch_many, so these stay plain ints.
         self._disp_launches = 0
         self._disp_fused_launches = 0
+        self._disp_mesh_launches = 0
         self._disp_tiles = 0
         # Upload busy time is accounted per lane (one writer each);
         # the STAGE_UPLOAD entry above stays zero and readers sum these.
@@ -303,7 +315,15 @@ class PipelineExecutor:
         # to the fusion width, so every device's fusion launch fills
         # regardless of the count.  Tune DOWN (``grant_batch`` /
         # ``--grant-batch``) to share a thin frontier across workers.
-        self._fusion_width = min(self.batch_tiles or self.depth, self.depth)
+        # A mesh launch spans mesh_width devices, each with its own
+        # ``depth`` window, so the fusion cap scales with the span (a
+        # 1-wide mesh reduces to the old min(batch_tiles or depth,
+        # depth)).  Permits are still held per device — see
+        # _dispatch_loop's shard-aligned spread.
+        self._mesh_width = (int(getattr(dispatcher, "mesh_width", 1) or 1)
+                            if hasattr(dispatcher, "dispatch_many") else 1)
+        cap = self.depth * max(1, self._mesh_width)
+        self._fusion_width = min(self.batch_tiles or cap, cap)
         self.grant_batch = min(self.window, grant_batch or self.window)
 
     # -- window + error accounting -----------------------------------------
@@ -500,8 +520,8 @@ class PipelineExecutor:
         devices = self._devices
         sems = self._dev_sems
         fuse = getattr(self.dispatcher, "dispatch_many", None)
-        limit = min(self.batch_tiles or self.depth, self.depth) \
-            if fuse is not None else 1
+        limit = self._fusion_width if fuse is not None else 1
+        mesh_w = self._mesh_width if fuse is not None else 1
         i = 0
         saw_eos = False
         while not saw_eos:
@@ -525,11 +545,26 @@ class PipelineExecutor:
                     saw_eos = True
                     break
                 batch.append(more)
-            d = i % len(devices)
-            i += 1
+            # Device assignment.  A mesh launch (fused batch, mesh route
+            # live) spans every local device — the backend shards the
+            # batch over the tiles axis in contiguous blocks — so the
+            # dispatch permits are charged per DEVICE, one permit on the
+            # device whose shard carries each tile, not ``len(batch)``
+            # permits on one chip.  Everything else keeps the
+            # round-robin.
+            if mesh_w > 1 and len(batch) > 1:
+                k_loc = -(-len(batch) // len(devices))
+                dev_for = [min(j // k_loc, len(devices) - 1)
+                           for j in range(len(batch))]
+                launch_dev = None  # the mesh route places the shards
+            else:
+                d = i % len(devices)
+                i += 1
+                dev_for = [d] * len(batch)
+                launch_dev = devices[d]
             held = 0
             while held < len(batch) and not self._stop.is_set():
-                if sems[d].acquire(timeout=_WAIT_SLICE_S):
+                if sems[dev_for[held]].acquire(timeout=_WAIT_SLICE_S):
                     held += 1
             if self._stop.is_set():
                 # May hold permits here; the run is over either way,
@@ -541,12 +576,12 @@ class PipelineExecutor:
             try:
                 if len(batch) == 1:
                     handles = [self.dispatcher.dispatch(batch[0],
-                                                        devices[d])]
+                                                        launch_dev)]
                 else:
-                    handles = fuse(batch, devices[d])
+                    handles = fuse(batch, launch_dev)
             except BaseException:
-                for _ in range(held):
-                    sems[d].release()
+                for dj in dev_for[:held]:
+                    sems[dj].release()
                 self._abandon(len(batch))
                 raise
             dt = self.clock() - t0
@@ -555,16 +590,18 @@ class PipelineExecutor:
             self._disp_tiles += len(batch)
             if len(batch) > 1:
                 self._disp_fused_launches += 1
+                if launch_dev is None:
+                    self._disp_mesh_launches += 1
             if self.spans is not None:
                 s1 = self.spans.clock()
-                for w in batch:
+                for w, dj in zip(batch, dev_for):
                     self.spans.record(obs_names.SPAN_DISPATCH, w.key,
-                                      s0, s1, device=d)
+                                      s0, s1, device=dj)
             self.registry.observe(
                 obs_names.HIST_PIPELINE_STAGE_SECONDS, dt,
                 labels={"stage": obs_names.STAGE_DISPATCH})
-            for w, handle in zip(batch, handles):
-                self._mat_q.put((w, d, handle, t0, s0))
+            for w, handle, dj in zip(batch, handles, dev_for):
+                self._mat_q.put((w, dj, handle, t0, s0))
 
     @staticmethod
     def _start_host_copy(handle) -> None:
@@ -883,6 +920,8 @@ class PipelineExecutor:
         fusion = {
             "launches": launches,
             "fused_launches": self._disp_fused_launches,
+            "mesh_launches": self._disp_mesh_launches,
+            "mesh_width": self._mesh_width,
             "tiles": self._disp_tiles,
             "tiles_per_launch": round(self._disp_tiles / launches, 4)
             if launches else 0.0,

@@ -617,6 +617,53 @@ def host(x):
     assert findings_for({f"{P}/ops/mix.py": src}, "jax-dtype-mix") == []
 
 
+def test_jax_dtype_mix_fires_on_mxu_census_without_gateway():
+    """An MXU-census-shaped module (jitted panel shadow downcasting to
+    bf16 around a dot_general) that does NOT route through the
+    mixed_precision gateway must fire per half-precision literal — the
+    exact drift the sanctioned ops/mxu_iteration.py module avoids."""
+    src = JIT_HEADER + '''
+from jax import lax
+
+@jax.jit
+def census_panel(params):
+    c = params.astype("bfloat16")
+    z = jnp.zeros_like(c)
+    state = jnp.stack([z, z], axis=-1)
+    sq = lax.dot_general(state, state,
+                         dimension_numbers=((( 1,), (1,)), ((), ())))
+    return (sq.astype(jnp.bfloat16) + c).sum()
+'''
+    found = findings_for({f"{P}/ops/mxu_census.py": src},
+                         "jax-dtype-mix")
+    assert len(found) == 2
+    assert all("bfloat16" in f.message or "half" in f.message
+               for f in found)
+
+
+def test_jax_dtype_mix_clean_on_mxu_census_via_gateway():
+    """The same census shape routed through the mixed_precision gateway
+    (the real ops/mxu_iteration.py pattern: scout_cast/scout_const as
+    the only way values cross the precision boundary) stays clean."""
+    src = (JIT_HEADER
+           + 'from distributedmandelbrot_tpu.ops.mixed_precision import '
+             'scout_cast, scout_const\n'
+           + '''
+from jax import lax
+
+@jax.jit
+def census_panel(params):
+    c = scout_cast(params)
+    four = scout_const(4.0)
+    state = jnp.stack([c, c], axis=-1)
+    sq = lax.dot_general(state, state,
+                         dimension_numbers=(((1,), (1,)), ((), ())))
+    return ((sq + c) >= four).sum()
+''')
+    assert findings_for({f"{P}/ops/mxu_census.py": src},
+                        "jax-dtype-mix") == []
+
+
 # -- proto -----------------------------------------------------------------
 
 PROTO_MOD = f"{P}/net/protocol.py"

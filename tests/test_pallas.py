@@ -964,3 +964,262 @@ def test_pipeline_executor_drives_pallas_backend_across_devices():
         want = np.asarray(compute_tile_pallas_device(
             spec, w.max_iter, interpret=True)).reshape(-1)
         assert np.array_equal(np.asarray(pixels), want)
+
+
+# --- Mesh megakernel route (shard_map over the tiles axis) -------------------
+
+
+def test_mesh_mega_matches_single_device_and_single_tile():
+    """Golden bit-parity triangle of the mesh route on the canonical
+    chunk trio (fast-escaping sky, bulb-straddling, deep seahorse
+    valley): the shard_map'd fused launch must be bit-identical to the
+    single-device megakernel AND to per-tile single dispatches —
+    pixels and scout census both — with k=3 exercising the
+    trivial-tile padding on the 8-device ring."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tiles_mega_pallas)
+    from distributedmandelbrot_tpu.parallel.sharding import (
+        compute_tiles_mega_sharded)
+
+    specs = [TileSpec.for_chunk(4, 3, 3, definition=128),   # sky
+             TileSpec.for_chunk(4, 1, 1, definition=128),   # bulb
+             TileSpec.for_chunk(4, 1, 2, definition=128)]   # seahorse
+    mis = [300, 300, 900]
+    mesh_t, mesh_s = compute_tiles_mega_sharded(specs, mis,
+                                                interpret=True)
+    mega_t, mega_s = compute_tiles_mega_pallas(specs, mis,
+                                               interpret=True)
+    mesh_t, mesh_s = np.asarray(mesh_t), np.asarray(mesh_s)
+    assert mesh_t.shape == (3, 128, 128)
+    assert mesh_s.shape == (3, 1)
+    assert np.array_equal(mesh_t, np.asarray(mega_t)), \
+        "mesh pixels diverged from the single-device megakernel"
+    assert np.array_equal(mesh_s, np.asarray(mega_s)), \
+        "mesh scout census diverged from the single-device megakernel"
+    # sky escapes everywhere inside the scout window; the census must
+    # have seen it through the mesh route too.
+    assert int(mesh_s[0, 0]) > 0
+
+
+def test_mesh_mega_single_tile_parity_per_tile():
+    """Per-tile leg of the parity triangle, kept separate so a failure
+    names the diverging window."""
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_pallas_device)
+    from distributedmandelbrot_tpu.parallel.sharding import (
+        compute_tiles_mega_sharded)
+
+    specs = [TileSpec.for_chunk(4, 3, 3, definition=128),
+             TileSpec.for_chunk(4, 1, 1, definition=128),
+             TileSpec.for_chunk(4, 1, 2, definition=128)]
+    mis = [300, 300, 900]
+    mesh_t, _ = compute_tiles_mega_sharded(specs, mis, interpret=True)
+    mesh_t = np.asarray(mesh_t)
+    names = ["sky", "bulb-straddling", "deep-seahorse"]
+    for i, (sp, mi) in enumerate(zip(specs, mis)):
+        single = np.asarray(compute_tile_pallas_device(sp, mi,
+                                                       interpret=True))
+        assert np.array_equal(mesh_t[i], single), \
+            f"{names[i]} chunk diverged from the single-tile kernel"
+
+
+def test_mesh_one_device_degenerates_exactly():
+    """A 1-device mesh must produce bit-identical pixels AND scout to
+    the existing single-device fused route — the degeneration contract
+    the backend's mesh_width gate relies on."""
+    import jax
+    from jax.sharding import Mesh
+
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tiles_mega_pallas)
+    from distributedmandelbrot_tpu.parallel.mesh import TILE_AXIS
+    from distributedmandelbrot_tpu.parallel.sharding import (
+        compute_tiles_mega_sharded)
+
+    specs = [TileSpec.for_chunk(4, 3, 3, definition=128),
+             TileSpec.for_chunk(4, 1, 2, definition=128)]
+    mis = [200, 500]
+    one = Mesh(np.array(jax.devices()[:1]), (TILE_AXIS,))
+    mesh_t, mesh_s = compute_tiles_mega_sharded(specs, mis, mesh=one,
+                                                interpret=True)
+    mega_t, mega_s = compute_tiles_mega_pallas(specs, mis,
+                                               interpret=True)
+    assert np.array_equal(np.asarray(mesh_t), np.asarray(mega_t))
+    assert np.array_equal(np.asarray(mesh_s), np.asarray(mega_s))
+
+
+def test_backend_mesh_route_counters_and_hatch(monkeypatch):
+    """dispatch_many over the >1-device ring takes the mesh route
+    (worker_mesh_* counters move, one device-launch equivalent per ring
+    member) with pixels bit-identical to per-tile dispatches; under
+    DMTPU_MESH=0 the route is off (mesh_width 1, counters untouched)
+    and output is unchanged."""
+    from distributedmandelbrot_tpu.core.workload import Workload
+    from distributedmandelbrot_tpu.obs import names as obs_names
+    from distributedmandelbrot_tpu.worker.backends import (MegaTileHandle,
+                                                           PallasBackend)
+
+    ws = [Workload(4, 300, 3, 3), Workload(4, 300, 1, 1),
+          Workload(4, 900, 1, 2)]
+    backend = PallasBackend(definition=128)
+    n_dev = len(backend.devices())
+    assert backend.mesh_width == n_dev >= 2
+    handles = backend.dispatch_many(ws)
+    assert all(isinstance(h, MegaTileHandle) for h in handles)
+    got = [np.asarray(backend.materialize_tile(h)) for h in handles]
+    per_tile = [np.asarray(backend.materialize_tile(
+        backend.dispatch_tile(w))) for w in ws]
+    for g, p in zip(got, per_tile):
+        assert np.array_equal(g, p)
+    assert backend.registry.counter_value(
+        obs_names.WORKER_MESH_LAUNCHES) == 1
+    assert backend.registry.counter_value(
+        obs_names.WORKER_MESH_DEVICES) == n_dev
+    # A device-pinned launch must NOT take the mesh route.
+    dev0 = backend.devices()[0]
+    backend.dispatch_many(ws, device=dev0)
+    assert backend.registry.counter_value(
+        obs_names.WORKER_MESH_LAUNCHES) == 1
+
+    monkeypatch.setenv("DMTPU_MESH", "0")
+    gated = PallasBackend(definition=128)
+    assert gated.mesh_width == 1
+    hatch = [np.asarray(gated.materialize_tile(h))
+             for h in gated.dispatch_many(ws)]
+    for h, p in zip(hatch, per_tile):
+        assert np.array_equal(h, p)
+    assert gated.registry.counter_value(
+        obs_names.WORKER_MESH_LAUNCHES) is None
+
+
+# --- MXU iteration map (ops/mxu_iteration) -----------------------------------
+
+
+def test_mxu_step_is_the_complex_square():
+    """The 2x2 rotation-matrix matmul form computes z^2 + c (numerical
+    agreement with the direct complex form; bit-identity is platform-
+    dependent and probed separately)."""
+    import jax.numpy as jnp
+
+    from distributedmandelbrot_tpu.ops.mxu_iteration import mxu_step
+
+    rng = np.random.default_rng(7)
+    zr = rng.uniform(-1.5, 1.5, (8, 16)).astype(np.float32)
+    zi = rng.uniform(-1.5, 1.5, (8, 16)).astype(np.float32)
+    cr = rng.uniform(-2.0, 1.0, (8, 16)).astype(np.float32)
+    ci = rng.uniform(-1.5, 1.5, (8, 16)).astype(np.float32)
+    out_r, out_i = mxu_step(jnp.asarray(zr), jnp.asarray(zi),
+                            jnp.asarray(cr), jnp.asarray(ci))
+    z = (zr + 1j * zi).astype(np.complex64)
+    want = z * z + (cr + 1j * ci)
+    np.testing.assert_allclose(np.asarray(out_r), want.real, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_i), want.imag, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_mxu_gate_resolution(monkeypatch):
+    """The DMTPU_MXU gate: off by default; enabled resolves to full
+    ONLY with proven bit-parity, census otherwise — and the parity
+    verdict is a real probe result, not an assumption."""
+    from distributedmandelbrot_tpu.ops import mxu_iteration as mxu
+
+    monkeypatch.delenv(mxu.MXU_ENV, raising=False)
+    assert mxu.mxu_mode() == "off"
+    monkeypatch.setenv(mxu.MXU_ENV, "0")
+    assert mxu.mxu_mode() == "off"
+    monkeypatch.setenv(mxu.MXU_ENV, "1")
+    proven = mxu.mxu_parity_proven()
+    assert mxu.mxu_mode() == ("full" if proven else "census")
+    # Force each verdict through the cache to pin the mapping.
+    import jax
+    key = jax.default_backend()
+    mxu._parity_cache[key] = True
+    assert mxu.mxu_mode() == "full"
+    mxu._parity_cache[key] = False
+    assert mxu.mxu_mode() == "census"
+    mxu.reset_mxu_cache()
+    assert key not in mxu._parity_cache
+
+
+def test_mxu_full_mode_bit_parity_where_proven():
+    """Wherever the parity contract claims bit-identity (full mode on a
+    parity-proven platform), the MXU-form megakernel must match the
+    single-tile VPU kernel exactly.  Skipped on platforms where the
+    probe demotes to census — there the contract claims nothing."""
+    from distributedmandelbrot_tpu.ops.mxu_iteration import (
+        mxu_parity_proven)
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        compute_tile_pallas_device, compute_tiles_mega_pallas)
+
+    if not mxu_parity_proven():
+        pytest.skip("MXU/VPU bit-parity unproven on this platform; "
+                    "gate demotes to the census (no parity claimed)")
+    specs = [TileSpec.for_chunk(4, 3, 3, definition=128),
+             TileSpec.for_chunk(4, 1, 2, definition=128)]
+    mis = [300, 900]
+    tiles, _ = compute_tiles_mega_pallas(specs, mis, interpret=True,
+                                         use_mxu=True)
+    for i, (sp, mi) in enumerate(zip(specs, mis)):
+        single = np.asarray(compute_tile_pallas_device(sp, mi,
+                                                       interpret=True))
+        assert np.array_equal(np.asarray(tiles[i]), single)
+
+
+def test_mxu_guards_and_census():
+    """use_mxu is power-2 Mandelbrot/Julia-form only (burning ship's
+    abs breaks the rotation-matrix embedding); the census-only fallback
+    counts sky escapes at full panel occupancy and near-none on the
+    all-interior window."""
+    from distributedmandelbrot_tpu.ops.mxu_iteration import (
+        CENSUS_PANEL, mxu_census_counts)
+    from distributedmandelbrot_tpu.ops.pallas_escape import (
+        PallasUnsupported, _params_row, compute_tiles_mega_pallas)
+
+    sky = TileSpec.for_chunk(4, 3, 3, definition=128)
+    with pytest.raises(PallasUnsupported, match="[Mm][Xx][Uu]"):
+        compute_tiles_mega_pallas([sky, sky], [100, 100], interpret=True,
+                                  use_mxu=True, burning=True,
+                                  interior_check=False)
+
+    bulb = TileSpec(-0.1, -0.05, 0.02, 0.02, width=128, height=128)
+    rows = [_params_row(sky), _params_row(bulb)]
+    counts = mxu_census_counts(rows, [300, 300], height=128, width=128)
+    assert counts.shape == (2,)
+    assert int(counts[0]) == CENSUS_PANEL * CENSUS_PANEL, \
+        "census missed escapes on the all-escaping sky window"
+    assert int(counts[1]) <= CENSUS_PANEL, \
+        "census claimed escapes across the cardioid interior"
+
+
+def test_backend_mxu_census_mode_counters(monkeypatch):
+    """DMTPU_MXU=1 on an unproven platform: outputs stay bit-identical
+    (the census is advisory), the demotion is counted, and the census
+    pixel counter moves; on a proven platform the launch counter moves
+    instead."""
+    from distributedmandelbrot_tpu.core.workload import Workload
+    from distributedmandelbrot_tpu.obs import names as obs_names
+    from distributedmandelbrot_tpu.ops.mxu_iteration import (
+        mxu_parity_proven)
+    from distributedmandelbrot_tpu.worker.backends import PallasBackend
+
+    ws = [Workload(4, 300, 3, 3), Workload(4, 300, 1, 1)]
+    base = PallasBackend(definition=128)
+    want = [np.asarray(base.materialize_tile(h))
+            for h in base.dispatch_many(ws)]
+
+    monkeypatch.setenv("DMTPU_MXU", "1")
+    backend = PallasBackend(definition=128)
+    got = [np.asarray(backend.materialize_tile(h))
+           for h in backend.dispatch_many(ws)]
+    for g, w in zip(got, want):
+        assert np.array_equal(g, w)
+    cv = backend.registry.counter_value
+    if mxu_parity_proven():
+        assert cv(obs_names.WORKER_KERNEL_MXU_LAUNCHES) == 1
+        assert cv(obs_names.WORKER_KERNEL_MXU_DEMOTIONS) is None
+    else:
+        assert cv(obs_names.WORKER_KERNEL_MXU_DEMOTIONS) == 1
+        assert cv(obs_names.WORKER_KERNEL_MXU_LAUNCHES) is None
+        # The sky tile's panel escapes entirely -> census pixels moved.
+        assert (cv(obs_names.WORKER_KERNEL_MXU_CENSUS) or 0) > 0

@@ -413,3 +413,69 @@ def test_window_and_depth_validation():
     from distributedmandelbrot_tpu.worker import Worker
     with pytest.raises(ValueError):
         Worker(client, FakeDispatcher(), window=-1)
+
+
+# -- mesh fusion leg ---------------------------------------------------------
+
+class FakeMeshDispatcher(FakeDispatcher):
+    """FakeDispatcher with a fused mesh entry point: dispatch_many
+    records (batch_size, device) per launch; mesh_width>1 advertises
+    the mesh route so the executor spreads permits per device."""
+
+    def __init__(self, mesh_width: int = 1, dispatch_real_s: float = 0.0,
+                 **kw) -> None:
+        super().__init__(**kw)
+        self.mesh_width = mesh_width
+        self.dispatch_real_s = dispatch_real_s
+        self.launches: list[tuple[int, object]] = []
+
+    def dispatch_many(self, workloads, device=None):
+        with self._lock:
+            self.dispatched += len(workloads)
+            self.launches.append((len(workloads), device))
+        if self.dispatch_real_s:
+            time.sleep(self.dispatch_real_s)
+        return [(w, device) for w in workloads]
+
+
+def test_mesh_dispatcher_scales_fusion_and_spreads_permits():
+    """With mesh_width=4 and depth=1 the fusion cap is depth*mesh = 4
+    (not depth): fused launches carry device=None (the mesh places the
+    shards), permits spread one-per-tile across the device semaphores
+    (the run completes — unbalanced release would deadlock or crash),
+    and stage_stats reports the mesh launches."""
+    client = FakeClient(n_tiles=12)
+    disp = FakeMeshDispatcher(mesh_width=4, n_devices=4,
+                              dispatch_real_s=0.03)
+    pipe = PipelineExecutor(client, disp, window=8, depth=1,
+                            batch_size=8)
+    pipe.run()
+    assert len(client.submitted) == 12
+    assert pipe.in_flight == 0
+    assert disp.dispatched == 12
+    fused = [(n, d) for n, d in disp.launches if n > 1]
+    assert fused, "no launch ever coalesced a batch"
+    assert all(d is None for _, d in fused), \
+        "a mesh launch was pinned to one device"
+    assert max(n for n, _ in disp.launches) <= 4  # depth * mesh_width
+    assert any(n > 1 for n, _ in disp.launches)
+    stats = pipe.stage_stats()["fusion"]
+    assert stats["mesh_width"] == 4
+    assert stats["mesh_launches"] == len(fused)
+    assert stats["tiles"] == 12
+
+
+def test_single_width_dispatcher_keeps_per_launch_device():
+    """mesh_width=1 (or absent) keeps the pre-mesh contract: fused
+    launches are pinned to one round-robin device and mesh_launches
+    stays zero."""
+    client = FakeClient(n_tiles=8)
+    disp = FakeMeshDispatcher(mesh_width=1, n_devices=2,
+                              dispatch_real_s=0.02)
+    pipe = PipelineExecutor(client, disp, window=8, depth=2,
+                            batch_size=8)
+    pipe.run()
+    assert len(client.submitted) == 8
+    assert all(d is not None for n, d in disp.launches if n > 1)
+    assert max((n for n, _ in disp.launches), default=1) <= 2  # depth
+    assert pipe.stage_stats()["fusion"]["mesh_launches"] == 0
