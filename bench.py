@@ -2473,6 +2473,12 @@ def bench_obs(repeats: int, *, levels: str = "64:100", n_shards: int = 2,
     so the delta isolates what watching a farm costs the farm: serving
     scrapes.
 
+    A third leg per repeat runs the same storm with the flight recorder
+    disabled (``DMTPU_FLIGHT=0``): the bare leg already records flight
+    events on every grant (the recorder is on whenever a coordinator
+    is), so bare-vs-flight-off isolates what the black box costs the
+    grant path.  Gate: ``flight_overhead_pct < 1``.
+
     Per repeat the legs run back-to-back on fresh subprocess fleets;
     the reported rates are each leg's best repeat (the storm numbers
     are noisy on shared CI boxes, and overhead is a property of the
@@ -2491,14 +2497,16 @@ def bench_obs(repeats: int, *, levels: str = "64:100", n_shards: int = 2,
     repo_root = os.path.dirname(os.path.abspath(__file__))
     driver = "distributedmandelbrot_tpu.chaos.driver"
 
-    def _env() -> dict:
+    def _env(flight: bool = True) -> dict:
         env = dict(os.environ)
         env["PYTHONPATH"] = repo_root + os.pathsep \
             + env.get("PYTHONPATH", "")
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if not flight:
+            env["DMTPU_FLIGHT"] = "0"
         return env
 
-    def spawn_shard(tmp: str, leg: str, k: int
+    def spawn_shard(tmp: str, leg: str, k: int, *, flight: bool = True
                     ) -> tuple[subprocess.Popen, str]:
         port_file = os.path.join(tmp, f"{leg}-ports-{k}.json")
         proc = subprocess.Popen(
@@ -2507,7 +2515,7 @@ def bench_obs(repeats: int, *, levels: str = "64:100", n_shards: int = 2,
              str(k), str(n_shards),
              "--lease-timeout", "0.05", "--sweep-period", "0.02",
              "--checkpoint-period", "0"],
-            env=_env(), stdout=subprocess.DEVNULL,
+            env=_env(flight), stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL)
         return proc, port_file
 
@@ -2523,11 +2531,12 @@ def bench_obs(repeats: int, *, levels: str = "64:100", n_shards: int = 2,
         with open(port_file, "r", encoding="utf-8") as f:
             return json.load(f)
 
-    def run_leg(tmp: str, leg: str, observed: bool
-                ) -> tuple[float, int, dict]:
+    def run_leg(tmp: str, leg: str, observed: bool, *,
+                flight: bool = True) -> tuple[float, int, dict]:
         from distributedmandelbrot_tpu.control.ring import (HashRing,
                                                             ShardInfo)
-        shards = [spawn_shard(tmp, leg, k) for k in range(n_shards)]
+        shards = [spawn_shard(tmp, leg, k, flight=flight)
+                  for k in range(n_shards)]
         scrapes = [0]
         stop = threading.Event()
         scraper = None
@@ -2589,9 +2598,13 @@ def bench_obs(repeats: int, *, levels: str = "64:100", n_shards: int = 2,
                  "scrape_period_s": scrape_period,
                  "cpu_count": os.cpu_count(), "repeats": repeats}
     base_rates, observed_rates, scrape_counts = [], [], []
+    flight_off_rates = []
     last_snap: dict = {}
     with tempfile.TemporaryDirectory(prefix="dmtpu-obsbench-") as tmp:
         for r in range(repeats):
+            rate, _, _ = run_leg(tmp, f"fl0{r}", observed=False,
+                                 flight=False)
+            flight_off_rates.append(rate)
             rate, _, _ = run_leg(tmp, f"base{r}", observed=False)
             base_rates.append(rate)
             rate, n_scrapes, snap = run_leg(tmp, f"obs{r}", observed=True)
@@ -2601,12 +2614,21 @@ def bench_obs(repeats: int, *, levels: str = "64:100", n_shards: int = 2,
                 last_snap = snap
     base = max(base_rates)
     observed = max(observed_rates)
+    flight_off = max(flight_off_rates)
     overhead = (base - observed) / base * 100.0 if base else 0.0
+    # The bare leg IS the flight-on leg (the recorder rides every
+    # coordinator); off-vs-on isolates the note() cost on grants.
+    fl_overhead = (flight_off - base) / flight_off * 100.0 \
+        if flight_off else 0.0
     out["grants_per_s_bare"] = round(base, 1)
     out["grants_per_s_observed"] = round(observed, 1)
+    out["grants_per_s_flight_off"] = round(flight_off, 1)
+    out["grants_per_s_flight_on"] = round(base, 1)
     out["scrapes_per_leg"] = scrape_counts
     out["overhead_pct"] = round(overhead, 2)
     out["overhead_under_1pct"] = overhead < 1.0
+    out["flight_overhead_pct"] = round(fl_overhead, 2)
+    out["flight_overhead_under_1pct"] = fl_overhead < 1.0
     out["fleet_totals"] = last_snap.get("totals", {})
     out["fleet_roles"] = {role: doc.get("healthy", 0)
                           for role, doc in

@@ -40,9 +40,19 @@ RULES = (
     Rule("obs-dead", "obs", "warning",
          "names registered in obs/names.py must be instrumented (or "
          "referenced) somewhere — unused registrations are drift"),
+    Rule("obs-event", "obs", "error",
+         "flight-recorder event literals must be registered in "
+         "obs/events.py, and every registered event must be emitted "
+         "(or referenced) somewhere"),
 )
 
 NAMES_SUFFIX = "obs/names.py"
+EVENTS_SUFFIX = "obs/events.py"
+
+# The flight recorder's emit surface: ``flight.note("...")`` (module
+# call) — the same receiver-hint gating the metric tables use, so a
+# dict's or notebook's unrelated ``.note`` never trips the scan.
+EVENT_METHODS = {"note": ("flight",)}
 
 # Method -> receiver spellings that identify the instrumented object
 # (gating hints keep dict.get("key") from tripping the scan — same
@@ -175,15 +185,115 @@ def _dead_findings(project: Project) -> list[Finding]:
         if target not in used and wire not in lit_used]
 
 
-def check(project: Project) -> list[Finding]:
-    known = known_names(project)
-    if known is None:
+def _events_file(project: Project) -> Optional[str]:
+    for rel in sorted(project.files):
+        if rel.endswith(EVENTS_SUFFIX):
+            return rel
+    return None
+
+
+def known_events(project: Project) -> Optional[dict[str, tuple[str, int]]]:
+    """Constant target -> (event name, definition line) from the events
+    module's AST (uppercase top-level string constants, same extraction
+    as the names module).  None when the project has no events module."""
+    rel = _events_file(project)
+    if rel is None:
+        return None
+    out: dict[str, tuple[str, int]] = {}
+    for node in project.files[rel].tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            target, value = node.target.id, node.value
+        else:
+            continue
+        if target.isupper() and isinstance(value, ast.Constant) \
+                and isinstance(value.value, str):
+            out[target] = (value.value, node.lineno)
+    return out
+
+
+def iter_event_sites(project: Project
+                     ) -> Iterator[tuple[SourceFile, int, str]]:
+    """(file, line, literal) for every ``flight.note("...")`` whose
+    first argument is a string literal."""
+    for rel in sorted(project.files):
+        sf = project.files[rel]
+        for node in cached_walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in EVENT_METHODS):
+                continue
+            recv_chain = attr_chain(node.func.value)
+            if not recv_chain:
+                continue
+            recv = recv_chain[-1].lower()
+            if not any(h in recv for h in EVENT_METHODS[node.func.attr]):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            yield sf, node.args[0].lineno, node.args[0].value
+
+
+def _event_findings(project: Project) -> list[Finding]:
+    """obs-event, both directions: a ``flight.note`` literal outside
+    the registry, and a registered event no layer ever emits.  'Used'
+    means an ``<...events>.CONST`` attribute reference or a
+    ``from ...obs.events import CONST`` outside the events module, or
+    the event spelling appearing as a note-site literal — same
+    semantics as obs-dead, because an event postmortem can never see
+    is exactly as much drift as a metric nobody increments."""
+    consts = known_events(project)
+    if consts is None:
         return []
-    rule = RULES[0]
+    rule = RULES[2]
+    events_rel = _events_file(project)
+    registered = {wire for wire, _ in consts.values()}
     out = [
         Finding(rule.id, rule.severity, sf.relpath, line,
-                f"metric name {name!r} is not registered in obs/names.py")
-        for sf, line, name in iter_sites(project)
-        if name not in known]
-    out.extend(_dead_findings(project))
+                f"flight event {name!r} is not registered in "
+                f"obs/events.py")
+        for sf, line, name in iter_event_sites(project)
+        if name not in registered]
+    used: set[str] = set()
+    for rel in sorted(project.files):
+        if rel == events_rel:
+            continue
+        for node in cached_walk(project.files[rel].tree):
+            if isinstance(node, ast.Attribute) and node.attr.isupper():
+                chain = attr_chain(node)
+                if chain and len(chain) >= 2 \
+                        and "events" in chain[-2].lower():
+                    used.add(node.attr)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.endswith("obs.events"):
+                used.update(alias.name for alias in node.names)
+    lit_used = {name for _, _, name in iter_event_sites(project)}
+    sf = project.files[events_rel]
+    out.extend(
+        Finding(rule.id, rule.severity, sf.relpath, line,
+                f"registered event {target} ({wire!r}) is never emitted "
+                f"or referenced outside obs/events.py")
+        for target, (wire, line) in sorted(consts.items())
+        if target not in used and wire not in lit_used)
+    return out
+
+
+def check(project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    known = known_names(project)
+    if known is not None:
+        rule = RULES[0]
+        out.extend(
+            Finding(rule.id, rule.severity, sf.relpath, line,
+                    f"metric name {name!r} is not registered in "
+                    f"obs/names.py")
+            for sf, line, name in iter_sites(project)
+            if name not in known)
+        out.extend(_dead_findings(project))
+    out.extend(_event_findings(project))
     return out

@@ -161,6 +161,11 @@ class ChaosReport:
     # before graceful teardown — the per-shard/per-worker rates a chaos
     # postmortem wants next to the invariant verdicts.
     fleet: dict = field(default_factory=dict)
+    # Flight-recorder postmortem summary (obs/postmortem.py), assembled
+    # from the fleet's crash dumps when the scenario FAILED — which
+    # process died holding which leases, and what the anomaly detectors
+    # flagged.  Empty on success (the dumps stay on disk either way).
+    postmortem: dict = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=1,
@@ -256,6 +261,10 @@ class ChaosRunner:
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep \
             + env.get("PYTHONPATH", "")
         env.setdefault("JAX_PLATFORMS", "cpu")
+        # Every child keeps a black box; a fast autoflush cadence is
+        # what makes SIGKILL evidence land (no exit hook ever runs).
+        env.setdefault("DMTPU_FLIGHT_DIR", self.flight_dir)
+        env.setdefault("DMTPU_FLIGHT_PERIOD", "0.2")
         if self.scenario.slow_persist > 0:
             env["DMTPU_SLOWPOINTS"] = \
                 f"{PERSIST_POINT}:{self.scenario.slow_persist}"
@@ -357,6 +366,22 @@ class ChaosRunner:
                 return json.loads(resp.read().decode("utf-8"))
         except Exception:
             return None
+
+    def _capture_postmortem(self) -> dict:
+        """Assemble the fleet's flight dumps into a postmortem summary
+        for a FAILED scenario report.  Best-effort like the fleet
+        snapshot: a postmortem that cannot assemble must not mask the
+        invariant verdict it was meant to explain."""
+        from distributedmandelbrot_tpu.obs import postmortem
+        try:
+            pm = postmortem.assemble(self.flight_dir,
+                                     registry=self.counters.registry)
+            summary = pm.summary()
+            summary["dump_dir"] = self.flight_dir
+            return summary
+        except Exception as e:
+            self._log(f"postmortem assembly failed: {e!r}")
+            return {}
 
     def _capture_fleet(self) -> dict:
         """A fleet snapshot (obs/fleet.py) over the still-live shards.
@@ -494,6 +519,8 @@ class ChaosRunner:
         self.root = root
         self.parent_dir = os.path.join(root, "farm")
         os.makedirs(self.parent_dir, exist_ok=True)
+        self.flight_dir = os.path.join(root, "flight")
+        os.makedirs(self.flight_dir, exist_ok=True)
         self.ring_path = os.path.join(root, "ring.json")
         self.t0 = time.monotonic()
         self._log(f"scenario {sc.name}: {sc.n_shards} shards, "
@@ -562,7 +589,9 @@ class ChaosRunner:
             restarts=self.restart_count,
             restart_to_first_grant_s=self.blips,
             failures=list(self.failures),
-            fleet=fleet_snapshot)
+            fleet=fleet_snapshot,
+            postmortem=self._capture_postmortem()
+            if self.failures else {})
         self._log(f"scenario {sc.name}: "
                   f"{'OK' if report.ok else 'FAILED'} in "
                   f"{report.duration_s:.1f}s ({report.kills} kills, "

@@ -1468,6 +1468,58 @@ def cmd_trace(argv: Sequence[str]) -> int:
     return 0
 
 
+def cmd_postmortem(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dmtpu postmortem",
+        description="Merge a directory of flight-recorder dumps (one "
+                    "JSONL per process; DMTPU_FLIGHT_DIR made them) "
+                    "into one causally-ordered cross-process timeline, "
+                    "reconstruct the leases in flight when each process "
+                    "died, and run the anomaly detectors (grant without "
+                    "accept, lease ping-pong, redirect loops, double "
+                    "commits, retry storms).  Corrupt dumps never abort "
+                    "the assembly; bad lines are counted and a partial "
+                    "timeline renders.")
+    parser.add_argument("dump_dir", metavar="DIR",
+                        help="directory of flight-*.jsonl dumps")
+    fmt = parser.add_mutually_exclusive_group()
+    fmt.add_argument("--json", action="store_true",
+                     help="full timeline + anomalies as JSON")
+    fmt.add_argument("--chrome", action="store_true",
+                     help="Chrome trace-event JSON (ui.perfetto.dev)")
+    parser.add_argument("--limit", type=int, default=200, metavar="N",
+                        help="text mode: show the last N merged events "
+                             "(default 200; 0 = all)")
+    parser.add_argument("--out", default="-", metavar="PATH",
+                        help="output path ('-' for stdout)")
+    args = parser.parse_args(argv)
+
+    import json
+
+    from distributedmandelbrot_tpu.obs import postmortem
+
+    pm = postmortem.assemble(args.dump_dir)
+    if args.json:
+        body = json.dumps(pm.to_dict(), indent=1, sort_keys=True)
+    elif args.chrome:
+        body = json.dumps(pm.to_chrome())
+    else:
+        body = pm.render_text(limit=args.limit or None)
+    if args.out == "-":
+        print(body, flush=True)
+    else:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(body + "\n")
+        print(f"wrote postmortem of {len(pm.dumps)} dump(s), "
+              f"{len(pm.timeline)} events, {len(pm.anomalies)} "
+              f"anomalies -> {args.out}", flush=True)
+    if not pm.dumps:
+        print(f"dmtpu postmortem: no readable dumps in "
+              f"{args.dump_dir}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_admin(argv: Sequence[str]) -> int:
     parser = argparse.ArgumentParser(
         prog="dmtpu admin",
@@ -2423,7 +2475,8 @@ COMMANDS = {"coordinator": cmd_coordinator, "worker": cmd_worker,
             "animate": cmd_animate, "compact": cmd_compact,
             "stats": cmd_stats, "trace": cmd_trace, "admin": cmd_admin,
             "check": cmd_check, "loadgen": cmd_loadgen,
-            "coord": cmd_coord, "chaos": cmd_chaos, "top": cmd_top}
+            "coord": cmd_coord, "chaos": cmd_chaos, "top": cmd_top,
+            "postmortem": cmd_postmortem}
 
 
 def _enable_compile_cache() -> None:

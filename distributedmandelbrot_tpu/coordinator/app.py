@@ -25,6 +25,7 @@ from distributedmandelbrot_tpu.coordinator.recovery import (RecoveryManager,
 from distributedmandelbrot_tpu.coordinator.scheduler import TileScheduler
 from distributedmandelbrot_tpu.core.workload import LevelSetting
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import flight
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.exporter import MetricsExporter
 from distributedmandelbrot_tpu.obs.metrics import Registry
@@ -95,6 +96,18 @@ class Coordinator:
         # and the distributer answers misrouted uploads with redirects.
         self.ring_slice = ring_slice
         namespace = "" if ring_slice is None else ring_slice.namespace
+        # Black-box flight recorder: the coordinator names the process
+        # (shard-N when sharded) and points the dump header at the span
+        # store's per-worker clock offsets so postmortem (obs/
+        # postmortem.py) can order this process's events against its
+        # workers' causally.
+        role = "coordinator" if ring_slice is None \
+            else f"shard-{ring_slice.shard}"
+        self.flight = flight.ensure(role, registry=self.registry)
+        if self.flight is not None:
+            if ring_slice is not None:
+                self.flight.shard = ring_slice.shard
+            self.flight.offsets_fn = self._flight_offsets
         self.store = ChunkStore(data_dir_parent, fsync_index=fsync_index,
                                 registry=self.registry,
                                 namespace=namespace)
@@ -232,6 +245,7 @@ class Coordinator:
                     varz_extra=self._varz_extra,
                     checkpoint_cb=self.recovery.checkpoint,
                     sampler=self.sampler,
+                    flight=self.flight,
                     host=host, port=exporter_port)
         except BaseException:
             # Construction failed after the claim: release it, or the
@@ -376,6 +390,17 @@ class Coordinator:
     @property
     def exporter_port(self) -> Optional[int]:
         return None if self.exporter is None else self.exporter.port
+
+    def _flight_offsets(self) -> dict:
+        """Per-worker NTP offsets for the flight-dump header, keyed by
+        the hex worker id workers stamp into their own dumps."""
+        out = {}
+        for wid in self.spans.workers():
+            est = self.spans.offset(wid)
+            if est is not None:
+                out[format(wid, "016x")] = {"offset": est.offset,
+                                            "error": est.error}
+        return out
 
     def _varz_extra(self) -> dict:
         """Scheduler frontier state for /varz (beyond the gauge family)."""

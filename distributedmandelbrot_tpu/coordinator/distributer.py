@@ -37,6 +37,8 @@ from distributedmandelbrot_tpu.core.workload import (WORKLOAD_WIRE_SIZE,
                                                      Workload)
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import events as obs_events
+from distributedmandelbrot_tpu.obs import flight
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.spans import Span, SpanStore
 from distributedmandelbrot_tpu.obs.trace import TraceLog
@@ -227,6 +229,8 @@ class Distributer:
                     logger.error("unknown purpose byte %#x from %s",
                                  purpose, peer)
                     self.counters.inc(obs_names.COORD_FRAMES_REJECTED)
+                    flight.note(obs_events.SESS_REJECT_FRAME, peer=peer,
+                                purpose=purpose)
                     break
                 await writer.drain()
         except (ConnectionError, TimeoutError, asyncio.TimeoutError,
@@ -236,6 +240,8 @@ class Distributer:
             # Malformed or hostile frame: drop the connection, leave a
             # trail, keep the accept loop alive.
             self.counters.inc(obs_names.COORD_FRAMES_REJECTED)
+            flight.note(obs_events.SESS_REJECT_FRAME, peer=peer,
+                        error=str(e)[:120])
             logger.error("dropping %s: %s", peer, e)
         except Exception:
             logger.exception("error serving %s", peer)
@@ -369,6 +375,8 @@ class Distributer:
         await writer.drain()
         self.counters.inc(obs_names.COORD_SESSIONS_OPENED)
         peer = _peer_id(writer)
+        flight.note(obs_events.SESS_OPEN, peer=peer,
+                    negotiated=negotiated)
         expected_seq = 0
         while True:
             try:
@@ -569,9 +577,13 @@ class Distributer:
                 owner = self.ring_slice.owner_of(w.key)
                 logger.info("redirecting result for %s to shard %d", w,
                             owner)
+                flight.note(obs_events.SESS_REDIRECT, key=w.key,
+                            owner=owner, peer=peer)
                 self._write_redirect(writer, seq, owner)
             else:
                 self.counters.inc(obs_names.COORD_RESULTS_REJECTED)
+                flight.note(obs_events.SESS_RESULT_REJECTED, key=w.key,
+                            reason="misroute")
                 logger.info("rejected result for %s (not this shard's "
                             "key)", w)
                 self._write_upload_ack(writer, seq, proto.RESPONSE_REJECT,
@@ -583,6 +595,8 @@ class Distributer:
             # keep the frame stream in sync before the reject ack.
             await self._read(framing.read_exact(reader, body_len))
             self.counters.inc(obs_names.COORD_RESULTS_REJECTED)
+            flight.note(obs_events.SESS_RESULT_REJECTED, key=w.key,
+                        reason="stale_lease")
             logger.info("rejected result for %s (stale or unknown lease)", w)
             self._write_upload_ack(writer, seq, proto.RESPONSE_REJECT,
                                    want, peer)
@@ -593,6 +607,8 @@ class Distributer:
                 framing.ProtocolError):
             self.scheduler.release_claim(w, token)
             self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
+            flight.note(obs_events.SESS_RESULT_DROPPED, key=w.key,
+                        reason="upload_stalled")
             logger.info("dropped result for %s (session upload stalled "
                         "or connection lost)", w)
             raise
@@ -609,6 +625,8 @@ class Distributer:
             except ValueError as e:
                 self.scheduler.release_claim(w, token)
                 self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
+                flight.note(obs_events.SESS_RESULT_DROPPED, key=w.key,
+                            reason="bad_rle")
                 raise framing.ProtocolError(
                     f"bad RLE body for {w}: {e}") from None
             self.registry.observe(obs_names.HIST_COORD_DECODE_SECONDS,
@@ -619,6 +637,8 @@ class Distributer:
             self.counters.inc(obs_names.WIRE_RAW_BYTES, body_len)
         if not self.scheduler.finish_claim(w, token):
             self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
+            flight.note(obs_events.SESS_RESULT_DROPPED, key=w.key,
+                        reason="expired_mid_upload")
             logger.info("dropped result for %s (lease expired mid-upload)", w)
             self._write_upload_ack(writer, seq, proto.RESPONSE_REJECT,
                                    want, peer)
@@ -664,6 +684,8 @@ class Distributer:
             framing.write_byte(writer, proto.RESPONSE_REJECT)
             await writer.drain()
             self.counters.inc(obs_names.COORD_RESULTS_REJECTED)
+            flight.note(obs_events.SESS_RESULT_REJECTED, key=w.key,
+                        reason="stale_lease")
             logger.info("rejected result for %s (stale or unknown lease)", w)
             return
         try:
@@ -682,12 +704,16 @@ class Distributer:
             # waiting out the claim's expiry.
             self.scheduler.release_claim(w, token)
             self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
+            flight.note(obs_events.SESS_RESULT_DROPPED, key=w.key,
+                        reason="upload_stalled")
             logger.info("dropped result for %s (upload stalled or "
                         "connection lost)", w)
             raise
         if not self.scheduler.finish_claim(w, token):
             # Claim expired between accept and payload arrival; drop.
             self.counters.inc(obs_names.COORD_RESULTS_DROPPED)
+            flight.note(obs_events.SESS_RESULT_DROPPED, key=w.key,
+                        reason="expired_mid_upload")
             logger.info("dropped result for %s (lease expired mid-upload)", w)
             return
         self.counters.inc(obs_names.COORD_RESULTS_ACCEPTED)
@@ -750,6 +776,8 @@ class Distributer:
             self.counters.inc(obs_names.COORD_PERSIST_US, int(dt * 1e6))
             self.registry.observe(obs_names.HIST_PERSIST_SECONDS, dt)
             self.counters.inc(obs_names.COORD_CHUNKS_SAVED, len(batch))
+            flight.note(obs_events.STORE_FLUSH, tiles=len(batch),
+                        seconds=round(dt, 6))
             for _, chunk in batch:
                 self.trace.record("persisted", chunk.key)
                 if self.on_chunk_saved is not None:
@@ -766,7 +794,9 @@ class Distributer:
             logger.exception("failed to save batch of %d chunks; "
                              "reopening tiles", len(batch))
             self.counters.inc("save_errors", len(batch))
+            flight.note(obs_events.STORE_SAVE_ERROR, tiles=len(batch))
             for w, _ in batch:
+                flight.note(obs_events.STORE_REOPEN, key=w.key)
                 self.scheduler.reopen(w)
         finally:
             # Durable (or reopened) either way: checkpoints may include —

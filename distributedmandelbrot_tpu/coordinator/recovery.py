@@ -51,6 +51,8 @@ from distributedmandelbrot_tpu.codecs.checkpoint import (
 from distributedmandelbrot_tpu.coordinator.scheduler import (Key,
                                                              TileScheduler)
 from distributedmandelbrot_tpu.core.workload import LevelSetting, Workload
+from distributedmandelbrot_tpu.obs import events as obs_events
+from distributedmandelbrot_tpu.obs import flight
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.storage.store import ChunkStore
 from distributedmandelbrot_tpu.utils import faults
@@ -261,6 +263,9 @@ def load_restore_state(store: ChunkStore,
         replayed = len(entries)
     if registry is not None:
         registry.inc(obs_names.COORD_REPLAY_ENTRIES, replayed)
+    flight.note(obs_events.CKPT_RESTORE, generation=generation,
+                completed=len(completed), replayed=replayed,
+                from_checkpoint=ckpt is not None)
     return RestoreResult(completed=completed, generation=generation,
                          checkpoint=ckpt, replayed_entries=replayed)
 
@@ -330,12 +335,16 @@ class RecoveryManager:
                 logger.error(
                     "fenced out: a newer coordinator generation owns the "
                     "checkpoint; disabling further checkpoints")
+                flight.note(obs_events.CKPT_ERROR, reason="fenced",
+                            generation=self.generation)
                 self._fenced = True
                 if self._registry is not None:
                     self._registry.inc(obs_names.COORD_CHECKPOINT_ERRORS)
                 return
-            except Exception:
+            except Exception as exc:
                 logger.exception("periodic checkpoint failed")
+                flight.note(obs_events.CKPT_ERROR, reason="exception",
+                            error=str(exc)[:120])
                 if self._registry is not None:
                     self._registry.inc(obs_names.COORD_CHECKPOINT_ERRORS)
 
@@ -374,6 +383,8 @@ class RecoveryManager:
     def write(self, ckpt: Checkpoint) -> dict:
         """Encode + fence-check + atomic PUT; returns write stats."""
         t0 = time.monotonic()
+        flight.note(obs_events.CKPT_BEGIN, generation=ckpt.generation,
+                    leases=len(ckpt.leases), completed=len(ckpt.completed))
         stored = peek_generation(self.store, self.scheduler.level_settings,
                                  self.namespace)
         if stored is not None and stored > ckpt.generation:
@@ -386,6 +397,8 @@ class RecoveryManager:
         faults.hit("recovery.mid_checkpoint")
         self.store.backend.put_blob(self._blob_name, data, fsync=True)
         dt = time.monotonic() - t0
+        flight.note(obs_events.CKPT_DONE, generation=ckpt.generation,
+                    bytes=len(data), seconds=round(dt, 6))
         if self._registry is not None:
             self._registry.inc(obs_names.COORD_CHECKPOINTS_WRITTEN)
             self._registry.observe(obs_names.HIST_CHECKPOINT_SECONDS, dt)

@@ -32,6 +32,8 @@ from typing import TYPE_CHECKING, Callable, Optional, Sequence
 from distributedmandelbrot_tpu.coordinator.clock import Clock, MonotonicClock
 from distributedmandelbrot_tpu.core.workload import LevelSetting, Workload
 from distributedmandelbrot_tpu.net.protocol import DEFAULT_LEASE_TIMEOUT
+from distributedmandelbrot_tpu.obs import events as obs_events
+from distributedmandelbrot_tpu.obs import flight
 from distributedmandelbrot_tpu.obs import names as obs_names
 
 if TYPE_CHECKING:
@@ -120,9 +122,11 @@ class TileScheduler:
     def _count_requeue(self, key: Key, *, expired: bool = False) -> None:
         if expired:
             self._record("lease_expired", key)
+            flight.note(obs_events.SCHED_EXPIRE, key=key)
             if self._registry is not None:
                 self._registry.inc(obs_names.COORD_LEASES_EXPIRED)
         self._record("requeued", key)
+        flight.note(obs_events.SCHED_REQUEUE, key=key)
         if self._registry is not None:
             self._registry.inc(obs_names.COORD_REQUEUES)
 
@@ -236,6 +240,7 @@ class TileScheduler:
                 return None
         self._record("scheduled", w.key)
         self._leases[w.key] = Lease(w, now + self.lease_timeout)
+        flight.note(obs_events.SCHED_GRANT, key=w.key)
         return w
 
     def acquire_batch(self, max_count: int) -> list[Workload]:
@@ -277,6 +282,8 @@ class TileScheduler:
             return None
         self._claim_seq += 1
         self._claims[w.key] = (self._claim_seq, self._leases.pop(w.key))
+        flight.note(obs_events.SCHED_CLAIM, key=w.key,
+                    lease=self._claim_seq)
         return self._claim_seq
 
     def finish_claim(self, w: Workload, token: int) -> bool:
@@ -297,6 +304,7 @@ class TileScheduler:
                 # path must not drive _remaining negative and end the run
                 # early.
                 self._remaining -= 1
+        flight.note(obs_events.SCHED_ACCEPT, key=w.key, lease=token)
         return True
 
     def release_claim(self, w: Workload, token: int) -> None:
@@ -305,6 +313,7 @@ class TileScheduler:
         if entry is None or entry[0] != token:
             return  # superseded; nothing to release
         del self._claims[w.key]
+        flight.note(obs_events.SCHED_RELEASE, key=w.key, lease=token)
         if w.key not in self._completed:
             self._retry.append(entry[1].workload)
             self._count_requeue(w.key)
@@ -336,6 +345,7 @@ class TileScheduler:
             return False
         if self._grantable(w, self.clock.now()):
             self._retry.appendleft(w)
+            flight.note(obs_events.SCHED_PRIORITIZE, key=w.key)
         return True
 
     def refine(self, w: Workload) -> bool:
@@ -355,6 +365,8 @@ class TileScheduler:
         if w.key in self._completed:
             self._completed.discard(w.key)
             self._remaining += 1
+        flight.note(obs_events.SCHED_REFINE, key=w.key,
+                    max_iter=w.max_iter)
         if self._grantable(w, self.clock.now()):
             self._retry.appendleft(w)
         return True
@@ -374,6 +386,7 @@ class TileScheduler:
             self._completed.discard(w.key)
             self._remaining += 1
             self._retry.append(w)
+            flight.note(obs_events.SCHED_REOPEN, key=w.key)
             self._count_requeue(w.key)
 
     # -- checkpoint / restore ---------------------------------------------
@@ -452,6 +465,8 @@ class TileScheduler:
             else:
                 self._retry.append(w)
                 self._count_requeue(w.key, expired=True)
+        flight.note(obs_events.SCHED_RESTORE, leases=rebuilt,
+                    retry=len(self._retry))
         return rebuilt
 
     # -- maintenance ------------------------------------------------------

@@ -17,22 +17,37 @@ package is the telemetry spine of the TPU build:
   (Perfetto-loadable), served by the exporter as ``/trace.json``;
 - :mod:`.exporter` — an asyncio HTTP endpoint serving ``/metrics``
   (Prometheus text exposition v0.0.4), ``/varz`` (JSON snapshot),
-  ``/healthz`` and ``/trace.json``, enabled from the coordinator like
-  the gateway is.
+  ``/healthz``, ``/trace.json`` and the live flight ring as
+  ``/flight``, enabled from the coordinator like the gateway is;
+- :mod:`.events` — the registered flight-recorder event names (the
+  ``obs-event`` rule in ``dmtpu check`` keeps call sites honest);
+- :mod:`.flight` — the per-process black-box flight recorder: a
+  bounded, sampled ring of state transitions dumped on every exit path
+  (``DMTPU_FLIGHT_DIR``), appended to via the free-when-off module
+  function :func:`flight.note`;
+- :mod:`.postmortem` — the ``dmtpu postmortem`` assembler merging a
+  directory of flight dumps into one clock-aligned causal timeline
+  with in-flight-lease reconstruction and anomaly detectors.
 """
 
 from distributedmandelbrot_tpu.obs.chrome import render_chrome_trace
 from distributedmandelbrot_tpu.obs.exporter import (MetricsExporter,
                                                     render_prometheus)
+from distributedmandelbrot_tpu.obs.flight import FlightRecorder
 from distributedmandelbrot_tpu.obs.metrics import (DEFAULT_BUCKETS, Counter,
                                                    Gauge, Histogram, Registry)
+from distributedmandelbrot_tpu.obs.postmortem import Postmortem
+from distributedmandelbrot_tpu.obs.postmortem import \
+    assemble as assemble_postmortem
 from distributedmandelbrot_tpu.obs.spans import (ClockOffsetEstimator,
                                                  OffsetEstimate, Span,
                                                  SpanRecorder, SpanStore,
                                                  critical_path)
 from distributedmandelbrot_tpu.obs.trace import TraceEvent, TraceLog
 
-__all__ = ["ClockOffsetEstimator", "Counter", "DEFAULT_BUCKETS", "Gauge",
-           "Histogram", "MetricsExporter", "OffsetEstimate", "Registry",
-           "Span", "SpanRecorder", "SpanStore", "TraceEvent", "TraceLog",
-           "critical_path", "render_chrome_trace", "render_prometheus"]
+__all__ = ["ClockOffsetEstimator", "Counter", "DEFAULT_BUCKETS",
+           "FlightRecorder", "Gauge", "Histogram", "MetricsExporter",
+           "OffsetEstimate", "Postmortem", "Registry", "Span",
+           "SpanRecorder", "SpanStore", "TraceEvent", "TraceLog",
+           "assemble_postmortem", "critical_path", "render_chrome_trace",
+           "render_prometheus"]

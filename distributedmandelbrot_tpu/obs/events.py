@@ -1,0 +1,75 @@
+"""Canonical flight-recorder event names, in one place (like names.py).
+
+The flight recorder (obs/flight.py) is a black box: its value after a
+crash depends entirely on every layer having spelled its state
+transitions consistently, because the postmortem assembler
+(obs/postmortem.py) joins events *by name* across process dumps —
+``sched.grant`` on a killed shard must mean the same thing as
+``sched.grant`` on the shard that re-granted the tile.  This module is
+the arbiter, exactly as ``obs/names.py`` arbitrates metric names, and
+the ``obs-event`` rule (analysis/rules_obs.py) is the enforcement: an
+event literal at a ``flight.note(...)`` site must be registered here,
+and every registration must be emitted somewhere.
+
+Event names are ``category.transition``; the category (the part before
+the first dot) is also the sampling-cap bucket in the recorder, so hot
+families (worker stage traffic, gateway sheds under storm) can be
+rate-capped without touching rare, load-bearing events (checkpoint
+seams, crashpoints).
+"""
+
+from __future__ import annotations
+
+# -- scheduler lease lifecycle (coordinator/scheduler.py) -----------------
+
+SCHED_GRANT = "sched.grant"
+SCHED_CLAIM = "sched.claim"
+SCHED_ACCEPT = "sched.accept"
+SCHED_RELEASE = "sched.release"
+SCHED_EXPIRE = "sched.expire"
+SCHED_REQUEUE = "sched.requeue"
+SCHED_PRIORITIZE = "sched.prioritize"
+SCHED_REFINE = "sched.refine"
+SCHED_REOPEN = "sched.reopen"
+SCHED_RESTORE = "sched.restore"
+
+# -- distributer session arms (coordinator/distributer.py) ----------------
+
+SESS_OPEN = "sess.open"
+SESS_REJECT_FRAME = "sess.reject_frame"
+SESS_REDIRECT = "sess.redirect"
+SESS_RESULT_REJECTED = "sess.result_rejected"
+SESS_RESULT_DROPPED = "sess.result_dropped"
+
+# -- group-commit writer (coordinator/distributer.py persist loop) --------
+
+STORE_FLUSH = "store.flush"
+STORE_SAVE_ERROR = "store.save_error"
+STORE_REOPEN = "store.reopen"
+
+# -- checkpoint/restore seams (coordinator/recovery.py) -------------------
+
+CKPT_BEGIN = "ckpt.begin"
+CKPT_DONE = "ckpt.done"
+CKPT_ERROR = "ckpt.error"
+CKPT_RESTORE = "ckpt.restore"
+
+# -- gateway admission (serve/gateway.py) ---------------------------------
+
+GW_REJECT = "gw.reject"
+GW_SHED = "gw.shed"
+GW_SESSION_THROTTLE = "gw.session_throttle"
+
+# -- worker pipeline + backend demotions (worker/) ------------------------
+
+WKR_STAGE = "wkr.stage"
+WKR_DEMOTE = "wkr.demote"
+
+# -- fault injection (utils/faults.py) ------------------------------------
+
+FAULT_CRASHPOINT = "fault.crashpoint"
+
+# -- SLO alerting (obs/slo.py) --------------------------------------------
+
+SLO_FIRE = "slo.fire"
+SLO_RECOVER = "slo.recover"

@@ -22,6 +22,9 @@ gateway, ephemeral port by default).
   (counter rates, gauge traces, histogram percentile series);
 - ``/fleet`` — the merged fleet snapshot from an attached
   :class:`~distributedmandelbrot_tpu.obs.fleet.FleetAggregator`;
+- ``/flight?window=`` — live flight-recorder ring snapshot from the
+  attached :class:`~distributedmandelbrot_tpu.obs.flight.FlightRecorder`
+  (the same header + events document the crash dumps carry);
 - ``POST /checkpoint`` — on-demand durability checkpoint (admin-only
   write route, present iff the embedding coordinator supplies
   ``checkpoint_cb``; `dmtpu admin checkpoint` posts here).
@@ -128,16 +131,18 @@ class MetricsExporter:
                  varz_extra: Optional[Callable[[], dict]] = None,
                  checkpoint_cb: Optional[Callable[[], "asyncio.Future"]]
                  = None,
-                 sampler=None, fleet=None,
+                 sampler=None, fleet=None, flight=None,
                  host: str = "127.0.0.1", port: int = 0) -> None:
         self.registry = registry
         self.trace = trace
         self.spans = spans
         self.varz_extra = varz_extra
-        # Optional TimeseriesSampler (/timeseries) and FleetAggregator
-        # (/fleet) — duck-typed so the exporter needs neither module.
+        # Optional TimeseriesSampler (/timeseries), FleetAggregator
+        # (/fleet) and FlightRecorder (/flight) — duck-typed so the
+        # exporter needs none of those modules.
         self.sampler = sampler
         self.fleet = fleet
+        self.flight = flight
         # Async callable -> stats dict; enables the POST /checkpoint
         # admin route (the coordinator wires its RecoveryManager here).
         self.checkpoint_cb = checkpoint_cb
@@ -228,6 +233,19 @@ class MetricsExporter:
                 body = (json.dumps(doc, sort_keys=True) + "\n").encode()
                 self._respond(writer, status, "application/json", body,
                               head=method == "HEAD")
+            elif path == "/flight" and self.flight is not None:
+                params = urllib.parse.parse_qs(query)
+                window = None
+                try:
+                    raw = (params.get("window") or [None])[0]
+                    if raw is not None:
+                        window = max(0.0, float(raw))
+                except ValueError:
+                    window = None  # garbage window -> whole ring
+                doc = self.flight.snapshot(window=window)
+                body = (json.dumps(doc, sort_keys=True) + "\n").encode()
+                self._respond(writer, 200, "application/json", body,
+                              head=method == "HEAD")
             elif path == "/fleet" and self.fleet is not None:
                 body = (json.dumps(self.fleet.snapshot(), sort_keys=True)
                         + "\n").encode()
@@ -236,7 +254,7 @@ class MetricsExporter:
             else:
                 self._respond(writer, 404, "text/plain; charset=utf-8",
                               b"not found (try /metrics /varz /healthz "
-                              b"/trace.json /timeseries /fleet)\n")
+                              b"/trace.json /timeseries /fleet /flight)\n")
             await writer.drain()
         except (ConnectionError, TimeoutError, asyncio.TimeoutError,
                 asyncio.CancelledError):
@@ -297,12 +315,13 @@ class ExporterThread:
 
     def __init__(self, registry: Registry, *,
                  varz_extra: Optional[Callable[[], dict]] = None,
-                 sampler=None, fleet=None,
+                 sampler=None, fleet=None, flight=None,
                  host: str = "127.0.0.1", port: int = 0) -> None:
         self.registry = registry
         self.varz_extra = varz_extra
         self.sampler = sampler
         self.fleet = fleet
+        self.flight = flight
         self.host = host
         self.port = port
         self._thread: Optional[threading.Thread] = None
@@ -342,6 +361,7 @@ class ExporterThread:
         exporter = MetricsExporter(
             self.registry, varz_extra=self.varz_extra,
             sampler=self.sampler, fleet=self.fleet,
+            flight=self.flight,
             host=self.host, port=self.port)
         await exporter.start()
         self.port = exporter.port

@@ -1,0 +1,437 @@
+"""Per-process black-box flight recorder: last-N state transitions, always on.
+
+Metrics (PR 2) say *that* something went wrong and spans (PR 5) say *how
+long* things took, but neither records *what the process was doing when
+it died* — the sequence of scheduler, session, persist and checkpoint
+transitions leading up to a crash.  :class:`FlightRecorder` is that
+black box: a lock-cheap bounded ring of structured events (monotonic
+seq, monotonic timestamp, category, optional tile key + lease token,
+small k=v payload) that every layer appends to through the module-level
+:func:`note` — a no-op costing one global read until a process opts in
+via :func:`ensure`.
+
+Event names are registered in obs/events.py (the ``obs-event`` lint
+rule keeps call sites honest); the part before the first dot is the
+category, which is also the sampling-cap bucket: hot categories are
+rate-capped per wall-second so a grant storm cannot starve the ring of
+the rare transitions (checkpoint seams, crashpoints) a postmortem
+actually needs.
+
+Dumps — a JSONL header line plus one line per ring event — are written
+on every exit path once :meth:`FlightRecorder.install` ran (it does
+when ``DMTPU_FLIGHT_DIR`` is set): ``sys.excepthook`` and
+``threading.excepthook`` (chained), SIGTERM (only when the default
+handler was in place; re-raised after the dump so exit codes survive),
+``atexit``, armed ``faults.hit`` crashpoints (utils/faults.py calls
+back here before ``os._exit``), and a periodic autoflush thread whose
+snapshot is what survives a SIGKILL.  The exporter serves the live ring
+as ``GET /flight?window=`` and obs/postmortem.py merges the dump files
+of a whole fleet into one causally-ordered timeline.
+
+``DMTPU_FLIGHT=0`` disables the recorder entirely (the ``bench.py
+--obs`` recorder-off leg); ``DMTPU_FLIGHT_PERIOD`` tunes the autoflush
+cadence.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, NamedTuple, Optional
+
+from distributedmandelbrot_tpu.obs import events as obs_events
+from distributedmandelbrot_tpu.obs import names as obs_names
+
+Key = tuple[int, int, int]
+
+ENV_VAR = "DMTPU_FLIGHT"  # "0" disables the process recorder
+ENV_DIR_VAR = "DMTPU_FLIGHT_DIR"  # dump directory; also enables dumps
+ENV_PERIOD_VAR = "DMTPU_FLIGHT_PERIOD"  # autoflush seconds (default 0.5)
+
+DUMP_VERSION = 1
+DUMP_KIND = "dmtpu-flight"
+
+# Per-category events per cap-window second.  The caps only bound the
+# *rate* each family may claim; the ring bounds total memory.  Rare,
+# load-bearing families (ckpt, fault, slo) are deliberately uncapped.
+DEFAULT_CAPS = {
+    "sched": 2000,
+    "sess": 1000,
+    "store": 500,
+    "gw": 500,
+    "wkr": 500,
+}
+
+
+class FlightEvent(NamedTuple):
+    seq: int
+    t: float  # recorder (monotonic) clock seconds
+    cat: str
+    name: str  # obs_events.* value
+    key: Optional[Key]
+    lease: Optional[int]
+    kv: Optional[dict]
+
+    def to_doc(self) -> dict:
+        doc: dict = {"seq": self.seq, "t": round(self.t, 6),
+                     "cat": self.cat, "name": self.name}
+        if self.key is not None:
+            doc["key"] = list(self.key)
+        if self.lease is not None:
+            doc["lease"] = self.lease
+        if self.kv:
+            doc["kv"] = self.kv
+        return doc
+
+
+class FlightRecorder:
+    """Bounded, thread-safe ring of flight events with per-category caps.
+
+    ``clock``/``wall`` are injectable (virtual-clock unit tests); every
+    event carries only the monotonic clock, and the dump header anchors
+    a (wall, mono) pair sampled together so readers can place the whole
+    ring on the wall clock without per-event double stamps.
+    """
+
+    def __init__(self, capacity: int = 4096, *, role: str = "proc",
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time,
+                 caps: Optional[dict] = None,
+                 cap_window: float = 1.0) -> None:
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self.role = role
+        self.clock = clock
+        self.wall = wall
+        self.enabled = True
+        self.pid = os.getpid()
+        self.worker_id: Optional[str] = None
+        self.shard: Optional[int] = None
+        # Coordinator processes point this at their SpanStore so dumps
+        # carry the per-worker NTP offsets postmortem aligns with.
+        self.offsets_fn: Optional[Callable[[], dict]] = None
+        self.dump_dir: Optional[str] = None
+        self.dumps_written = 0
+        self._caps = dict(DEFAULT_CAPS if caps is None else caps)
+        self._cap_window = cap_window
+        self._cap_bucket = -1
+        self._cap_counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._dump_lock = threading.Lock()
+        self._exited = False
+        self._ring: deque[FlightEvent] = deque(maxlen=capacity)
+        self._seq = 0
+        self._dropped_ring = 0
+        self._dropped_sampled: dict[str, int] = {}
+        self._registry = None
+        self._bound_registries: set[int] = set()
+        self._flush_stop: Optional[threading.Event] = None
+        self._installed = False
+        self._prev_excepthook = None
+        self._prev_threading_hook = None
+
+    # -- hot path ------------------------------------------------------
+
+    def note(self, name: str, key: Optional[Key] = None,
+             lease: Optional[int] = None, **kv) -> None:
+        if not self.enabled:
+            return
+        cat = name.partition(".")[0]
+        now = self.clock()
+        with self._lock:
+            cap = self._caps.get(cat)
+            if cap is not None:
+                bucket = int(now / self._cap_window)
+                if bucket != self._cap_bucket:
+                    self._cap_bucket = bucket
+                    self._cap_counts.clear()
+                used = self._cap_counts.get(cat, 0)
+                if used >= cap:
+                    self._dropped_sampled[cat] = \
+                        self._dropped_sampled.get(cat, 0) + 1
+                    return
+                self._cap_counts[cat] = used + 1
+            self._seq += 1
+            if len(self._ring) == self.capacity:
+                self._dropped_ring += 1
+            self._ring.append(FlightEvent(self._seq, now, cat, name,
+                                          key, lease, kv or None))
+
+    # -- read side -----------------------------------------------------
+
+    @property
+    def recorded(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped_ring + sum(self._dropped_sampled.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def header(self, reason: str = "live") -> dict:
+        """Dump/snapshot header: identity + a (wall, mono) anchor pair
+        sampled together, so every ring timestamp places on the wall
+        clock, plus the span-derived worker clock offsets when a
+        SpanStore is attached (coordinator roles)."""
+        with self._lock:
+            dropped_sampled = dict(self._dropped_sampled)
+            dropped_ring = self._dropped_ring
+            seq = self._seq
+        doc: dict = {
+            "v": DUMP_VERSION, "kind": DUMP_KIND,
+            "role": self.role, "pid": self.pid,
+            "host": socket.gethostname(),
+            "reason": reason,
+            "wall0": self.wall(), "mono0": self.clock(),
+            "seq": seq,
+            "dropped_ring": dropped_ring,
+            "dropped_sampled": dropped_sampled,
+        }
+        if self.worker_id is not None:
+            doc["worker_id"] = self.worker_id
+        if self.shard is not None:
+            doc["shard"] = self.shard
+        if self.offsets_fn is not None:
+            try:
+                doc["offsets"] = self.offsets_fn()
+            except Exception:
+                doc["offsets"] = {}
+        return doc
+
+    def snapshot(self, window: Optional[float] = None,
+                 reason: str = "live") -> dict:
+        """Live ``{"header", "events"}`` document (the ``/flight``
+        route); ``window`` keeps only the trailing seconds of ring."""
+        with self._lock:
+            events = list(self._ring)
+        if window is not None and events:
+            cutoff = self.clock() - window
+            events = [e for e in events if e.t >= cutoff]
+        return {"header": self.header(reason=reason),
+                "events": [e.to_doc() for e in events]}
+
+    def tail(self, n: int) -> list[dict]:
+        """Last ``n`` events as dicts (SLO alerts attach this)."""
+        with self._lock:
+            events = list(self._ring)[-n:]
+        return [e.to_doc() for e in events]
+
+    # -- registry ------------------------------------------------------
+
+    def bind_registry(self, registry) -> None:
+        """Expose ring totals as live gauges on ``registry`` (idempotent
+        per registry — embedders construct several coordinators per
+        process and each brings its own registry)."""
+        if id(registry) in self._bound_registries:
+            return
+        self._bound_registries.add(id(registry))
+        self._registry = registry
+        registry.gauge(obs_names.GAUGE_FLIGHT_EVENTS,
+                       help="flight-recorder events recorded",
+                       fn=lambda: self.recorded)
+        registry.gauge(obs_names.GAUGE_FLIGHT_EVENTS_DROPPED,
+                       help="flight-recorder events dropped "
+                            "(ring overflow + sampling caps)",
+                       fn=lambda: self.dropped)
+
+    # -- dumps ---------------------------------------------------------
+
+    @property
+    def dump_path(self) -> Optional[str]:
+        if self.dump_dir is None:
+            return None
+        safe_role = "".join(c if c.isalnum() or c in "-_" else "-"
+                            for c in self.role)
+        return os.path.join(self.dump_dir,
+                            f"flight-{safe_role}-{self.pid}.jsonl")
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual", *, final: bool = False) -> Optional[str]:
+        """Write header + ring as JSONL, atomically (tmp + rename): a
+        reader — or the next autoflush — never sees a torn file.
+
+        ``final`` marks a process-exit dump (atexit, signal, crashpoint,
+        main-thread excepthook).  The dump lock serializes writers, and
+        once a final dump landed, later autoflush dumps become no-ops —
+        the daemon flusher outlives atexit callbacks in CPython and must
+        not clobber the exit reason."""
+        path = path if path is not None else self.dump_path
+        if path is None:
+            return None
+        with self._dump_lock:
+            if self._exited and reason == "autoflush":
+                return None
+            if final:
+                self._exited = True
+            with self._lock:
+                events = list(self._ring)
+            lines = [json.dumps(self.header(reason=reason), default=str)]
+            lines.extend(json.dumps(e.to_doc(), default=str)
+                         for e in events)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write("\n".join(lines) + "\n")
+            os.replace(tmp, path)
+            self.dumps_written += 1
+        if self._registry is not None:
+            self._registry.inc(obs_names.FLIGHT_DUMPS)
+        return path
+
+    # -- exit-path installation ---------------------------------------
+
+    def install(self, dump_dir: str, *, period: float = 0.5) -> None:
+        """Arm every exit path to dump into ``dump_dir`` and start the
+        autoflush thread (the SIGKILL survivor).  Idempotent."""
+        if self._installed:
+            return
+        self._installed = True
+        self.dump_dir = dump_dir
+        os.makedirs(dump_dir, exist_ok=True)
+
+        self._prev_excepthook = sys.excepthook
+
+        def _excepthook(tp, value, tb):
+            self._safe_dump(f"excepthook:{tp.__name__}", final=True)
+            self._prev_excepthook(tp, value, tb)
+
+        sys.excepthook = _excepthook
+
+        self._prev_threading_hook = threading.excepthook
+
+        def _thread_hook(args):
+            self._safe_dump(
+                f"threading.excepthook:{args.exc_type.__name__}")
+            self._prev_threading_hook(args)
+
+        threading.excepthook = _thread_hook
+        atexit.register(self._exit_dump)
+        # Crashpoints hard-exit via os._exit (no atexit, no excepthook):
+        # faults.py calls back just before dying.
+        from distributedmandelbrot_tpu.utils import faults
+
+        faults.on_fire(self._on_crashpoint)
+        self._install_sigterm()
+        if period > 0:
+            self._flush_stop = threading.Event()
+            t = threading.Thread(target=self._autoflush_loop,
+                                 args=(period,), daemon=True,
+                                 name="flight-autoflush")
+            t.start()
+
+    def uninstall(self) -> None:
+        """Restore the chained hooks (test hygiene; the autoflush thread
+        stops, signal handlers are left as-is)."""
+        if not self._installed:
+            return
+        self._installed = False
+        if self._flush_stop is not None:
+            self._flush_stop.set()
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+        if self._prev_threading_hook is not None:
+            threading.excepthook = self._prev_threading_hook
+
+    def _safe_dump(self, reason: str, *, final: bool = False) -> None:
+        try:
+            self.dump(reason=reason, final=final)
+        except Exception:
+            pass  # a dying process must die its own death, not ours
+
+    def _exit_dump(self) -> None:
+        if self._flush_stop is not None:
+            self._flush_stop.set()
+        self._safe_dump("atexit", final=True)
+
+    def _on_crashpoint(self, point: str, hard_exit: bool) -> None:
+        self.note(obs_events.FAULT_CRASHPOINT, point=point,
+                  hard_exit=hard_exit)
+        if hard_exit:
+            self._safe_dump(f"crashpoint:{point}", final=True)
+
+    def _install_sigterm(self) -> None:
+        # Only claim SIGTERM when nobody else did (SIG_DFL): asyncio
+        # processes (the shard driver) install their own graceful
+        # handler after construction and must win; re-raising after the
+        # dump preserves the default killed-by-signal exit status for
+        # the parent.
+        try:
+            if signal.getsignal(signal.SIGTERM) is not signal.SIG_DFL:
+                return
+
+            def _handler(signum, frame):
+                self._safe_dump(f"signal:{signum}", final=True)
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+            signal.signal(signal.SIGTERM, _handler)
+        except (ValueError, OSError):
+            pass  # not the main thread, or an embedding forbids signals
+
+    def _autoflush_loop(self, period: float) -> None:
+        assert self._flush_stop is not None
+        while not self._flush_stop.wait(period):
+            self._safe_dump("autoflush")
+
+
+# -- process-global recorder ----------------------------------------------
+
+_default: Optional[FlightRecorder] = None
+_default_lock = threading.Lock()
+
+
+def get() -> Optional[FlightRecorder]:
+    return _default
+
+
+def set_recorder(recorder: Optional[FlightRecorder]) -> None:
+    """Test hook: swap the process-global recorder."""
+    global _default
+    _default = recorder
+
+
+def note(name: str, key: Optional[Key] = None,
+         lease: Optional[int] = None, **kv) -> None:
+    """Record on the process recorder; free (one global read) when no
+    layer has called :func:`ensure` or ``DMTPU_FLIGHT=0``."""
+    rec = _default
+    if rec is not None:
+        rec.note(name, key=key, lease=lease, **kv)
+
+
+def ensure(role: str, *, registry=None,
+           environ=os.environ) -> Optional[FlightRecorder]:
+    """Create (once) and return the process recorder.
+
+    The first caller names the process — a shard, coordinator or worker
+    constructor — and wins; later callers just bind their registry.
+    ``DMTPU_FLIGHT=0`` returns None and leaves :func:`note` free;
+    ``DMTPU_FLIGHT_DIR`` arms the dump paths + autoflush.
+    """
+    global _default
+    if environ.get(ENV_VAR, "1") == "0":
+        return None
+    with _default_lock:
+        if _default is None:
+            _default = FlightRecorder(role=role)
+            dump_dir = environ.get(ENV_DIR_VAR)
+            if dump_dir:
+                _default.install(
+                    dump_dir,
+                    period=float(environ.get(ENV_PERIOD_VAR, "0.5")))
+        rec = _default
+    if registry is not None:
+        rec.bind_registry(registry)
+    return rec

@@ -407,6 +407,21 @@ GAUGE_SLO_BURN = "slo_burn_rate"
 SLO_ALERTS_FIRED = "slo_alerts_fired"
 SLO_ALERTS_RECOVERED = "slo_alerts_recovered"
 
+# -- flight recorder + postmortem (obs/flight + obs/postmortem) ------------
+
+# Black-box ring totals (live gauges read off the recorder — the hot
+# note() path never touches the registry), dump files written across
+# the exit paths, and the postmortem assembler's load accounting:
+# dumps merged, corrupt/truncated lines tolerated-but-counted, and
+# anomalies (grant-without-accept, ping-pong, redirect loops, retry
+# storms, double-commit evidence) the detectors surfaced.
+GAUGE_FLIGHT_EVENTS = "flight_events"
+GAUGE_FLIGHT_EVENTS_DROPPED = "flight_events_dropped"
+FLIGHT_DUMPS = "flight_dumps"
+POSTMORTEM_DUMPS_LOADED = "postmortem_dumps_loaded"
+POSTMORTEM_DUMP_ERRORS = "postmortem_dump_errors"
+POSTMORTEM_ANOMALIES = "postmortem_anomalies"
+
 # -- legacy aliases -------------------------------------------------------
 
 # canonical name -> the spelling pre-registry call sites read.  Reads of a

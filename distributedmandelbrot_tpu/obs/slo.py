@@ -34,9 +34,16 @@ from __future__ import annotations
 import bisect
 from typing import Callable, NamedTuple, Optional, Sequence
 
+from distributedmandelbrot_tpu.obs import events as obs_events
+from distributedmandelbrot_tpu.obs import flight
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.timeseries import (TimeseriesSampler,
                                                       family_of)
+
+# Ring events attached to a firing alert's status doc: enough trailing
+# flight-recorder context to see what the process was doing when the
+# burn crossed the threshold, without shipping the whole ring.
+EVIDENCE_TAIL = 40
 
 DEFAULT_FAST_WINDOW = 300.0
 DEFAULT_SLOW_WINDOW = 3600.0
@@ -128,18 +135,21 @@ class _BaseSLO:
         over_fast = fast.burn >= self.burn_threshold
         over_slow = slow.burn >= self.burn_threshold
         reg = self.sampler.registry
+        evidence: Optional[list[dict]] = None
         if self.state == STATE_OK:
             if over_fast and over_slow:
                 self.state = STATE_FIRING
                 self.fired += 1
                 reg.inc(obs_names.SLO_ALERTS_FIRED,
                         labels={"slo": self.name})
+                evidence = self._on_fire(fast, slow)
         elif self.state == STATE_FIRING:
             if not over_slow:
                 self.state = STATE_OK
                 self.recovered += 1
                 reg.inc(obs_names.SLO_ALERTS_RECOVERED,
                         labels={"slo": self.name})
+                self._on_recover()
             elif not over_fast:
                 self.state = STATE_HOLD
         else:  # hold: slow window still burning, fast recovered
@@ -148,12 +158,13 @@ class _BaseSLO:
                 self.recovered += 1
                 reg.inc(obs_names.SLO_ALERTS_RECOVERED,
                         labels={"slo": self.name})
+                self._on_recover()
             elif over_fast:
                 self.state = STATE_FIRING
         for win, wb in (("fast", fast), ("slow", slow)):
             reg.set_gauge(obs_names.GAUGE_SLO_BURN, wb.burn,
                           labels={"slo": self.name, "window": win})
-        return {
+        out = {
             "name": self.name, "objective": self.objective,
             "state": self.state, "fired": self.fired,
             "recovered": self.recovered,
@@ -167,6 +178,30 @@ class _BaseSLO:
                      "error_rate": round(slow.error_rate, 6),
                      "burn": round(slow.burn, 4)},
         }
+        if evidence is not None:
+            out["evidence"] = evidence
+        return out
+
+    def _on_fire(self, fast: WindowBurn,
+                 slow: WindowBurn) -> Optional[list[dict]]:
+        """Fire transition: note the event, dump the black box (an SLO
+        fire is a crash-grade moment for postmortems) and return the
+        ring tail as alert evidence."""
+        flight.note(obs_events.SLO_FIRE, slo=self.name,
+                    fast_burn=round(fast.burn, 4),
+                    slow_burn=round(slow.burn, 4))
+        rec = flight.get()
+        if rec is None:
+            return None
+        if rec.dump_dir is not None:
+            try:
+                rec.dump(reason=f"slo:{self.name}")
+            except Exception:
+                pass
+        return rec.tail(EVIDENCE_TAIL)
+
+    def _on_recover(self) -> None:
+        flight.note(obs_events.SLO_RECOVER, slo=self.name)
 
 
 class AvailabilitySLO(_BaseSLO):

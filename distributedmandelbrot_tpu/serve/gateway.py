@@ -58,6 +58,8 @@ from distributedmandelbrot_tpu.core.chunk import Chunk
 from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.net import framing
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import events as obs_events
+from distributedmandelbrot_tpu.obs import flight
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.trace import TraceLog
 from distributedmandelbrot_tpu.serve import render
@@ -365,6 +367,8 @@ class TileGateway:
         self.counters.inc("gateway_queries")
         if not proto.query_in_range(level, index_real, index_imag):
             self.counters.inc("gateway_rejected")
+            flight.note(obs_events.GW_REJECT,
+                        key=(level, index_real, index_imag), path="query")
             return proto.QUERY_REJECT, None, obs_names.OUTCOME_REJECTED
         redirect = self._redirect_for(level, index_real, index_imag)
         if redirect is not None:
@@ -379,6 +383,9 @@ class TileGateway:
             self.counters.inc("gateway_overloaded")
             logger.info("shed query (%d,%d,%d): %d in service",
                         level, index_real, index_imag, self._active)
+            flight.note(obs_events.GW_SHED,
+                        key=(level, index_real, index_imag), path="query",
+                        in_service=self._active)
             return proto.QUERY_OVERLOADED, None, obs_names.OUTCOME_OVERLOADED
         self._active += 1
         try:
@@ -419,6 +426,8 @@ class TileGateway:
         self.counters.inc(obs_names.GATEWAY_RENDER_QUERIES)
         if not proto.query_in_range(level, index_real, index_imag):
             self.counters.inc("gateway_rejected")
+            flight.note(obs_events.GW_REJECT,
+                        key=(level, index_real, index_imag), path="render")
             return proto.QUERY_REJECT, None, obs_names.OUTCOME_REJECTED
         redirect = self._redirect_for(level, index_real, index_imag)
         if redirect is not None:
@@ -437,6 +446,9 @@ class TileGateway:
             self.counters.inc("gateway_overloaded")
             logger.info("shed render (%d,%d,%d): %d in service",
                         level, index_real, index_imag, self._active)
+            flight.note(obs_events.GW_SHED,
+                        key=(level, index_real, index_imag), path="render",
+                        in_service=self._active)
             return proto.QUERY_OVERLOADED, None, obs_names.OUTCOME_OVERLOADED
         self._active += 1
         try:
@@ -521,6 +533,8 @@ class TileGateway:
     ) -> tuple[int, Optional[bytes | tuple[int, int]], str]:
         if not proto.query_in_range(level, index_real, index_imag):
             self.counters.inc("gateway_rejected")
+            flight.note(obs_events.GW_REJECT,
+                        key=(level, index_real, index_imag), path="session")
             return proto.QUERY_REJECT, None, obs_names.OUTCOME_REJECTED
         redirect = self._redirect_for(level, index_real, index_imag)
         if redirect is not None:
@@ -540,6 +554,9 @@ class TileGateway:
         # while the rest of the crowd queues.
         if not state.admit():
             self.counters.inc(obs_names.SESSION_THROTTLED)
+            flight.note(obs_events.GW_SESSION_THROTTLE,
+                        key=(level, index_real, index_imag),
+                        session=state.session_id)
             return (proto.QUERY_OVERLOADED, None,
                     obs_names.OUTCOME_SESSION_THROTTLED)
         render_key = (level, index_real, index_imag, colormap_id)
@@ -551,6 +568,9 @@ class TileGateway:
         if self._active >= self.max_queue_depth \
                 or not self.bucket.try_acquire():
             self.counters.inc("gateway_overloaded")
+            flight.note(obs_events.GW_SHED,
+                        key=(level, index_real, index_imag),
+                        path="session", in_service=self._active)
             return proto.QUERY_OVERLOADED, None, obs_names.OUTCOME_OVERLOADED
         self._active += 1
         try:

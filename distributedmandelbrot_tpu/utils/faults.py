@@ -54,6 +54,29 @@ _lock = threading.Lock()
 _armed: dict[str, list] = {}
 # point -> sleep seconds (every hit, until disarmed)
 _slow: dict[str, float] = {}
+# Fired-point observers: cb(point, hard_exit), called just before the
+# crash takes effect.  A hard exit skips atexit and excepthooks, so this
+# is the ONLY seam where the flight recorder (obs/flight.py) can dump
+# the black box of a crashpoint-murdered process.
+_on_fire: list = []
+
+
+def on_fire(cb) -> None:
+    """Register ``cb(point, hard_exit)`` to run when an armed point
+    fires (before the raise / ``os._exit``).  Callbacks must not raise;
+    failures are swallowed — dying is the point's job, not theirs."""
+    with _lock:
+        _on_fire.append(cb)
+
+
+def _notify_fire(point: str, hard_exit: bool) -> None:
+    with _lock:
+        cbs = list(_on_fire)
+    for cb in cbs:
+        try:
+            cb(point, hard_exit)
+        except Exception:
+            pass
 
 
 def arm(point: str, *, after: int = 1, exit: bool = False) -> None:
@@ -119,6 +142,7 @@ def hit(point: str) -> None:
             return
         del _armed[point]
         hard_exit = spec[1]
+    _notify_fire(point, hard_exit)
     if hard_exit:
         os._exit(CRASH_EXIT_CODE)
     raise CrashPointError(f"armed crash point {point!r} fired")

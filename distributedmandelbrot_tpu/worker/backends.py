@@ -30,6 +30,8 @@ from distributedmandelbrot_tpu.core.geometry import (CHUNK_WIDTH,
                                                      TileSpec,
                                                      spec_f32_resolvable)
 from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.obs import events as obs_events
+from distributedmandelbrot_tpu.obs import flight
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.metrics import Registry
 try:
@@ -301,6 +303,8 @@ class PallasBackend:
                     use_mxu=(mode == "full"))
             except PallasUnsupported:
                 tiles = None  # demote to the single-device fused launch
+                flight.note(obs_events.WKR_DEMOTE, key=workloads[0].key,
+                            route="mesh_to_fused", tiles=len(workloads))
         if tiles is None:
             mesh_n = 1
             try:
@@ -308,6 +312,9 @@ class PallasBackend:
                     specs, max_iters, clamp=self.clamp, device=device,
                     use_mxu=(mode == "full"))
             except PallasUnsupported:
+                flight.note(obs_events.WKR_DEMOTE, key=workloads[0].key,
+                            route="fused_to_per_tile",
+                            tiles=len(workloads))
                 return [self.dispatch_tile(w, device) for w in workloads]
         self.registry.inc(obs_names.WORKER_KERNEL_FUSED_LAUNCHES)
         self.registry.inc(obs_names.WORKER_KERNEL_FUSED_TILES,
@@ -319,6 +326,8 @@ class PallasBackend:
             self.registry.inc(obs_names.WORKER_KERNEL_MXU_LAUNCHES)
         elif mode == "census":
             self.registry.inc(obs_names.WORKER_KERNEL_MXU_DEMOTIONS)
+            flight.note(obs_events.WKR_DEMOTE, key=workloads[0].key,
+                        route="mxu_census", tiles=len(workloads))
             self._mxu_shadow(specs, max_iters)
         self._observe_phase(obs_names.PHASE_DISPATCH,
                             time.monotonic() - t0)

@@ -67,6 +67,8 @@ import numpy as np
 
 from distributedmandelbrot_tpu.core.workload import Workload
 from distributedmandelbrot_tpu.net import protocol as proto
+from distributedmandelbrot_tpu.obs import events as obs_events
+from distributedmandelbrot_tpu.obs import flight
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.spans import SpanRecorder, flush_spans
 from distributedmandelbrot_tpu.utils.metrics import Counters
@@ -510,6 +512,8 @@ class PipelineExecutor:
                     waited += slice_s
                 continue
             self._rounds += 1
+            flight.note(obs_events.WKR_STAGE,
+                        stage=obs_names.STAGE_LEASE, tiles=len(got))
             with self._cond:
                 self._in_flight += len(got)
             for w in got:
@@ -586,6 +590,9 @@ class PipelineExecutor:
                 raise
             dt = self.clock() - t0
             st.add(dt, len(batch))
+            flight.note(obs_events.WKR_STAGE, key=batch[0].key,
+                        stage=obs_names.STAGE_DISPATCH, tiles=len(batch),
+                        mesh=launch_dev is None and len(batch) > 1)
             self._disp_launches += 1
             self._disp_tiles += len(batch)
             if len(batch) > 1:
@@ -659,6 +666,8 @@ class PipelineExecutor:
                 self.spans.record(obs_names.SPAN_COMPUTE, workload.key,
                                   s_disp, s1, device=d)
             tile_s = self.clock() - t_disp
+            flight.note(obs_events.WKR_STAGE, key=workload.key,
+                        stage=obs_names.STAGE_MATERIALIZE)
             self.counters.inc(obs_names.WORKER_TILES_COMPUTED)
             self.counters.inc(obs_names.WORKER_COMPUTE_US,
                               int(tile_s * 1e6))
@@ -729,6 +738,9 @@ class PipelineExecutor:
             accepted = self.client.submit_batch(results)
         dt = self.clock() - t0
         st.add(dt, len(results))
+        flight.note(obs_events.WKR_STAGE, key=results[0][0].key,
+                    stage=obs_names.STAGE_UPLOAD, tiles=len(results),
+                    accepted=sum(1 for a in accepted if a))
         if self.spans is not None:
             s1 = self.spans.clock()
             for w, _ in results:

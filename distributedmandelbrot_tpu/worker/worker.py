@@ -31,6 +31,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from distributedmandelbrot_tpu.core.workload import Workload
+from distributedmandelbrot_tpu.obs import flight
 from distributedmandelbrot_tpu.obs import names as obs_names
 from distributedmandelbrot_tpu.obs.spans import SpanRecorder, flush_spans
 from distributedmandelbrot_tpu.utils.metrics import Counters
@@ -102,6 +103,12 @@ class Worker:
         # compute/D2H phases adopts the recorder and owns those stages;
         # otherwise run_once records batch-granularity compute spans.
         self.spans = SpanRecorder()
+        # Flight recorder: the worker names the process and stamps its
+        # span worker id into the dump header, which is the join key
+        # postmortem uses against coordinator-dump clock offsets.
+        rec = flight.ensure("worker", registry=self.registry)
+        if rec is not None and rec.worker_id is None:
+            rec.worker_id = format(self.spans.worker_id, "016x")
         bind_spans = getattr(backend, "bind_spans", None)
         self._backend_spans = bind_spans is not None
         if bind_spans is not None:

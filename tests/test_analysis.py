@@ -1418,6 +1418,63 @@ class W:
                          f"{P}/worker/w.py": src}, "obs-dead") == []
 
 
+# -- obs-event -------------------------------------------------------------
+
+EVENTS_MOD = f"{P}/obs/events.py"
+EVENTS_SRC = '''
+SCHED_GRANT = "sched.grant"
+CKPT_DONE = "ckpt.done"
+'''
+
+
+def test_obs_event_fires_on_unregistered_literal():
+    src = '''
+from distributedmandelbrot_tpu.obs import flight
+
+
+def f(self):
+    flight.note("sched.grant")
+    flight.note("sched.grnat")
+    self.notebook.note("not.an.event")
+'''
+    found = findings_for({EVENTS_MOD: EVENTS_SRC,
+                          f"{P}/coordinator/s.py": src}, "obs-event")
+    # one unregistered emit + CKPT_DONE registered-but-never-emitted
+    assert len(found) == 2
+    assert any("'sched.grnat'" in f.message for f in found)
+    # Without an events module there is no arbiter — stay silent.
+    assert findings_for({f"{P}/coordinator/s.py": src}, "obs-event") == []
+
+
+def test_obs_event_reverse_audit_accepts_attr_and_import_refs():
+    src = f'''
+from {P}.obs import events as obs_events
+from {P}.obs import flight
+
+
+def f():
+    flight.note(obs_events.SCHED_GRANT)
+    flight.note("ckpt.done")
+'''
+    assert findings_for({EVENTS_MOD: EVENTS_SRC,
+                         f"{P}/coordinator/s.py": src}, "obs-event") == []
+
+
+def test_obs_event_reverse_audit_fires_on_ghost_event():
+    src = '''
+from distributedmandelbrot_tpu.obs import flight
+
+
+def f():
+    flight.note("sched.grant")
+'''
+    found = findings_for({EVENTS_MOD: EVENTS_SRC,
+                          f"{P}/coordinator/s.py": src}, "obs-event")
+    assert len(found) == 1
+    assert "CKPT_DONE" in found[0].message
+    assert found[0].path == EVENTS_MOD  # anchored at the registration
+
+
 # -- fsm: protocol state machines ------------------------------------------
 
 FSM_CLIENT_REL = f"{P}/viewer/client.py"
